@@ -1,0 +1,28 @@
+"""Common layer: process identity, lifecycle, shared enums.
+
+Reference parity: ``horovod/common/__init__.py``.
+"""
+
+from horovod_tpu.common.basics import HorovodBasics, basics
+
+init = basics.init
+shutdown = basics.shutdown
+is_initialized = basics.is_initialized
+rank = basics.rank
+size = basics.size
+local_rank = basics.local_rank
+local_size = basics.local_size
+mpi_threads_supported = basics.mpi_threads_supported
+
+__all__ = [
+    "HorovodBasics",
+    "basics",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "rank",
+    "size",
+    "local_rank",
+    "local_size",
+    "mpi_threads_supported",
+]

@@ -1,0 +1,247 @@
+"""Process identity + lifecycle for the TPU-native Horovod rebuild.
+
+Reference parity: ``horovod/common/__init__.py`` (HorovodBasics, the ctypes
+bridge to the C ABI ``horovod_init/_shutdown/_rank/_size/_local_rank/
+_local_size/_mpi_threads_supported`` declared in
+``horovod/common/operations.h:68-98``).
+
+TPU-native design
+-----------------
+Horovod's identity model is "one process per accelerator, ranks assigned by
+mpirun".  On TPU the natural model is SPMD over a device mesh: one process per
+*host*, each owning several chips, with JAX's distributed runtime (not MPI)
+providing process_index/process_count.  We therefore keep Horovod's
+rank/size/local_rank/local_size vocabulary but define it over *processes*
+(hosts), and additionally expose device counts, because data parallelism on
+TPU spans devices-within-a-process as well as processes.
+
+The native C++ core (``horovod_tpu/cpp``, built separately) provides the
+background coordinator (negotiation, fusion, timeline, stall detection)
+behind the same C ABI as the reference.  This module loads it via ctypes when
+the shared library is present, with a pure-Python fallback so the framework
+is importable without the native build.
+"""
+
+from __future__ import annotations
+
+import atexit
+import ctypes
+import os
+import threading
+from typing import Optional, Sequence
+
+__all__ = ["HorovodBasics", "basics"]
+
+# Env vars understood for rank discovery, in priority order.  The OMPI/PMI
+# names are accepted for drop-in familiarity with the reference's mpirun
+# workflow (reference test/common.py:24-56 reads the same names).
+_RANK_ENV = ("HOROVOD_RANK", "OMPI_COMM_WORLD_RANK", "PMI_RANK")
+_SIZE_ENV = ("HOROVOD_SIZE", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE")
+_LOCAL_RANK_ENV = ("HOROVOD_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_RANK")
+_LOCAL_SIZE_ENV = ("HOROVOD_LOCAL_SIZE", "OMPI_COMM_WORLD_LOCAL_SIZE")
+
+
+def _env_int(names: Sequence[str]) -> Optional[int]:
+    for name in names:
+        value = os.environ.get(name)
+        if value is not None and value != "":
+            return int(value)
+    return None
+
+
+def _find_native_lib() -> Optional[str]:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for candidate in (
+        os.path.join(here, "cpp", "libhorovod_core.so"),
+        os.path.join(here, "libhorovod_core.so"),
+    ):
+        if os.path.exists(candidate):
+            return candidate
+    return None
+
+
+class HorovodBasics:
+    """init/shutdown/rank/size lifecycle, optionally backed by the C++ core.
+
+    Mirrors the reference ``HorovodBasics`` (common/__init__.py:51-154): the
+    same method surface, raising if queried before ``init()``.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._initialized = False
+        self._rank = 0
+        self._size = 1
+        self._local_rank = 0
+        self._local_size = 1
+        self._lib = None
+        self._atexit_registered = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init(
+        self,
+        comm: Optional[Sequence[int]] = None,
+        *,
+        rank: Optional[int] = None,
+        size: Optional[int] = None,
+        local_rank: Optional[int] = None,
+        local_size: Optional[int] = None,
+        coordinator: Optional[str] = None,
+    ) -> None:
+        """Initialize the runtime.
+
+        ``comm`` accepts a rank subset for API parity with the reference
+        (common/__init__.py:58-84) but sub-communicators are not yet
+        supported; pass None/[] for world.
+
+        Identity resolution order: explicit kwargs > HOROVOD_*/OMPI_*/PMI_*
+        env vars > JAX distributed runtime (process_index/process_count) >
+        single-process defaults.  Unlike the reference there is no MPI_Init:
+        process rendezvous is the JAX coordination service's job (SURVEY.md
+        §3.1 "TPU equivalent").
+        """
+        with self._lock:
+            if self._initialized:
+                return
+            if comm:
+                raise NotImplementedError(
+                    "sub-communicators (hvd.init(comm=...)) are not supported yet"
+                )
+
+            env_rank = _env_int(_RANK_ENV)
+            env_size = _env_int(_SIZE_ENV)
+            if rank is None:
+                rank = env_rank
+            if size is None:
+                size = env_size
+            from_jax = False
+            if rank is None or size is None:
+                jrank, jsize = self._jax_identity()
+                rank = jrank if rank is None else rank
+                size = jsize if size is None else size
+                from_jax = True
+            if local_rank is None:
+                local_rank = _env_int(_LOCAL_RANK_ENV)
+            if local_size is None:
+                local_size = _env_int(_LOCAL_SIZE_ENV)
+            if local_size is None:
+                if from_jax:
+                    # JAX multi-host deployments run one process per host.
+                    local_size = 1
+                else:
+                    # Env-launched N processes with no local info: the
+                    # single-host CI/test topology.
+                    local_size = size
+            if local_rank is None:
+                local_rank = rank % local_size
+
+            self._rank = int(rank)
+            self._size = int(size)
+            self._local_rank = int(local_rank)
+            self._local_size = int(local_size)
+
+            self._load_native()
+            if self._lib is not None:
+                addr = coordinator or os.environ.get("HOROVOD_COORDINATOR", "")
+                ret = self._lib.horovod_init(
+                    self._rank,
+                    self._size,
+                    self._local_rank,
+                    self._local_size,
+                    addr.encode(),
+                )
+                if ret != 0:
+                    raise RuntimeError(
+                        f"native horovod_init failed with code {ret}"
+                    )
+            self._initialized = True
+            if not self._atexit_registered:
+                # Reference registers shutdown via atexit (common/__init__.py:69).
+                atexit.register(self.shutdown)
+                self._atexit_registered = True
+
+    def shutdown(self) -> None:
+        with self._lock:
+            if not self._initialized:
+                return
+            if self._lib is not None:
+                self._lib.horovod_shutdown()
+            self._initialized = False
+
+    # -- queries -----------------------------------------------------------
+
+    def _check(self) -> None:
+        if not self._initialized:
+            # Same contract as reference CheckInitialized (operations.cc:1933).
+            raise ValueError(
+                "Horovod has not been initialized; use hvd.init()."
+            )
+
+    def is_initialized(self) -> bool:
+        return self._initialized
+
+    def rank(self) -> int:
+        self._check()
+        return self._rank
+
+    def size(self) -> int:
+        self._check()
+        return self._size
+
+    def local_rank(self) -> int:
+        self._check()
+        return self._local_rank
+
+    def local_size(self) -> int:
+        self._check()
+        return self._local_size
+
+    def mpi_threads_supported(self) -> bool:
+        """Parity shim: there is no MPI; the coordination service is
+        inherently multi-threaded, so report True (reference
+        common/__init__.py:147-154)."""
+        self._check()
+        return True
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _jax_identity() -> tuple[int, int]:
+        try:
+            import jax
+
+            return jax.process_index(), jax.process_count()
+        except Exception:
+            return 0, 1
+
+    def _load_native(self) -> None:
+        if self._lib is not None:
+            return
+        path = _find_native_lib()
+        if path is None:
+            return
+        try:
+            lib = ctypes.CDLL(path, mode=ctypes.RTLD_GLOBAL)
+        except OSError:
+            return
+        lib.horovod_init.argtypes = [
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_char_p,
+        ]
+        lib.horovod_init.restype = ctypes.c_int
+        lib.horovod_shutdown.argtypes = []
+        lib.horovod_shutdown.restype = None
+        self._lib = lib
+
+    @property
+    def native_lib(self):
+        """The loaded C++ core (ctypes CDLL) or None."""
+        return self._lib
+
+
+#: Singleton, mirroring the reference's module-level basics object.
+basics = HorovodBasics()

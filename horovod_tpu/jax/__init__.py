@@ -1,0 +1,287 @@
+"""JAX frontend: the flagship user API of the TPU-native Horovod rebuild.
+
+Reference parity: ``horovod/tensorflow/__init__.py`` (225 LoC) — ``init``,
+rank queries, ``allreduce``, ``broadcast_global_variables``,
+``DistributedOptimizer`` — re-thought for JAX's functional model:
+
+* ``DistributedOptimizer`` wraps an *optax* ``GradientTransformation``; the
+  wrapped ``update`` fuses and psums gradients over the mesh's data axes
+  before the inner optimizer sees them.  This is the exact analogue of the
+  reference overriding ``compute_gradients`` to allreduce each grad
+  (tensorflow/__init__.py:183-209), but it happens inside ``jit`` where XLA
+  overlaps the ICI collectives with remaining backward compute — the same
+  overlap the reference engineered by hand with its background thread.
+* ``broadcast_parameters`` replaces ``BroadcastGlobalVariablesHook``:
+  functional in, functional out (no sessions, no variable mutation).
+* Collectives dispatch on context: on tracers (inside jit/shard_map) they are
+  single XLA ops over a named axis; on concrete arrays they go through the
+  eager runtime engine (negotiation across processes), matching the
+  reference's eager TF path.
+
+Typical use::
+
+    import horovod_tpu.jax as hvd
+    hvd.init()
+    mesh = hvd.data_parallel_mesh()
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01 * hvd.num_chips()))
+    step = hvd.make_train_step(loss_fn, opt, mesh)
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec
+
+from horovod_tpu.common import (
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.ops import collective_ops as _cops
+from horovod_tpu.ops.collective_ops import (
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+)
+from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops.fusion import fuse_apply
+from horovod_tpu.parallel import mesh as _mesh
+from horovod_tpu.parallel.mesh import (
+    build_mesh,
+    data_parallel_mesh,
+    default_mesh,
+    use_mesh,
+)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "mpi_threads_supported",
+    "num_chips", "local_devices",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "reducescatter", "alltoall",
+    "Average", "Sum", "Min", "Max", "Product", "ReduceOp", "Compression",
+    "DistributedOptimizer", "allreduce_gradients",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "build_mesh", "data_parallel_mesh", "default_mesh", "use_mesh",
+    "make_train_step",
+]
+
+
+def num_chips() -> int:
+    """Total number of TPU chips across all processes (the unit the
+    reference calls ``size`` when run one-process-per-GPU)."""
+    return jax.device_count()
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+# ---------------------------------------------------------------------------
+# Collectives (context-dispatching wrappers)
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, *, axis_name="data", op=Average, average=None,
+              compression=Compression.none, name=None):
+    """Allreduce. Inside jit/shard_map: one XLA collective over ``axis_name``.
+
+    On concrete values: process-level eager allreduce through the runtime
+    engine (identity at size()==1, like the reference under ``-np 1``).
+    """
+    if _is_traced(tensor):
+        return _cops.allreduce(
+            tensor, axis_name=axis_name, op=op, average=average,
+            compression=compression,
+        )
+    from horovod_tpu.runtime import eager
+
+    return eager.allreduce(tensor, op=op, average=average,
+                           compression=compression, name=name)
+
+
+def grouped_allreduce(tensors, *, axis_name="data", op=Average,
+                      compression=Compression.none, name=None):
+    if tensors and _is_traced(tensors[0]):
+        return _cops.grouped_allreduce(
+            tensors, axis_name=axis_name, op=op, compression=compression
+        )
+    from horovod_tpu.runtime import eager
+
+    return eager.grouped_allreduce(tensors, op=op, compression=compression,
+                                   name=name)
+
+
+def allgather(tensor, *, axis_name="data", axis=0, name=None):
+    if _is_traced(tensor):
+        return _cops.allgather(tensor, axis_name=axis_name, axis=axis)
+    from horovod_tpu.runtime import eager
+
+    return eager.allgather(tensor, name=name)
+
+
+def broadcast(tensor, root_rank=0, *, axis_name="data", name=None):
+    if _is_traced(tensor):
+        return _cops.broadcast(tensor, root_rank, axis_name=axis_name)
+    from horovod_tpu.runtime import eager
+
+    return eager.broadcast(tensor, root_rank=root_rank, name=name)
+
+
+reducescatter = _cops.reducescatter
+alltoall = _cops.alltoall
+
+
+# ---------------------------------------------------------------------------
+# Gradient reduction + DistributedOptimizer
+# ---------------------------------------------------------------------------
+
+def allreduce_gradients(grads, *, axis_name=None, op=Average,
+                        compression=Compression.none,
+                        fusion_threshold_bytes=None):
+    """Fused allreduce of a gradient pytree over the data axes.
+
+    ``axis_name`` may be a name, tuple of names, or None (= every data-like
+    axis of the default mesh: ``data`` and ``fsdp``).
+    """
+    if axis_name is None:
+        axis_name = _mesh.data_axes() or ("data",)
+
+    def _reduce_buffer(buf):
+        return _cops.allreduce(buf, axis_name=axis_name, op=op,
+                               compression=compression)
+
+    return fuse_apply(grads, _reduce_buffer, fusion_threshold_bytes)
+
+
+class DistributedOptimizer:
+    """Wrap an optax ``GradientTransformation`` so that ``update`` averages
+    gradients across the mesh before applying the inner optimizer.
+
+    Reference parity: ``hvd.DistributedOptimizer`` (tensorflow/__init__.py:
+    135-209).  Implements the optax interface, so it drops into any optax
+    pipeline (including ``optax.chain``) and into flax's TrainState.
+
+    Must be called inside a context with the mesh axes bound (shard_map or
+    pmap); under plain pjit-with-sharded-batch XLA already inserts the psum,
+    in which case wrap with ``reduce_gradients=False`` to keep only the
+    bookkeeping.
+    """
+
+    def __init__(self, optimizer, *, axis_name=None, op=Average,
+                 compression=Compression.none, fusion_threshold_bytes=None,
+                 reduce_gradients=True, name=None):
+        self._inner = optimizer
+        self._axis_name = axis_name
+        self._op = op
+        self._compression = compression
+        self._fusion_threshold = fusion_threshold_bytes
+        self._reduce = reduce_gradients
+        self.name = name or "DistributedOptimizer"
+
+    def init(self, params):
+        return self._inner.init(params)
+
+    def update(self, grads, state, params=None, **extra):
+        if self._reduce:
+            grads = allreduce_gradients(
+                grads,
+                axis_name=self._axis_name,
+                op=self._op,
+                compression=self._compression,
+                fusion_threshold_bytes=self._fusion_threshold,
+            )
+        return self._inner.update(grads, state, params, **extra)
+
+    # Make it quack like an optax.GradientTransformation namedtuple.
+    def __iter__(self):
+        return iter((self.init, self.update))
+
+
+def broadcast_parameters(params, root_rank=0, *, axis_name=None):
+    """Return ``params`` with every leaf replaced by root's value.
+
+    Reference parity: ``broadcast_global_variables`` / torch
+    ``broadcast_parameters`` (tensorflow/__init__.py:90-98,
+    torch/__init__.py:153-182).  Functional: returns the synced pytree.
+
+    On tracers this is an in-jit masked-psum broadcast; on concrete arrays it
+    is a cross-process broadcast through the runtime (host path), which at
+    ``size()==1`` is the identity.
+    """
+    leaves = jax.tree.leaves(params)
+    if leaves and _is_traced(leaves[0]):
+        if axis_name is None:
+            axis_name = _mesh.data_axes() or ("data",)
+
+        def _bcast_buffer(buf):
+            return _cops.broadcast(buf, root_rank, axis_name=axis_name)
+
+        return fuse_apply(params, _bcast_buffer)
+    from horovod_tpu.runtime import eager
+
+    return jax.tree.map(
+        lambda x: eager.broadcast(x, root_rank=root_rank), params
+    )
+
+
+def broadcast_optimizer_state(opt_state, root_rank=0, *, axis_name=None):
+    """Broadcast optimizer state from root (reference torch/__init__.py:
+    185-301).  Optax states are pytrees of arrays, so no scalar
+    tensor-ization dance is needed — one fused broadcast covers it."""
+    return broadcast_parameters(opt_state, root_rank, axis_name=axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Train-step builder (the minimum end-to-end slice, SURVEY.md §7 step 4)
+# ---------------------------------------------------------------------------
+
+def make_train_step(loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
+                    *, donate=True):
+    """Build a jitted SPMD train step: shard batch over data axes, compute
+    grads, fused-allreduce them, apply the optimizer.
+
+    ``loss_fn(params, batch) -> scalar loss``.  ``optimizer`` may be a plain
+    optax transformation (it will be wrapped) or a ``DistributedOptimizer``.
+
+    Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``
+    with params/opt_state replicated and batch sharded on the data axes.
+    """
+    mesh = mesh or default_mesh()
+    axes = tuple(a for a in mesh.axis_names if a in ("data", "fsdp")) or mesh.axis_names
+    if not isinstance(optimizer, DistributedOptimizer):
+        optimizer = DistributedOptimizer(optimizer, axis_name=axes)
+
+    def _sharded_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+
+        params = optax.apply_updates(params, updates)
+        loss = _cops.allreduce(loss, axis_name=axes, op=Average)
+        return params, opt_state, loss
+
+    batch_spec = PartitionSpec(axes)
+    replicated = PartitionSpec()
+    step = jax.shard_map(
+        _sharded_step,
+        mesh=mesh,
+        in_specs=(replicated, replicated, batch_spec),
+        out_specs=(replicated, replicated, replicated),
+        check_vma=False,
+    )
+    donate_args = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_args)

@@ -1,0 +1,50 @@
+"""Collective ops, compression, and fusion."""
+
+from horovod_tpu.ops.collective_ops import (
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    axis_rank,
+    axis_size,
+    broadcast,
+    grouped_allreduce,
+    reducescatter,
+)
+from horovod_tpu.ops.compression import Compression, Compressor
+from horovod_tpu.ops.fusion import (
+    DEFAULT_FUSION_THRESHOLD,
+    FusionPlan,
+    fuse_apply,
+    fusion_threshold_bytes,
+    plan_fusion,
+)
+
+__all__ = [
+    "Average",
+    "Max",
+    "Min",
+    "Product",
+    "ReduceOp",
+    "Sum",
+    "allgather",
+    "allreduce",
+    "alltoall",
+    "axis_rank",
+    "axis_size",
+    "broadcast",
+    "grouped_allreduce",
+    "reducescatter",
+    "Compression",
+    "Compressor",
+    "DEFAULT_FUSION_THRESHOLD",
+    "FusionPlan",
+    "fuse_apply",
+    "fusion_threshold_bytes",
+    "plan_fusion",
+]

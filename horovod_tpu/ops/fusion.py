@@ -1,0 +1,135 @@
+"""Tensor fusion: batching many small tensors into few large collectives.
+
+Reference parity: the Tensor Fusion buffer (``horovod/common/operations.cc``
+149-165, 743-767, 1232-1311 and ``docs/tensor-fusion.md``): a 64 MB persistent
+buffer per (device, framework); consecutive same-dtype responses are packed
+back-to-back, one collective runs over the packed buffer, results are copied
+back out.  Threshold via ``HOROVOD_FUSION_THRESHOLD``.
+
+TPU-native design: under XLA there is no persistent staging buffer and no
+memcpy — fusion is *flattening the gradient pytree at trace time*.  We
+ravel + concatenate same-dtype leaves into flat buffers up to the threshold,
+run one ``psum`` per buffer (a single large ICI collective keeps the links
+saturated, which is where scaling efficiency is won — SURVEY.md §7 "Fusion on
+TPU"), then slice + reshape back.  XLA fuses the pack/unpack copies into the
+collective's prologue/epilogue, so unlike the reference there is no extra HBM
+round-trip.  The plan is shape-static, so it traces once per pytree structure.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "DEFAULT_FUSION_THRESHOLD",
+    "fusion_threshold_bytes",
+    "FusionPlan",
+    "plan_fusion",
+    "fuse_apply",
+]
+
+#: 64 MB, matching the reference default (operations.cc:1595).
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+
+
+def fusion_threshold_bytes() -> int:
+    """Read ``HOROVOD_FUSION_THRESHOLD`` (bytes), reference knob parity
+    (operations.cc:1595-1618).  0 disables fusion."""
+    value = os.environ.get("HOROVOD_FUSION_THRESHOLD")
+    if value is None or value == "":
+        return DEFAULT_FUSION_THRESHOLD
+    return int(value)
+
+
+@dataclass(frozen=True)
+class _Bucket:
+    dtype: Any
+    indices: tuple[int, ...]  # leaf positions in flattened order
+    sizes: tuple[int, ...]
+    shapes: tuple[tuple[int, ...], ...]
+
+
+@dataclass(frozen=True)
+class FusionPlan:
+    buckets: tuple[_Bucket, ...]
+    n_leaves: int
+
+
+def plan_fusion(
+    leaves: Sequence[jax.Array], threshold_bytes: int | None = None
+) -> FusionPlan:
+    """Group leaves into same-dtype buckets of at most ``threshold_bytes``.
+
+    Order within a dtype is preserved; a bucket never mixes dtypes (the
+    reference likewise only fuses same-dtype, same-device responses,
+    operations.cc:1815-1842).
+    """
+    if threshold_bytes is None:
+        threshold_bytes = fusion_threshold_bytes()
+    by_dtype: dict[Any, list[int]] = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+
+    buckets: list[_Bucket] = []
+    for dtype, idxs in by_dtype.items():
+        itemsize = np.dtype(dtype).itemsize
+        cur: list[int] = []
+        cur_bytes = 0
+        for i in idxs:
+            nbytes = int(np.prod(jnp.shape(leaves[i]), dtype=np.int64)) * itemsize
+            if cur and threshold_bytes > 0 and cur_bytes + nbytes > threshold_bytes:
+                buckets.append(_mk_bucket(dtype, cur, leaves))
+                cur, cur_bytes = [], 0
+            if threshold_bytes == 0:
+                # Fusion disabled: one leaf per bucket.
+                buckets.append(_mk_bucket(dtype, [i], leaves))
+                continue
+            cur.append(i)
+            cur_bytes += nbytes
+        if cur:
+            buckets.append(_mk_bucket(dtype, cur, leaves))
+    return FusionPlan(buckets=tuple(buckets), n_leaves=len(leaves))
+
+
+def _mk_bucket(dtype, idxs: list[int], leaves) -> _Bucket:
+    shapes = tuple(tuple(jnp.shape(leaves[i])) for i in idxs)
+    sizes = tuple(int(np.prod(s, dtype=np.int64)) for s in shapes)
+    return _Bucket(dtype=dtype, indices=tuple(idxs), sizes=sizes, shapes=shapes)
+
+
+def fuse_apply(
+    tree: Any,
+    fn: Callable[[jax.Array], jax.Array],
+    threshold_bytes: int | None = None,
+) -> Any:
+    """Apply ``fn`` (e.g. a psum) over fused flat buffers of ``tree``.
+
+    Equivalent to ``jax.tree.map(fn_elementwise, tree)`` when ``fn`` is an
+    elementwise-safe collective, but emits one ``fn`` call per fused bucket
+    instead of one per leaf.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    plan = plan_fusion(leaves, threshold_bytes)
+    out: list[Any] = [None] * plan.n_leaves
+    for bucket in plan.buckets:
+        if len(bucket.indices) == 1:
+            i = bucket.indices[0]
+            out[i] = fn(leaves[i])
+            continue
+        flat = jnp.concatenate(
+            [jnp.ravel(leaves[i]) for i in bucket.indices], axis=0
+        )
+        reduced = fn(flat)
+        offset = 0
+        for i, size, shape in zip(bucket.indices, bucket.sizes, bucket.shapes):
+            out[i] = jax.lax.slice_in_dim(reduced, offset, offset + size).reshape(shape)
+            offset += size
+    return jax.tree.unflatten(treedef, out)

@@ -1,0 +1,31 @@
+"""Parallelism strategies: meshes, FSDP, sequence/context parallelism."""
+
+from horovod_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    build_mesh,
+    data_axes,
+    data_parallel_mesh,
+    default_mesh,
+    mesh_axis_size,
+    set_default_mesh,
+    use_mesh,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_EXPERT",
+    "AXIS_FSDP",
+    "AXIS_SEQ",
+    "AXIS_TENSOR",
+    "build_mesh",
+    "data_axes",
+    "data_parallel_mesh",
+    "default_mesh",
+    "mesh_axis_size",
+    "set_default_mesh",
+    "use_mesh",
+]

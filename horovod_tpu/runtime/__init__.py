@@ -1,0 +1,1 @@
+"""Host-side runtime: eager collectives, negotiation engine bridge."""

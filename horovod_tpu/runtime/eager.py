@@ -1,0 +1,86 @@
+"""Eager (host-driven) collectives across processes.
+
+Reference parity: the enqueue → negotiate → execute pipeline
+(``EnqueueTensorAllreduce`` → ``RunLoopOnce`` → ``PerformOperation``,
+``horovod/common/operations.cc:2029-2145, 1694-1907, 714-1362``).
+
+This module is the Python face of that pipeline.  At ``size() == 1`` the
+collectives are arithmetic identities (matching the reference under
+``mpirun -np 1``), with averaging/compression semantics still applied so
+code paths are identical at any scale.  At ``size() > 1`` calls are routed
+through the native negotiation engine (``horovod_tpu.cpp``) which establishes
+a globally agreed, identically ordered, fused batch of collectives per cycle
+— the reference's central correctness idea — and then executes them either
+over the global device mesh (XLA data plane) or the host socket data plane.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from horovod_tpu.common.basics import basics
+from horovod_tpu.ops.collective_ops import Average, ReduceOp, Sum
+from horovod_tpu.ops.compression import Compression
+
+__all__ = ["allreduce", "grouped_allreduce", "allgather", "broadcast"]
+
+
+def _resolve_op(op, average):
+    if average is not None:
+        return Average if average else Sum
+    return op
+
+
+def _engine():
+    """The multi-process negotiation engine (None at size 1)."""
+    if basics.size() == 1:
+        return None
+    try:
+        from horovod_tpu.runtime import engine
+    except ImportError as e:
+        raise NotImplementedError(
+            "eager collectives at size > 1 require the negotiation engine "
+            "(horovod_tpu.runtime.engine), which is not available: "
+            f"{e}"
+        ) from e
+    return engine.get_engine()
+
+
+def allreduce(tensor, *, op=Average, average=None,
+              compression=Compression.none, name: Optional[str] = None):
+    op = _resolve_op(op, average)
+    eng = _engine()
+    if eng is None:
+        wire, ctx = compression.compress(jnp.asarray(tensor))
+        return compression.decompress(wire, ctx)
+    return eng.allreduce(tensor, op=op, compression=compression, name=name)
+
+
+def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
+                      compression=Compression.none,
+                      name: Optional[str] = None):
+    return [
+        allreduce(t, op=op, average=average, compression=compression,
+                  name=None if name is None else f"{name}.{i}")
+        for i, t in enumerate(tensors)
+    ]
+
+
+def allgather(tensor, *, name: Optional[str] = None):
+    eng = _engine()
+    if eng is None:
+        return jnp.asarray(tensor)
+    return eng.allgather(tensor, name=name)
+
+
+def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None):
+    eng = _engine()
+    if eng is None:
+        if root_rank != 0:
+            raise ValueError(
+                f"root_rank {root_rank} out of range for size 1"
+            )
+        return jnp.asarray(tensor)
+    return eng.broadcast(tensor, root_rank=root_rank, name=name)

@@ -1,0 +1,6 @@
+"""Version of the horovod_tpu framework.
+
+Reference parity target: Horovod 0.15.1 (``/root/reference/horovod/__init__.py:1``).
+"""
+
+__version__ = "0.1.0"

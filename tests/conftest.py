@@ -1,0 +1,43 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+Reference parity: the reference tests run under ``mpirun -np 2 pytest``
+(.travis.yml:104-111).  The TPU-native equivalent (SURVEY.md §4) is a
+multi-device mesh simulated on CPU via
+``--xla_force_host_platform_device_count`` — the sitecustomize in this image
+registers a TPU plugin at interpreter start, so we must also switch the
+platform back to CPU before first JAX use.
+"""
+
+import os
+import sys
+
+# Make the repo importable when pytest is run from anywhere.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DEVICES = 8
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={N_DEVICES}"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def n_devices():
+    assert len(jax.devices()) == N_DEVICES
+    return N_DEVICES
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hvd_init():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    yield

@@ -1,0 +1,55 @@
+"""Lifecycle + identity tests.
+
+Reference parity: rank/size validation against env ground truth
+(test/common.py:24-56) and the uninitialized-error contract
+(operations.cc:1933).
+"""
+
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.common.basics import HorovodBasics
+
+
+def test_initialized_identity():
+    assert hvd.is_initialized()
+    assert hvd.size() >= 1
+    assert 0 <= hvd.rank() < hvd.size()
+    assert 0 <= hvd.local_rank() < hvd.local_size()
+    assert hvd.mpi_threads_supported() is True
+
+
+def test_uninitialized_raises():
+    b = HorovodBasics()
+    with pytest.raises(ValueError, match="not been initialized"):
+        b.rank()
+    with pytest.raises(ValueError, match="not been initialized"):
+        b.size()
+
+
+def test_env_rank_discovery(monkeypatch):
+    b = HorovodBasics()
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HOROVOD_SIZE", "8")
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", "1")
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", "4")
+    b.init()
+    assert b.rank() == 3
+    assert b.size() == 8
+    assert b.local_rank() == 1
+    assert b.local_size() == 4
+    b.shutdown()
+
+
+def test_double_init_is_noop():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_subcommunicator_unsupported():
+    b = HorovodBasics()
+    with pytest.raises(NotImplementedError):
+        b.init(comm=[0, 1])

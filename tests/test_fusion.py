@@ -1,0 +1,107 @@
+"""Fusion planner tests (reference docs/tensor-fusion.md semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops import fusion
+
+
+def test_plan_groups_by_dtype():
+    leaves = [
+        jnp.ones((4,), jnp.float32),
+        jnp.ones((2,), jnp.bfloat16),
+        jnp.ones((3,), jnp.float32),
+    ]
+    plan = fusion.plan_fusion(leaves, threshold_bytes=1 << 20)
+    assert len(plan.buckets) == 2
+    dtypes = {b.dtype for b in plan.buckets}
+    assert jnp.dtype(jnp.float32) in dtypes
+    assert jnp.dtype(jnp.bfloat16) in dtypes
+    f32 = next(b for b in plan.buckets if b.dtype == jnp.dtype(jnp.float32))
+    assert f32.indices == (0, 2)
+
+
+def test_plan_respects_threshold():
+    # 3 tensors of 1024 f32 = 4 KiB each; threshold 8 KiB -> 2 buckets.
+    leaves = [jnp.ones((1024,), jnp.float32) for _ in range(3)]
+    plan = fusion.plan_fusion(leaves, threshold_bytes=8 * 1024)
+    assert len(plan.buckets) == 2
+    assert plan.buckets[0].indices == (0, 1)
+    assert plan.buckets[1].indices == (2,)
+
+
+def test_threshold_zero_disables_fusion():
+    leaves = [jnp.ones((8,), jnp.float32) for _ in range(3)]
+    plan = fusion.plan_fusion(leaves, threshold_bytes=0)
+    assert len(plan.buckets) == 3
+
+
+def test_fuse_apply_roundtrip():
+    rng = np.random.RandomState(0)
+    tree = {
+        "a": jnp.asarray(rng.randn(3, 4).astype(np.float32)),
+        "b": [
+            jnp.asarray(rng.randn(7).astype(np.float32)),
+            jnp.asarray(rng.randn(2, 2, 2).astype(np.float32)),
+        ],
+        "c": jnp.asarray(rng.randn(5).astype(np.float64)),
+    }
+    out = fusion.fuse_apply(tree, lambda buf: buf * 2.0)
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 2),
+        tree,
+        out,
+    )
+    # Shapes and dtypes preserved exactly.
+    jax.tree.map(
+        lambda x, y: (x.shape == y.shape, x.dtype == y.dtype), tree, out
+    )
+
+
+def test_fuse_apply_under_jit_single_collective(n_devices):
+    """The whole point: one psum per dtype bucket, not one per leaf."""
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu.jax as hvd
+
+    mesh = hvd.data_parallel_mesh()
+    tree = [jnp.ones((n_devices, 8), jnp.float32) for _ in range(10)]
+
+    def fn(*shards):
+        return tuple(
+            fusion.fuse_apply(
+                [s.reshape(s.shape[1:]) for s in shards],
+                lambda buf: jax.lax.psum(buf, "data"),
+            )
+        )
+
+    lowered = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=tuple(P("data") for _ in tree),
+            out_specs=tuple(P() for _ in tree),
+            check_vma=False,
+        )
+    ).lower(*tree)
+    hlo = lowered.as_text()
+    assert hlo.count("all-reduce") <= 2, hlo.count("all-reduce")
+    outs = jax.jit(
+        jax.shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=tuple(P("data") for _ in tree),
+            out_specs=tuple(P() for _ in tree),
+            check_vma=False,
+        )
+    )(*tree)
+    for o in outs:
+        np.testing.assert_allclose(np.asarray(o), n_devices)
+
+
+def test_env_threshold(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "12345")
+    assert fusion.fusion_threshold_bytes() == 12345
+    monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD")
+    assert fusion.fusion_threshold_bytes() == fusion.DEFAULT_FUSION_THRESHOLD
