@@ -1,0 +1,118 @@
+"""Benchmark harness: ResNet-50 synthetic training throughput.
+
+Mirrors the reference's img/sec methodology
+(``examples/pytorch_synthetic_benchmark.py:73-110``: timed fwd+bwd+step loop
+over synthetic ImageNet batches, img/sec per device) on TPU via the
+framework's own train-step path.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` compares against the reference's only published absolute
+throughput: tf_cnn_benchmarks ResNet-101 at 1656.82 total img/s on 16 Pascal
+GPUs = 103.55 img/s/GPU (``docs/benchmarks.md:22-37``; the reference
+publishes no ResNet-50 or TPU numbers — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.md:22-37
+
+
+def main() -> None:
+    import optax
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.models import ResNet50
+
+    hvd.init()
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        batch_per_chip, image_size, iters, warmup = 256, 224, 30, 10
+    else:  # CPU smoke mode so the harness is runnable anywhere
+        batch_per_chip, image_size, iters, warmup = 8, 32, 3, 1
+
+    n_chips = jax.device_count()
+    mesh = hvd.data_parallel_mesh()
+    model = ResNet50(dtype=jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.standard_normal(
+            (batch_per_chip * n_chips, image_size, image_size, 3),
+            dtype=np.float32,
+        )
+    )
+    labels = jnp.asarray(rng.integers(0, 1000, batch_per_chip * n_chips))
+
+    variables = jax.jit(
+        lambda: model.init(jax.random.key(0), images[:1], train=False)
+    )()
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # Reference recipe: momentum SGD, LR scaled by world size
+    # (examples/pytorch_synthetic_benchmark.py:57-62, keras LR×size).
+    opt = optax.sgd(0.01 * n_chips, momentum=0.9)
+    opt_state = jax.jit(opt.init)(params)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data_sharding = NamedSharding(mesh, P("data"))
+    repl = NamedSharding(mesh, P())
+    images = jax.device_put(images, data_sharding)
+    labels = jax.device_put(labels, data_sharding)
+    params = jax.device_put(params, repl)
+    batch_stats = jax.device_put(batch_stats, repl)
+    opt_state = jax.device_put(opt_state, repl)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images, train=True, mutable=["batch_stats"],
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+        return loss, updates["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, batch_stats, images, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, batch_stats, opt_state, loss
+
+    state = (params, batch_stats, opt_state)
+    for _ in range(warmup):
+        *state, loss = train_step(*state, images, labels)
+    # Sync via host fetch: the final loss depends on the whole step chain.
+    # (block_until_ready alone has proven unreliable over remote-device
+    # tunnels, returning before execution finishes.)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        *state, loss = train_step(*state, images, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    total_img_per_sec = batch_per_chip * n_chips * iters / dt
+    per_chip = total_img_per_sec / n_chips
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip"
+                  if on_tpu else "resnet50_train_images_per_sec_per_chip_cpu_smoke",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

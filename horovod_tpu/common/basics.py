@@ -109,17 +109,20 @@ class HorovodBasics:
                     "sub-communicators (hvd.init(comm=...)) are not supported yet"
                 )
 
-            env_rank = _env_int(_RANK_ENV)
-            env_size = _env_int(_SIZE_ENV)
             if rank is None:
-                rank = env_rank
+                rank = _env_int(_RANK_ENV)
             if size is None:
-                size = env_size
+                size = _env_int(_SIZE_ENV)
+            if (rank is None) != (size is None):
+                raise ValueError(
+                    "half-specified identity: rank and size must be given "
+                    "together (via kwargs or HOROVOD_RANK/HOROVOD_SIZE "
+                    "style env vars); got "
+                    f"rank={rank!r}, size={size!r}"
+                )
             from_jax = False
-            if rank is None or size is None:
-                jrank, jsize = self._jax_identity()
-                rank = jrank if rank is None else rank
-                size = jsize if size is None else size
+            if rank is None:
+                rank, size = self._jax_identity()
                 from_jax = True
             if local_rank is None:
                 local_rank = _env_int(_LOCAL_RANK_ENV)
@@ -136,10 +139,21 @@ class HorovodBasics:
             if local_rank is None:
                 local_rank = rank % local_size
 
-            self._rank = int(rank)
-            self._size = int(size)
-            self._local_rank = int(local_rank)
-            self._local_size = int(local_size)
+            rank, size = int(rank), int(size)
+            local_rank, local_size = int(local_rank), int(local_size)
+            if not (0 < size and 0 <= rank < size):
+                raise ValueError(
+                    f"invalid identity: rank={rank}, size={size}"
+                )
+            if not (0 < local_size <= size and 0 <= local_rank < local_size):
+                raise ValueError(
+                    f"invalid local identity: local_rank={local_rank}, "
+                    f"local_size={local_size} (size={size})"
+                )
+            self._rank = rank
+            self._size = size
+            self._local_rank = local_rank
+            self._local_size = local_size
 
             self._load_native()
             if self._lib is not None:

@@ -141,8 +141,35 @@ def broadcast(tensor, root_rank=0, *, axis_name="data", name=None):
     return eager.broadcast(tensor, root_rank=root_rank, name=name)
 
 
-reducescatter = _cops.reducescatter
-alltoall = _cops.alltoall
+def reducescatter(tensor, *, axis_name="data", op=Sum, scatter_axis=0,
+                  tiled=True, name=None):
+    if _is_traced(tensor):
+        return _cops.reducescatter(tensor, axis_name=axis_name, op=op,
+                                   scatter_axis=scatter_axis, tiled=tiled)
+    if size() == 1:
+        # World of one: reduce is identity; the scatter keeps the full shard.
+        import jax.numpy as jnp
+
+        return jnp.asarray(tensor)
+    raise NotImplementedError(
+        "eager reducescatter across processes is not supported yet; use it "
+        "inside shard_map/make_train_step, or allreduce + slice"
+    )
+
+
+def alltoall(tensor, *, axis_name="seq", split_axis=0, concat_axis=0,
+             name=None):
+    if _is_traced(tensor):
+        return _cops.alltoall(tensor, axis_name=axis_name,
+                              split_axis=split_axis, concat_axis=concat_axis)
+    if size() == 1:
+        import jax.numpy as jnp
+
+        return jnp.asarray(tensor)
+    raise NotImplementedError(
+        "eager alltoall across processes is not supported yet; use it "
+        "inside shard_map"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -261,9 +288,18 @@ def make_train_step(loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
     with params/opt_state replicated and batch sharded on the data axes.
     """
     mesh = mesh or default_mesh()
-    axes = tuple(a for a in mesh.axis_names if a in ("data", "fsdp")) or mesh.axis_names
+    axes = _mesh.data_axes(mesh) or mesh.axis_names
     if not isinstance(optimizer, DistributedOptimizer):
         optimizer = DistributedOptimizer(optimizer, axis_name=axes)
+    elif optimizer._axis_name is None:
+        # Bind reduction to THIS mesh's data-like axes — resolving from the
+        # thread-local default mesh would silently skip e.g. 'fsdp'.
+        optimizer = DistributedOptimizer(
+            optimizer._inner, axis_name=axes, op=optimizer._op,
+            compression=optimizer._compression,
+            fusion_threshold_bytes=optimizer._fusion_threshold,
+            reduce_gradients=optimizer._reduce, name=optimizer.name,
+        )
 
     def _sharded_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

@@ -1,0 +1,32 @@
+"""Model zoo for the TPU-native framework.
+
+Covers the reference's example workloads (reference ``examples/``:
+MNIST convnets ×4, ImageNet ResNet-50 ×2, word2vec, synthetic ResNet
+benchmark) plus the transformer families (BERT, Llama) used by the
+FSDP-style baseline workloads.  All models are flax.linen modules designed
+TPU-first: bfloat16 compute with float32 params, channels-last layouts,
+MXU-friendly dimensions.
+"""
+
+from horovod_tpu.models.mnist import MnistConvNet, MnistMLP
+from horovod_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
+from horovod_tpu.models.word2vec import SkipGramModel, nce_loss
+from horovod_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
+from horovod_tpu.models.llama import LlamaConfig, LlamaModel
+
+__all__ = [
+    "MnistConvNet",
+    "MnistMLP",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "SkipGramModel",
+    "nce_loss",
+    "BertConfig",
+    "BertEncoder",
+    "BertForPretraining",
+    "LlamaConfig",
+    "LlamaModel",
+]

@@ -1,0 +1,154 @@
+"""BERT encoder family.
+
+No reference equivalent — Horovod 0.15.1 predates BERT — but the baseline
+workload list (BASELINE.json / SURVEY.md §5.7) adds a BERT-base data/FSDP
+workload, so the model zoo carries one.
+
+TPU-first: bf16 compute / fp32 params, fused QKV projection (one large
+matmul instead of three — keeps the MXU busy), attention via a pluggable
+``attention_fn`` so sequence-parallel ring attention
+(``horovod_tpu.parallel.ring_attention``) can drop in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BertConfig", "BertEncoder", "BertForPretraining"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny() -> "BertConfig":
+        """CI-sized config for tests and dry runs."""
+        return BertConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                          num_heads=4, intermediate_size=128, max_position=128)
+
+
+def dot_product_attention(q, k, v, mask=None):
+    """Default attention: softmax(QK^T/sqrt(d))V in fp32 logits."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+    attention_fn: Callable = staticmethod(dot_product_attention)
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = False):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        # Fused QKV: one [H, 3H] matmul.
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype, name="qkv")(x)
+        qkv = qkv.reshape(x.shape[0], x.shape[1], 3, cfg.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = self.attention_fn(q, k, v, mask)
+        out = out.reshape(x.shape[0], x.shape[1], cfg.hidden_size)
+        out = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="proj")(out)
+        out = nn.Dropout(cfg.dropout_rate, deterministic=not train)(out)
+        return out
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+    attention_fn: Callable = staticmethod(dot_product_attention)
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = False):
+        cfg = self.config
+        y = SelfAttention(cfg, attention_fn=self.attention_fn,
+                          name="attention")(x, mask, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + y).astype(cfg.dtype)
+        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+        y = nn.Dropout(cfg.dropout_rate, deterministic=not train)(y)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y).astype(cfg.dtype)
+        return x
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+    attention_fn: Callable = staticmethod(dot_product_attention)
+
+    def setup(self):
+        cfg = self.config
+        self.tok_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                                dtype=cfg.dtype)
+        self.pos_emb = nn.Embed(cfg.max_position, cfg.hidden_size,
+                                dtype=cfg.dtype)
+        self.type_emb = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                                 dtype=cfg.dtype)
+        self.ln_emb = nn.LayerNorm(dtype=jnp.float32)
+        self.layers = [
+            BertLayer(cfg, attention_fn=self.attention_fn, name=f"layer_{i}")
+            for i in range(cfg.num_layers)
+        ]
+
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 *, train: bool = False):
+        cfg = self.config
+        S = input_ids.shape[1]
+        x = self.tok_emb(input_ids) + self.pos_emb(jnp.arange(S)[None, :])
+        if token_type_ids is not None:
+            x = x + self.type_emb(token_type_ids)
+        x = self.ln_emb(x).astype(cfg.dtype)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for layer in self.layers:
+            x = layer(x, mask, train=train)
+        return x
+
+    def attend(self, h):
+        """Project hidden states onto the (tied) token-embedding table."""
+        return self.tok_emb.attend(h.astype(self.config.dtype))
+
+
+class BertForPretraining(nn.Module):
+    """Encoder + MLM head (output projection weight-tied to the token
+    embedding, standard BERT pretraining) + NSP head."""
+
+    config: BertConfig
+    attention_fn: Callable = staticmethod(dot_product_attention)
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 *, train: bool = False):
+        cfg = self.config
+        enc = BertEncoder(cfg, attention_fn=self.attention_fn, name="encoder")
+        x = enc(input_ids, token_type_ids, attention_mask, train=train)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(h).astype(cfg.dtype)
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,))
+        mlm_logits = enc.attend(h).astype(jnp.float32) + mlm_bias
+        nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp")(x[:, 0])
+        return mlm_logits, nsp_logits

@@ -1,0 +1,60 @@
+"""MNIST models.
+
+Capability parity with the reference's MNIST workloads
+(``examples/tensorflow_mnist.py:39-60`` conv net, ``examples/keras_mnist.py``
+and ``examples/pytorch_mnist.py:63-78``): a small convnet (conv-pool ×2 →
+dense) and an MLP, used by the example scripts and the end-to-end tests.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bf16 compute with
+fp32 params, feature counts kept multiples of 8 so the VPU/MXU tile cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistConvNet(nn.Module):
+    """Conv(32) → pool → Conv(64) → pool → Dense(512) → Dense(10).
+
+    Same topology family as the reference conv nets
+    (examples/tensorflow_mnist.py:39-60, examples/pytorch_mnist.py:63-78).
+    """
+
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        # x: [B, 28, 28, 1] float in [0, 1]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+class MnistMLP(nn.Module):
+    """Dense(128) → Dense(10), the keras_mnist-style small model."""
+
+    num_classes: int = 10
+    hidden: int = 128
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

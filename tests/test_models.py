@@ -1,0 +1,99 @@
+"""Model zoo shape/forward tests (CPU, tiny configs)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu.models import (
+    BertConfig,
+    BertForPretraining,
+    LlamaConfig,
+    LlamaModel,
+    MnistConvNet,
+    MnistMLP,
+    ResNet18,
+    ResNet50,
+    SkipGramModel,
+    nce_loss,
+)
+
+
+def test_mnist_convnet_forward():
+    model = MnistConvNet(dtype=jnp.float32)
+    x = jnp.zeros((4, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (4, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_mnist_mlp_forward():
+    model = MnistMLP(dtype=jnp.float32)
+    x = jnp.zeros((4, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)
+    assert model.apply(params, x).shape == (4, 10)
+
+
+@pytest.mark.parametrize("factory,n_params_expected", [
+    (ResNet50, 25_557_032),   # the canonical ResNet-50 parameter count
+])
+def test_resnet50_param_count(factory, n_params_expected):
+    model = factory(dtype=jnp.float32)
+    x = jnp.zeros((1, 224, 224, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    n = sum(p.size for p in jax.tree.leaves(variables["params"]))
+    assert n == n_params_expected
+
+
+def test_resnet18_forward_small():
+    model = ResNet18(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    out, updates = model.apply(
+        variables, x, train=True, mutable=["batch_stats"]
+    )
+    assert out.shape == (2, 10)
+    assert "batch_stats" in updates
+
+
+def test_bert_tiny_forward():
+    cfg = BertConfig.tiny()
+    model = BertForPretraining(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), ids)
+    mlm, nsp = model.apply(params, ids)
+    assert mlm.shape == (2, 16, cfg.vocab_size)
+    assert nsp.shape == (2, 2)
+
+
+def test_llama_tiny_forward_and_causality():
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    params = model.init(jax.random.key(0), ids)
+    logits = model.apply(params, ids)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    # Causality: changing a future token must not affect earlier logits.
+    ids2 = ids.at[:, 10].set((ids[:, 10] + 1) % cfg.vocab_size)
+    logits2 = model.apply(params, ids2)
+    assert jnp.allclose(logits[:, :10], logits2[:, :10], atol=1e-5)
+    assert not jnp.allclose(logits[:, 10:], logits2[:, 10:], atol=1e-5)
+
+
+def test_llama_moe_forward():
+    cfg = LlamaConfig.tiny(num_experts=4)
+    model = LlamaModel(cfg)
+    ids = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.key(0), ids)
+    assert model.apply(params, ids).shape == (2, 8, cfg.vocab_size)
+
+
+def test_word2vec_nce_loss():
+    model = SkipGramModel(vocab_size=100, embedding_size=16)
+    center = jnp.array([1, 2, 3])
+    labels = jnp.array([4, 5, 6])
+    negatives = jnp.array([[7, 8], [9, 10], [11, 12]])
+    params = model.init(jax.random.key(0), center)
+    loss = nce_loss(model, params, center, labels, negatives)
+    assert loss.shape == ()
+    assert jnp.isfinite(loss)

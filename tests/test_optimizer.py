@@ -145,3 +145,51 @@ def test_training_converges(n_devices):
     for _ in range(60):
         params, opt_state, loss = step(params, opt_state, (x, y))
     assert float(loss) < 1e-2, float(loss)
+
+
+def test_make_train_step_binds_mesh_axes(n_devices):
+    """Regression: a user DistributedOptimizer with axis_name=None must
+    reduce over the step mesh's axes (data AND fsdp), not the default mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu.jax as hvd
+
+    mesh = hvd.build_mesh({"data": 4, "fsdp": 2})
+    params = {"w": jnp.zeros((4,))}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((16, 4), dtype=np.float32))
+    Y = jnp.asarray(rng.standard_normal(16, dtype=np.float32))
+
+    opt = optax.sgd(0.1)
+    step_plain = hvd.make_train_step(loss_fn, opt, mesh, donate=False)
+    step_dist = hvd.make_train_step(
+        loss_fn, hvd.DistributedOptimizer(opt), mesh, donate=False
+    )
+    p1, _, _ = step_plain(params, opt.init(params), (X, Y))
+    p2, _, _ = step_dist(params, opt.init(params), (X, Y))
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=1e-5)
+
+
+def test_init_identity_validation():
+    import pytest
+
+    from horovod_tpu.common.basics import HorovodBasics
+
+    b = HorovodBasics()
+    with pytest.raises(ValueError, match="rank"):
+        b.init(rank=3, size=1)
+    b2 = HorovodBasics()
+    with pytest.raises(ValueError, match="half-specified"):
+        b2.init(rank=2)
+    b3 = HorovodBasics()
+    with pytest.raises(ValueError, match="local"):
+        b3.init(rank=0, size=2, local_rank=1, local_size=1)
