@@ -57,21 +57,12 @@ def main() -> None:
     params, batch_stats = variables["params"], variables["batch_stats"]
 
     # Reference recipe: momentum SGD, LR scaled by world size
-    # (examples/pytorch_synthetic_benchmark.py:57-62, keras LR×size).
-    opt = optax.sgd(0.01 * n_chips, momentum=0.9)
-    opt_state = jax.jit(opt.init)(params)
+    # (examples/pytorch_synthetic_benchmark.py:57-62, keras LR×size);
+    # gradients averaged by the framework's DistributedOptimizer.
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01 * n_chips, momentum=0.9))
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    data_sharding = NamedSharding(mesh, P("data"))
-    repl = NamedSharding(mesh, P())
-    images = jax.device_put(images, data_sharding)
-    labels = jax.device_put(labels, data_sharding)
-    params = jax.device_put(params, repl)
-    batch_stats = jax.device_put(batch_stats, repl)
-    opt_state = jax.device_put(opt_state, repl)
-
-    def loss_fn(params, batch_stats, images, labels):
+    def loss_fn(params, batch_stats, batch):
+        images, labels = batch
         logits, updates = model.apply(
             {"params": params, "batch_stats": batch_stats},
             images, train=True, mutable=["batch_stats"],
@@ -80,18 +71,12 @@ def main() -> None:
         loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
         return loss, updates["batch_stats"]
 
-    @jax.jit
-    def train_step(params, batch_stats, opt_state, images, labels):
-        (loss, batch_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(params, batch_stats, images, labels)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, batch_stats, opt_state, loss
+    train_step = hvd.make_train_step(loss_fn, opt, mesh, has_aux=True)
+    opt_state = jax.jit(opt.inner.init)(params)
 
-    state = (params, batch_stats, opt_state)
+    state = (params, opt_state, batch_stats)
     for _ in range(warmup):
-        *state, loss = train_step(*state, images, labels)
+        *state, loss = train_step(*state, (images, labels))
     # Sync via host fetch: the final loss depends on the whole step chain.
     # (block_until_ready alone has proven unreliable over remote-device
     # tunnels, returning before execution finishes.)
@@ -99,7 +84,7 @@ def main() -> None:
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        *state, loss = train_step(*state, images, labels)
+        *state, loss = train_step(*state, (images, labels))
     float(loss)
     dt = time.perf_counter() - t0
 

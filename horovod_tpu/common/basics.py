@@ -166,8 +166,13 @@ class HorovodBasics:
                     addr.encode(),
                 )
                 if ret != 0:
+                    try:
+                        detail = self._lib.horovod_last_error().decode()
+                    except Exception:
+                        detail = ""
                     raise RuntimeError(
                         f"native horovod_init failed with code {ret}"
+                        + (f": {detail}" if detail else "")
                     )
             self._initialized = True
             if not self._atexit_registered:
@@ -249,6 +254,9 @@ class HorovodBasics:
         lib.horovod_init.restype = ctypes.c_int
         lib.horovod_shutdown.argtypes = []
         lib.horovod_shutdown.restype = None
+        if hasattr(lib, "horovod_last_error"):
+            lib.horovod_last_error.argtypes = []
+            lib.horovod_last_error.restype = ctypes.c_char_p
         self._lib = lib
 
     @property

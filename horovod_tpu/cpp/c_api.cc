@@ -1,0 +1,81 @@
+// C ABI for the native engine, loaded from Python via ctypes.
+//
+// Surface parity with the reference C API (horovod/common/operations.h:
+// 68-118: horovod_init/_shutdown/_rank/_size/_local_rank/_local_size/
+// _mpi_threads_supported + EnqueueTensor*), reshaped for ctypes: instead of
+// C++ callbacks, enqueue returns an int64 handle polled/waited on from
+// Python (the pattern of the reference torch handle manager,
+// horovod/torch/handle_manager.{h,cc}).
+#include <cstring>
+
+#include "engine.h"
+
+using hvd::DataType;
+using hvd::Engine;
+using hvd::RequestType;
+
+extern "C" {
+
+int horovod_init(int rank, int size, int local_rank, int local_size,
+                 const char* coordinator_addr) {
+  return Engine::Get().Init(rank, size, local_rank, local_size,
+                            coordinator_addr ? coordinator_addr : "");
+}
+
+void horovod_shutdown() { Engine::Get().Shutdown(); }
+
+int horovod_is_initialized() {
+  return Engine::Get().initialized() ? 1 : 0;
+}
+
+int horovod_rank() { return Engine::Get().rank(); }
+int horovod_size() { return Engine::Get().size(); }
+int horovod_local_rank() { return Engine::Get().local_rank(); }
+int horovod_local_size() { return Engine::Get().local_size(); }
+
+// No MPI anywhere; the engine's own threading is unconditional.
+int horovod_mpi_threads_supported() { return 1; }
+
+const char* horovod_last_error() {
+  return Engine::Get().last_error().c_str();
+}
+
+// op: 0 = allreduce, 1 = allgather, 2 = broadcast (RequestType values).
+// Returns handle >= 0, -1 on duplicate in-flight name, -2 if not running.
+int64_t horovod_enqueue(int op, const char* name, int dtype, int ndim,
+                        const int64_t* shape, void* data, int root_rank) {
+  std::vector<int64_t> dims(shape, shape + ndim);
+  return Engine::Get().Enqueue(static_cast<RequestType>(op), name,
+                               static_cast<DataType>(dtype), dims, data,
+                               root_rank);
+}
+
+int horovod_poll(int64_t handle) { return Engine::Get().Poll(handle); }
+int horovod_wait(int64_t handle) { return Engine::Get().Wait(handle); }
+
+// Copies the handle's error message into buf (truncated to buflen-1).
+void horovod_error_message(int64_t handle, char* buf, int buflen) {
+  std::string msg = Engine::Get().ErrorMessage(handle);
+  if (buflen <= 0) return;
+  size_t n = std::min(msg.size(), static_cast<size_t>(buflen - 1));
+  memcpy(buf, msg.data(), n);
+  buf[n] = '\0';
+}
+
+int64_t horovod_result_ndim(int64_t handle) {
+  return Engine::Get().ResultNumDims(handle);
+}
+int64_t horovod_result_dim(int64_t handle, int i) {
+  return Engine::Get().ResultDim(handle, i);
+}
+int64_t horovod_result_bytes(int64_t handle) {
+  return Engine::Get().ResultByteSize(handle);
+}
+int horovod_copy_result(int64_t handle, void* dst, int64_t nbytes) {
+  return Engine::Get().CopyResult(handle, dst, nbytes);
+}
+void horovod_release_handle(int64_t handle) {
+  Engine::Get().ReleaseHandle(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,984 @@
+#include "engine.h"
+
+#include <algorithm>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <sstream>
+
+namespace hvd {
+
+// ---------------------------------------------------------------------------
+// Reduction kernels
+// ---------------------------------------------------------------------------
+
+// IEEE half <-> float, scalar bit twiddling (no F16C dependency; the
+// compiler auto-vectorizes the loops below well enough for a host-side
+// control-plane data path).
+static inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (man == 0) {
+      f = sign;
+    } else {
+      exp = 127 - 15 + 1;
+      while ((man & 0x400u) == 0) {
+        man <<= 1;
+        exp--;
+      }
+      man &= 0x3ffu;
+      f = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000u | (man << 13);
+  } else {
+    f = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+static inline uint16_t FloatToHalf(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((f >> 23) & 0xff) - 127 + 15;
+  uint32_t man = f & 0x7fffffu;
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    man |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half_man = man >> shift;
+    uint32_t round = (man >> (shift - 1)) & 1u;
+    return static_cast<uint16_t>(sign | (half_man + round));
+  }
+  if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);
+  uint32_t half = sign | (static_cast<uint32_t>(exp) << 10) | (man >> 13);
+  if (man & 0x1000u) half += 1;  // round-to-nearest
+  return static_cast<uint16_t>(half);
+}
+
+// bfloat16 is float32's top 16 bits — the TPU-native conversion is two
+// shifts (with round-to-nearest-even on the way down).
+static inline float BF16ToFloat(uint16_t h) {
+  uint32_t f = static_cast<uint32_t>(h) << 16;
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+static inline uint16_t FloatToBF16(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t rounding = 0x7fffu + ((f >> 16) & 1u);
+  return static_cast<uint16_t>((f + rounding) >> 16);
+}
+
+template <typename T>
+static void SumLoop(void* dst, const void* src, int64_t n) {
+  T* d = static_cast<T*>(dst);
+  const T* s = static_cast<const T*>(src);
+  for (int64_t i = 0; i < n; ++i) d[i] += s[i];
+}
+
+void ReduceSumInto(void* dst, const void* src, int64_t count, DataType dtype) {
+  switch (dtype) {
+    case DataType::FLOAT32: SumLoop<float>(dst, src, count); return;
+    case DataType::FLOAT64: SumLoop<double>(dst, src, count); return;
+    case DataType::INT32: SumLoop<int32_t>(dst, src, count); return;
+    case DataType::INT64: SumLoop<int64_t>(dst, src, count); return;
+    case DataType::UINT8: SumLoop<uint8_t>(dst, src, count); return;
+    case DataType::INT8: SumLoop<int8_t>(dst, src, count); return;
+    case DataType::UINT16: SumLoop<uint16_t>(dst, src, count); return;
+    case DataType::INT16: SumLoop<int16_t>(dst, src, count); return;
+    case DataType::FLOAT16: {
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; ++i) {
+        d[i] = FloatToHalf(HalfToFloat(d[i]) + HalfToFloat(s[i]));
+      }
+      return;
+    }
+    case DataType::BFLOAT16: {
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; ++i) {
+        d[i] = FloatToBF16(BF16ToFloat(d[i]) + BF16ToFloat(s[i]));
+      }
+      return;
+    }
+    case DataType::BOOL: {
+      uint8_t* d = static_cast<uint8_t*>(dst);
+      const uint8_t* s = static_cast<const uint8_t*>(src);
+      for (int64_t i = 0; i < count; ++i) d[i] = d[i] || s[i];
+      return;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Engine lifecycle
+// ---------------------------------------------------------------------------
+
+Engine& Engine::Get() {
+  static Engine* engine = new Engine();
+  return *engine;
+}
+
+static int64_t EnvInt64(const char* name, int64_t dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || v[0] == '\0') return dflt;
+  return std::strtoll(v, nullptr, 10);
+}
+
+int Engine::Init(int rank, int size, int local_rank, int local_size,
+                 const std::string& coordinator_addr) {
+  if (initialized_.load()) return 0;
+  rank_ = rank;
+  size_ = size;
+  local_rank_ = local_rank;
+  local_size_ = local_size;
+  shut_down_.store(false);
+  shutdown_requested_.store(false);
+
+  // Knobs (reference operations.cc:1556-1618).
+  cycle_time_ms_ = static_cast<int>(EnvInt64("HOROVOD_CYCLE_TIME", 5));
+  if (cycle_time_ms_ < 1) cycle_time_ms_ = 1;
+  fusion_threshold_ = EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  stall_check_disabled_ = EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) != 0;
+  stall_warning_sec_ =
+      static_cast<int>(EnvInt64("HOROVOD_STALL_WARNING_SEC", 60));
+  const char* timeline_path = std::getenv("HOROVOD_TIMELINE");
+  if (timeline_path != nullptr && timeline_path[0] != '\0' && rank_ == 0) {
+    timeline_.Initialize(timeline_path);
+  }
+
+  if (size_ > 1) {
+    std::string host = "127.0.0.1";
+    int port = 0;
+    auto colon = coordinator_addr.rfind(':');
+    if (colon != std::string::npos) {
+      host = coordinator_addr.substr(0, colon);
+      port = std::atoi(coordinator_addr.c_str() + colon + 1);
+    }
+    if (port == 0) {
+      last_error_ = "coordinator address host:port required for size > 1";
+      return 1;
+    }
+    std::string err;
+    const char* my_host_env = std::getenv("HOROVOD_HOST");
+    std::string my_host = my_host_env ? my_host_env : "127.0.0.1";
+
+    // Every rank opens an ephemeral data listener for ring neighbors.
+    int data_port = 0;
+    data_listener_ = Listen("0.0.0.0", 0, 4, &data_port, &err);
+    if (!data_listener_.valid()) {
+      last_error_ = "data listener: " + err;
+      return 1;
+    }
+
+    // Rendezvous: workers report (rank, host, data_port) to the
+    // coordinator, which broadcasts the full peer table — the moral
+    // equivalent of MPI_Init's wire-up or NCCL's ncclUniqueId broadcast
+    // (reference operations.cc:894-931).
+    std::vector<std::string> peer_hosts(size_);
+    std::vector<int> peer_ports(size_, 0);
+    if (rank_ == 0) {
+      control_listener_ = Listen(host, port, size_ + 8, nullptr, &err);
+      if (!control_listener_.valid()) {
+        last_error_ = "coordinator listen on " + coordinator_addr + ": " + err;
+        return 1;
+      }
+      peer_hosts[0] = my_host;
+      peer_ports[0] = data_port;
+      worker_conns_.clear();
+      worker_conns_.resize(size_);
+      for (int i = 1; i < size_; ++i) {
+        Socket conn = Accept(control_listener_, &err);
+        if (!conn.valid()) {
+          last_error_ = "accept: " + err;
+          return 1;
+        }
+        std::vector<uint8_t> frame;
+        if (!conn.RecvFrame(&frame)) {
+          last_error_ = "rendezvous recv failed";
+          return 1;
+        }
+        Reader r(frame.data(), frame.size());
+        int32_t peer_rank = r.i32();
+        std::string peer_host = r.str();
+        int32_t peer_port = r.i32();
+        if (!r.ok() || peer_rank < 1 || peer_rank >= size_) {
+          last_error_ = "bad rendezvous frame";
+          return 1;
+        }
+        peer_hosts[peer_rank] = peer_host;
+        peer_ports[peer_rank] = peer_port;
+        worker_conns_[peer_rank] = std::move(conn);
+      }
+      Writer w;
+      for (int i = 0; i < size_; ++i) {
+        w.str(peer_hosts[i]);
+        w.i32(peer_ports[i]);
+      }
+      for (int i = 1; i < size_; ++i) {
+        if (!worker_conns_[i].SendFrame(w.bytes())) {
+          last_error_ = "rendezvous bcast failed";
+          return 1;
+        }
+      }
+    } else {
+      coordinator_conn_ = ConnectRetry(host, port, 60000, &err);
+      if (!coordinator_conn_.valid()) {
+        last_error_ = err;
+        return 1;
+      }
+      Writer w;
+      w.i32(rank_);
+      w.str(my_host);
+      w.i32(data_port);
+      if (!coordinator_conn_.SendFrame(w.bytes())) {
+        last_error_ = "rendezvous send failed";
+        return 1;
+      }
+      std::vector<uint8_t> frame;
+      if (!coordinator_conn_.RecvFrame(&frame)) {
+        last_error_ = "rendezvous table recv failed";
+        return 1;
+      }
+      Reader r(frame.data(), frame.size());
+      for (int i = 0; i < size_; ++i) {
+        peer_hosts[i] = r.str();
+        peer_ports[i] = r.i32();
+      }
+      if (!r.ok()) {
+        last_error_ = "bad rendezvous table";
+        return 1;
+      }
+    }
+
+    // Ring wiring: connect to (rank+1) % size, accept from (rank-1) % size.
+    // Connect cannot deadlock: every listener already exists, so the
+    // connect completes from the backlog even before the peer accepts.
+    int next = (rank_ + 1) % size_;
+    ring_next_ = ConnectRetry(peer_hosts[next], peer_ports[next], 60000, &err);
+    if (!ring_next_.valid()) {
+      last_error_ = "ring connect: " + err;
+      return 1;
+    }
+    int32_t my_rank32 = rank_;
+    if (!ring_next_.SendAll(&my_rank32, 4)) {
+      last_error_ = "ring handshake send failed";
+      return 1;
+    }
+    ring_prev_ = Accept(data_listener_, &err);
+    if (!ring_prev_.valid()) {
+      last_error_ = "ring accept: " + err;
+      return 1;
+    }
+    int32_t prev_rank32 = -1;
+    if (!ring_prev_.RecvAll(&prev_rank32, 4) ||
+        prev_rank32 != (rank_ - 1 + size_) % size_) {
+      last_error_ = "ring handshake mismatch";
+      return 1;
+    }
+  }
+
+  last_stall_check_ = std::chrono::steady_clock::now();
+  initialized_.store(true);
+  background_ = std::thread(&Engine::BackgroundLoop, this);
+  return 0;
+}
+
+void Engine::Shutdown() {
+  if (!initialized_.load() || shut_down_.load()) return;
+  shutdown_requested_.store(true);
+  if (background_.joinable()) background_.join();
+  initialized_.store(false);
+}
+
+// ---------------------------------------------------------------------------
+// Background negotiation loop
+// ---------------------------------------------------------------------------
+
+void Engine::BackgroundLoop() {
+  while (RunLoopOnce()) {
+  }
+  // Fail anything still in flight (reference SHUT_DOWN_ERROR,
+  // operations.cc:1647-1662).
+  std::vector<TensorTableEntry> leftovers;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : tensor_table_) leftovers.push_back(std::move(kv.second));
+    tensor_table_.clear();
+    message_queue_.clear();
+  }
+  for (auto& e : leftovers) {
+    FinishEntry(e, Status::Aborted(
+        "Horovod has been shut down. This was caused by an exception on one "
+        "of the ranks or an attempt to enqueue after shutdown."));
+  }
+  shut_down_.store(true);
+}
+
+bool Engine::RunLoopOnce() {
+  std::this_thread::sleep_for(std::chrono::milliseconds(cycle_time_ms_));
+
+  RequestList my_list;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    while (!message_queue_.empty()) {
+      my_list.requests.push_back(std::move(message_queue_.front()));
+      message_queue_.pop_front();
+    }
+  }
+  my_list.shutdown = shutdown_requested_.load();
+
+  if (size_ == 1) {
+    // Single process: every tensor is instantly "globally ready".
+    for (auto& q : my_list.requests) {
+      timeline_.NegotiateStart(q.tensor_name);
+      timeline_.NegotiateRankReady(q.tensor_name, 0);
+      std::lock_guard<std::mutex> lk(mu_);
+      auto& info = message_table_[q.tensor_name];
+      info.requests.assign(1, q);
+      info.seen.assign(1, true);
+      info.count = 1;
+    }
+    std::vector<Response> responses;
+    for (auto& q : my_list.requests) {
+      timeline_.NegotiateEnd(q.tensor_name);
+      responses.push_back(BuildResponse(q.tensor_name));
+    }
+    FuseResponses(responses);
+    for (auto& resp : responses) PerformResponse(resp);
+    return !my_list.shutdown;
+  }
+
+  if (rank_ == 0) {
+    std::vector<RequestList> lists(size_);
+    lists[0] = std::move(my_list);
+    for (int r = 1; r < size_; ++r) {
+      std::vector<uint8_t> frame;
+      if (!worker_conns_[r].RecvFrame(&frame)) {
+        std::fprintf(stderr,
+                     "horovod_tpu coordinator: lost connection to rank %d\n",
+                     r);
+        return false;
+      }
+      Reader reader(frame.data(), frame.size());
+      if (!ParseRequestList(&reader, &lists[r])) {
+        std::fprintf(stderr, "horovod_tpu coordinator: bad frame from %d\n",
+                     r);
+        return false;
+      }
+    }
+    ResponseList response_list = CoordinatorStep(lists);
+    Writer w;
+    SerializeResponseList(response_list, &w);
+    for (int r = 1; r < size_; ++r) {
+      if (!worker_conns_[r].SendFrame(w.bytes())) {
+        std::fprintf(stderr,
+                     "horovod_tpu coordinator: send to rank %d failed\n", r);
+        return false;
+      }
+    }
+    for (auto& resp : response_list.responses) PerformResponse(resp);
+    if (!stall_check_disabled_) CheckForStalledTensors();
+    return !response_list.shutdown;
+  }
+
+  // Worker: ship requests up, execute the agreed response list.
+  Writer w;
+  SerializeRequestList(my_list, &w);
+  if (!coordinator_conn_.SendFrame(w.bytes())) {
+    std::fprintf(stderr, "horovod_tpu rank %d: coordinator send failed\n",
+                 rank_);
+    return false;
+  }
+  std::vector<uint8_t> frame;
+  if (!coordinator_conn_.RecvFrame(&frame)) {
+    std::fprintf(stderr, "horovod_tpu rank %d: coordinator recv failed\n",
+                 rank_);
+    return false;
+  }
+  Reader reader(frame.data(), frame.size());
+  ResponseList response_list;
+  if (!ParseResponseList(&reader, &response_list)) {
+    std::fprintf(stderr, "horovod_tpu rank %d: bad response frame\n", rank_);
+    return false;
+  }
+  for (auto& resp : response_list.responses) PerformResponse(resp);
+  return !response_list.shutdown;
+}
+
+// Readiness counting + response construction + fusion, on the coordinator.
+// Reference: IncrementTensorCount (operations.cc:282-307) +
+// ConstructMPIResponse (315-517) + fusion (1815-1842).
+ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
+  ResponseList out;
+  std::vector<std::string> became_ready;
+  for (int r = 0; r < size_; ++r) {
+    if (lists[r].shutdown) out.shutdown = true;
+    for (auto& q : lists[r].requests) {
+      auto it = message_table_.find(q.tensor_name);
+      if (it == message_table_.end()) {
+        timeline_.NegotiateStart(q.tensor_name);
+        PendingInfo info;
+        info.requests.resize(size_);
+        info.seen.assign(size_, false);
+        info.first_seen = std::chrono::steady_clock::now();
+        it = message_table_.emplace(q.tensor_name, std::move(info)).first;
+      }
+      PendingInfo& info = it->second;
+      if (!info.seen[r]) {
+        info.seen[r] = true;
+        info.requests[r] = q;
+        info.count++;
+        timeline_.NegotiateRankReady(q.tensor_name, r);
+      }
+      if (info.count == size_) {
+        became_ready.push_back(q.tensor_name);
+      }
+    }
+  }
+  for (auto& name : became_ready) {
+    timeline_.NegotiateEnd(name);
+    out.responses.push_back(BuildResponse(name));
+  }
+  FuseResponses(out.responses);
+  return out;
+}
+
+// Cross-rank validation: dtype / op / shape / root consistency.  Mismatch
+// yields an ERROR response delivered to every rank instead of undefined
+// collective behavior — the reference's most important failure-containment
+// feature (operations.cc:315-517).
+Response Engine::BuildResponse(const std::string& name) {
+  PendingInfo info;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = message_table_.find(name);
+    info = std::move(it->second);
+    message_table_.erase(it);
+  }
+  const Request& first = info.requests[0];
+  Response resp;
+  resp.tensor_names.push_back(name);
+  std::ostringstream err;
+
+  for (int r = 1; r < size_; ++r) {
+    const Request& q = info.requests[r];
+    if (q.type != first.type) {
+      err << "Mismatched collective operations: rank 0 requested "
+          << RequestTypeName(first.type) << " but rank " << r << " requested "
+          << RequestTypeName(q.type) << " for tensor " << name << ".";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+    if (q.dtype != first.dtype) {
+      err << "Mismatched data types: rank 0 has " << DataTypeName(first.dtype)
+          << " but rank " << r << " has " << DataTypeName(q.dtype)
+          << " for tensor " << name << ".";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+  }
+
+  if (first.type == RequestType::ALLREDUCE ||
+      first.type == RequestType::BROADCAST) {
+    for (int r = 1; r < size_; ++r) {
+      if (info.requests[r].shape != first.shape) {
+        TensorShape s0, sr;
+        for (auto d : first.shape) s0.AddDim(d);
+        for (auto d : info.requests[r].shape) sr.AddDim(d);
+        err << "Mismatched " << RequestTypeName(first.type)
+            << " tensor shapes: rank 0 has shape " << s0.DebugString()
+            << " but rank " << r << " has shape " << sr.DebugString()
+            << " for tensor " << name << ".";
+        resp.type = ResponseType::ERROR;
+        resp.error_message = err.str();
+        return resp;
+      }
+    }
+  }
+  if (first.type == RequestType::BROADCAST) {
+    for (int r = 1; r < size_; ++r) {
+      if (info.requests[r].root_rank != first.root_rank) {
+        err << "Mismatched broadcast root ranks: rank 0 has root "
+            << first.root_rank << " but rank " << r << " has root "
+            << info.requests[r].root_rank << " for tensor " << name << ".";
+        resp.type = ResponseType::ERROR;
+        resp.error_message = err.str();
+        return resp;
+      }
+    }
+    resp.type = ResponseType::BROADCAST;
+    resp.root_rank = first.root_rank;
+    return resp;
+  }
+  if (first.type == RequestType::ALLGATHER) {
+    // dim0 may differ per rank (the negotiated dynamic shape); the rest
+    // must match.  tensor_sizes carries every rank's dim0.
+    for (int r = 1; r < size_; ++r) {
+      const auto& s = info.requests[r].shape;
+      bool ok = s.size() == first.shape.size() && !s.empty();
+      for (size_t d = 1; ok && d < s.size(); ++d) {
+        ok = s[d] == first.shape[d];
+      }
+      if (first.shape.empty() || !ok) {
+        err << "Mismatched allgather tensor shapes: all dimensions except "
+               "the first must match across ranks for tensor "
+            << name << ".";
+        resp.type = ResponseType::ERROR;
+        resp.error_message = err.str();
+        return resp;
+      }
+    }
+    resp.type = ResponseType::ALLGATHER;
+    for (int r = 0; r < size_; ++r) {
+      resp.tensor_sizes.push_back(info.requests[r].shape[0]);
+    }
+    return resp;
+  }
+  resp.type = ResponseType::ALLREDUCE;
+  return resp;
+}
+
+// Consecutive same-dtype allreduces merge into one response executed as a
+// single ring collective over the fusion buffer.
+void Engine::FuseResponses(std::vector<Response>& responses) {
+  if (fusion_threshold_ <= 0) return;
+  auto entry_bytes = [this](const std::string& name) -> int64_t {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tensor_table_.find(name);
+    if (it == tensor_table_.end()) return 0;
+    return it->second.shape.num_elements() *
+           static_cast<int64_t>(DataTypeSize(it->second.dtype));
+  };
+  auto entry_dtype = [this](const std::string& name) -> DataType {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tensor_table_.find(name);
+    if (it == tensor_table_.end()) return DataType::FLOAT32;
+    return it->second.dtype;
+  };
+  std::vector<Response> fused;
+  for (auto& resp : responses) {
+    if (resp.type == ResponseType::ALLREDUCE && !fused.empty() &&
+        fused.back().type == ResponseType::ALLREDUCE &&
+        entry_dtype(fused.back().tensor_names[0]) ==
+            entry_dtype(resp.tensor_names[0])) {
+      int64_t total = 0;
+      for (auto& n : fused.back().tensor_names) total += entry_bytes(n);
+      if (total + entry_bytes(resp.tensor_names[0]) <= fusion_threshold_) {
+        fused.back().tensor_names.push_back(resp.tensor_names[0]);
+        continue;
+      }
+    }
+    fused.push_back(std::move(resp));
+  }
+  responses = std::move(fused);
+}
+
+// ---------------------------------------------------------------------------
+// Execution (the host data plane)
+// ---------------------------------------------------------------------------
+
+void Engine::PerformResponse(const Response& response) {
+  std::vector<TensorTableEntry> entries;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& name : response.tensor_names) {
+      auto it = tensor_table_.find(name);
+      if (it != tensor_table_.end()) {
+        entries.push_back(std::move(it->second));
+        tensor_table_.erase(it);
+      }
+    }
+  }
+  if (response.type == ResponseType::ERROR) {
+    for (auto& e : entries) {
+      FinishEntry(e, Status::PreconditionError(response.error_message));
+    }
+    return;
+  }
+  if (entries.empty()) return;
+  switch (response.type) {
+    case ResponseType::ALLREDUCE:
+      ExecAllreduce(response, entries);
+      break;
+    case ResponseType::ALLGATHER:
+      ExecAllgather(response, entries);
+      break;
+    case ResponseType::BROADCAST:
+      ExecBroadcast(response, entries);
+      break;
+    default:
+      break;
+  }
+}
+
+// Bandwidth-optimal ring allreduce: reduce-scatter + allgather over the
+// neighbor sockets.  Send and recv run concurrently (sender thread) so the
+// ring never deadlocks on socket buffers.
+static bool RingAllreduce(void* data, int64_t count, DataType dtype,
+                          int rank, int size, Socket& next, Socket& prev,
+                          std::string* err) {
+  const size_t esize = DataTypeSize(dtype);
+  uint8_t* base = static_cast<uint8_t*>(data);
+  std::vector<int64_t> seg_count(size), seg_off(size);
+  int64_t off = 0;
+  for (int s = 0; s < size; ++s) {
+    seg_count[s] = count / size + (s < count % size ? 1 : 0);
+    seg_off[s] = off;
+    off += seg_count[s];
+  }
+  std::vector<uint8_t> tmp(static_cast<size_t>(seg_count[0]) * esize);
+
+  // Reduce-scatter: after step t, rank r owns the full sum of segment
+  // (r - t - 1) mod size's partials seen so far.
+  for (int step = 0; step < size - 1; ++step) {
+    int send_seg = (rank - step + size) % size;
+    int recv_seg = (rank - step - 1 + size) % size;
+    bool send_ok = true;
+    std::thread sender([&] {
+      send_ok = next.SendAll(base + seg_off[send_seg] * esize,
+                             static_cast<size_t>(seg_count[send_seg]) * esize);
+    });
+    bool recv_ok = prev.RecvAll(
+        tmp.data(), static_cast<size_t>(seg_count[recv_seg]) * esize);
+    sender.join();
+    if (!send_ok || !recv_ok) {
+      *err = "ring reduce-scatter transport failure";
+      return false;
+    }
+    ReduceSumInto(base + seg_off[recv_seg] * esize, tmp.data(),
+                  seg_count[recv_seg], dtype);
+  }
+  // Allgather: circulate the fully-reduced segments.
+  for (int step = 0; step < size - 1; ++step) {
+    int send_seg = (rank - step + 1 + size) % size;
+    int recv_seg = (rank - step + size) % size;
+    bool send_ok = true;
+    std::thread sender([&] {
+      send_ok = next.SendAll(base + seg_off[send_seg] * esize,
+                             static_cast<size_t>(seg_count[send_seg]) * esize);
+    });
+    bool recv_ok = prev.RecvAll(
+        base + seg_off[recv_seg] * esize,
+        static_cast<size_t>(seg_count[recv_seg]) * esize);
+    sender.join();
+    if (!send_ok || !recv_ok) {
+      *err = "ring allgather transport failure";
+      return false;
+    }
+  }
+  return true;
+}
+
+void Engine::ExecAllreduce(const Response& response,
+                           std::vector<TensorTableEntry>& entries) {
+  const std::string& tname = entries[0].name;
+  for (auto& e : entries) timeline_.Start(e.name);
+  DataType dtype = entries[0].dtype;
+  int64_t total = 0;
+  for (auto& e : entries) total += e.shape.num_elements();
+
+  if (size_ > 1) {
+    void* buf = entries[0].data;
+    const size_t esize = DataTypeSize(dtype);
+    if (entries.size() > 1) {
+      timeline_.ActivityStart(tname, "MEMCPY_IN_FUSION_BUFFER");
+      if (fusion_buffer_.size() < static_cast<size_t>(total) * esize) {
+        fusion_buffer_.resize(static_cast<size_t>(total) * esize);
+      }
+      int64_t off = 0;
+      for (auto& e : entries) {
+        size_t n = static_cast<size_t>(e.shape.num_elements()) * esize;
+        memcpy(fusion_buffer_.data() + off, e.data, n);
+        off += n;
+      }
+      buf = fusion_buffer_.data();
+      timeline_.ActivityEnd(tname);
+    }
+    timeline_.ActivityStart(tname, "RING_ALLREDUCE");
+    std::string err;
+    if (!RingAllreduce(buf, total, dtype, rank_, size_, ring_next_,
+                       ring_prev_, &err)) {
+      timeline_.ActivityEnd(tname);
+      for (auto& e : entries) FinishEntry(e, Status::Aborted(err));
+      return;
+    }
+    timeline_.ActivityEnd(tname);
+    if (entries.size() > 1) {
+      timeline_.ActivityStart(tname, "MEMCPY_OUT_FUSION_BUFFER");
+      int64_t off = 0;
+      for (auto& e : entries) {
+        size_t n = static_cast<size_t>(e.shape.num_elements()) * esize;
+        memcpy(e.data, fusion_buffer_.data() + off, n);
+        off += n;
+      }
+      timeline_.ActivityEnd(tname);
+    }
+  }
+  for (auto& e : entries) {
+    timeline_.End(e.name, e.dtype, e.shape.DebugString());
+    FinishEntry(e, Status::OK());
+  }
+}
+
+void Engine::ExecAllgather(const Response& response,
+                           std::vector<TensorTableEntry>& entries) {
+  // Allgather is never fused (matches the reference); one entry.
+  TensorTableEntry& e = entries[0];
+  timeline_.Start(e.name);
+  const size_t esize = DataTypeSize(e.dtype);
+  int64_t slice = 1;
+  for (int d = 1; d < e.shape.ndim(); ++d) slice *= e.shape.dim(d);
+
+  int64_t total_dim0 = 0;
+  for (auto v : response.tensor_sizes) total_dim0 += v;
+
+  auto hs = GetHandle(e.handle);
+  if (hs == nullptr) return;
+  hs->result.resize(static_cast<size_t>(total_dim0 * slice) * esize);
+  hs->result_shape.clear();
+  hs->result_shape.push_back(total_dim0);
+  for (int d = 1; d < e.shape.ndim(); ++d) {
+    hs->result_shape.push_back(e.shape.dim(d));
+  }
+
+  std::vector<int64_t> block_bytes(size_), block_off(size_);
+  int64_t off = 0;
+  for (int r = 0; r < size_; ++r) {
+    block_bytes[r] = response.tensor_sizes[r] * slice *
+                     static_cast<int64_t>(esize);
+    block_off[r] = off;
+    off += block_bytes[r];
+  }
+  memcpy(hs->result.data() + block_off[rank_], e.data,
+         static_cast<size_t>(block_bytes[rank_]));
+
+  if (size_ > 1) {
+    timeline_.ActivityStart(e.name, "RING_ALLGATHER");
+    // Circulate blocks around the ring; after size-1 steps everyone has all.
+    bool failed = false;
+    for (int step = 0; step < size_ - 1 && !failed; ++step) {
+      int send_block = (rank_ - step + size_) % size_;
+      int recv_block = (rank_ - step - 1 + size_) % size_;
+      bool send_ok = true;
+      std::thread sender([&] {
+        send_ok = ring_next_.SendAll(
+            hs->result.data() + block_off[send_block],
+            static_cast<size_t>(block_bytes[send_block]));
+      });
+      bool recv_ok = ring_prev_.RecvAll(
+          hs->result.data() + block_off[recv_block],
+          static_cast<size_t>(block_bytes[recv_block]));
+      sender.join();
+      failed = !send_ok || !recv_ok;
+    }
+    timeline_.ActivityEnd(e.name);
+    if (failed) {
+      FinishEntry(e, Status::Aborted("ring allgather transport failure"));
+      return;
+    }
+  }
+  timeline_.End(e.name, e.dtype, e.shape.DebugString());
+  FinishEntry(e, Status::OK());
+}
+
+void Engine::ExecBroadcast(const Response& response,
+                           std::vector<TensorTableEntry>& entries) {
+  TensorTableEntry& e = entries[0];
+  timeline_.Start(e.name);
+  if (size_ > 1) {
+    timeline_.ActivityStart(e.name, "RING_BROADCAST");
+    size_t nbytes = static_cast<size_t>(e.shape.num_elements()) *
+                    DataTypeSize(e.dtype);
+    int root = response.root_rank;
+    bool ok = true;
+    // Pipeline root → root+1 → ... → root-1 along the ring.
+    if (rank_ == root) {
+      if (size_ > 1) ok = ring_next_.SendAll(e.data, nbytes);
+    } else {
+      ok = ring_prev_.RecvAll(e.data, nbytes);
+      int next = (rank_ + 1) % size_;
+      if (ok && next != root) ok = ring_next_.SendAll(e.data, nbytes);
+    }
+    timeline_.ActivityEnd(e.name);
+    if (!ok) {
+      FinishEntry(e, Status::Aborted("ring broadcast transport failure"));
+      return;
+    }
+  }
+  timeline_.End(e.name, e.dtype, e.shape.DebugString());
+  FinishEntry(e, Status::OK());
+}
+
+void Engine::FinishEntry(TensorTableEntry& e, const Status& s) {
+  auto hs = GetHandle(e.handle);
+  if (hs == nullptr) return;
+  {
+    std::lock_guard<std::mutex> lk(handle_mu_);
+    hs->error = s.reason();
+    hs->done.store(s.ok() ? 1 : -1);
+  }
+  handle_cv_.notify_all();
+}
+
+// Rank-0-only stall warnings naming the missing ranks (reference
+// CheckForStalledTensors, operations.cc:1366-1412).
+void Engine::CheckForStalledTensors() {
+  auto now = std::chrono::steady_clock::now();
+  if (now - last_stall_check_ < std::chrono::seconds(stall_warning_sec_)) {
+    return;
+  }
+  last_stall_check_ = now;
+  std::lock_guard<std::mutex> lk(mu_);
+  bool preamble = false;
+  for (auto& kv : message_table_) {
+    auto age = std::chrono::duration_cast<std::chrono::seconds>(
+                   now - kv.second.first_seen)
+                   .count();
+    if (age < stall_warning_sec_) continue;
+    if (!preamble) {
+      std::fprintf(
+          stderr,
+          "One or more tensors were submitted to be reduced, gathered or "
+          "broadcasted by subset of ranks and are waiting for remainder of "
+          "ranks for more than %d seconds. This may indicate that different "
+          "ranks are trying to submit different tensors or that only subset "
+          "of ranks is submitting tensors, which will cause deadlock.\n",
+          stall_warning_sec_);
+      std::fprintf(stderr, "Stalled ops:\n");
+      preamble = true;
+    }
+    std::string missing;
+    for (int r = 0; r < size_; ++r) {
+      if (!kv.second.seen[r]) {
+        if (!missing.empty()) missing += ", ";
+        missing += std::to_string(r);
+      }
+    }
+    std::fprintf(stderr, "%s [missing ranks: %s]\n", kv.first.c_str(),
+                 missing.c_str());
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Public enqueue / handle API
+// ---------------------------------------------------------------------------
+
+int64_t Engine::Enqueue(RequestType type, const std::string& name,
+                        DataType dtype, const std::vector<int64_t>& shape,
+                        void* data, int root_rank) {
+  if (!initialized_.load() || shutdown_requested_.load() ||
+      shut_down_.load()) {
+    return -2;
+  }
+  int64_t handle = next_handle_.fetch_add(1);
+  auto hs = std::make_shared<HandleState>();
+  {
+    std::lock_guard<std::mutex> lk(handle_mu_);
+    handles_[handle] = hs;
+  }
+  TensorTableEntry e;
+  e.name = name;
+  e.type = type;
+  e.dtype = dtype;
+  for (auto d : shape) e.shape.AddDim(d);
+  e.data = data;
+  e.root_rank = root_rank;
+  e.handle = handle;
+
+  Request q;
+  q.request_rank = rank_;
+  q.type = type;
+  q.dtype = dtype;
+  q.tensor_name = name;
+  q.root_rank = root_rank;
+  q.shape = shape;
+
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (tensor_table_.count(name) != 0) {
+      std::lock_guard<std::mutex> hlk(handle_mu_);
+      handles_.erase(handle);
+      return -1;  // duplicate name in flight
+    }
+    tensor_table_.emplace(name, std::move(e));
+    message_queue_.push_back(std::move(q));
+  }
+  return handle;
+}
+
+std::shared_ptr<HandleState> Engine::GetHandle(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handle_mu_);
+  auto it = handles_.find(handle);
+  return it == handles_.end() ? nullptr : it->second;
+}
+
+int Engine::Poll(int64_t handle) {
+  auto hs = GetHandle(handle);
+  if (hs == nullptr) return -1;
+  return hs->done.load();
+}
+
+int Engine::Wait(int64_t handle) {
+  auto hs = GetHandle(handle);
+  if (hs == nullptr) return -1;
+  std::unique_lock<std::mutex> lk(handle_mu_);
+  handle_cv_.wait(lk, [&] { return hs->done.load() != 0; });
+  return hs->done.load();
+}
+
+std::string Engine::ErrorMessage(int64_t handle) {
+  auto hs = GetHandle(handle);
+  if (hs == nullptr) return "unknown handle";
+  std::lock_guard<std::mutex> lk(handle_mu_);
+  return hs->error;
+}
+
+int64_t Engine::ResultNumDims(int64_t handle) {
+  auto hs = GetHandle(handle);
+  if (hs == nullptr) return -1;
+  return static_cast<int64_t>(hs->result_shape.size());
+}
+
+int64_t Engine::ResultDim(int64_t handle, int i) {
+  auto hs = GetHandle(handle);
+  if (hs == nullptr || i < 0 ||
+      i >= static_cast<int>(hs->result_shape.size())) {
+    return -1;
+  }
+  return hs->result_shape[i];
+}
+
+int64_t Engine::ResultByteSize(int64_t handle) {
+  auto hs = GetHandle(handle);
+  if (hs == nullptr) return -1;
+  return static_cast<int64_t>(hs->result.size());
+}
+
+int Engine::CopyResult(int64_t handle, void* dst, int64_t nbytes) {
+  auto hs = GetHandle(handle);
+  if (hs == nullptr || nbytes < static_cast<int64_t>(hs->result.size())) {
+    return -1;
+  }
+  memcpy(dst, hs->result.data(), hs->result.size());
+  return 0;
+}
+
+void Engine::ReleaseHandle(int64_t handle) {
+  std::lock_guard<std::mutex> lk(handle_mu_);
+  handles_.erase(handle);
+}
+
+}  // namespace hvd

@@ -1,0 +1,172 @@
+// The native runtime engine: background coordinator + host data plane.
+//
+// Functional parity with the reference core (horovod/common/operations.cc):
+//   * HorovodGlobalState      → Engine singleton (tensor table, message
+//     queue, background thread, fusion buffer, knobs)
+//   * BackgroundThreadLoop / RunLoopOnce (operations.cc:1435-1907)
+//     → Engine::BackgroundLoop / RunLoopOnce — a lock-step negotiation
+//     cycle every HOROVOD_CYCLE_TIME ms (default 5)
+//   * rank-0 coordinator protocol (MPI_Gather/v + MPI_Bcast of
+//     FlatBuffers lists) → length-prefixed TCP frames to/from the
+//     coordinator address (JAX-style rendezvous, no mpirun)
+//   * IncrementTensorCount / ConstructMPIResponse (operations.cc:282-517)
+//     → MessageTable readiness counting + full cross-rank validation
+//   * tensor fusion buffer (operations.cc:149-165, 1815-1842)
+//     → same-dtype ready allreduces packed into one ring collective
+//   * MPI_Allreduce/Allgatherv/Bcast data plane (operations.cc:1232-1353)
+//     → ring allreduce (reduce-scatter + allgather over neighbor TCP
+//       sockets — the classic bandwidth-optimal ring the reference gets
+//       from NCCL), frame-forwarding ring allgather, pipelined ring
+//       broadcast
+//   * stall detection (operations.cc:1366-1412) → StallCheck
+//   * Timeline hooks (operations.cc:698-710) → timeline.h
+//
+// The accelerator hot path does NOT go through this engine — jitted SPMD
+// programs use XLA collectives over ICI.  This engine serves the host-driven
+// paths: eager collectives, the torch frontend, parameter/optimizer
+// broadcast, metric averaging, and cross-process (DCN) reductions.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+#include "socket.h"
+#include "timeline.h"
+
+namespace hvd {
+
+struct TensorTableEntry {
+  std::string name;
+  RequestType type = RequestType::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  TensorShape shape;
+  void* data = nullptr;   // caller-owned; in/out for allreduce & broadcast
+  int root_rank = -1;
+  int64_t handle = -1;
+};
+
+struct HandleState {
+  std::atomic<int> done{0};   // 0 pending, 1 ok, -1 error
+  std::string error;
+  // Allgather result (shape negotiated at runtime, reference
+  // operations.cc:796-856): buffered here, copied out by the caller.
+  std::vector<uint8_t> result;
+  std::vector<int64_t> result_shape;
+};
+
+class Engine {
+ public:
+  static Engine& Get();
+
+  // Returns 0 on success; nonzero + FillLastError on failure.
+  int Init(int rank, int size, int local_rank, int local_size,
+           const std::string& coordinator_addr);
+  void Shutdown();
+
+  bool initialized() const { return initialized_.load(); }
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  int local_rank() const { return local_rank_; }
+  int local_size() const { return local_size_; }
+  const std::string& last_error() const { return last_error_; }
+
+  // Enqueue a collective on caller-owned memory.  Returns a handle, or -1
+  // (duplicate name in flight — reference DUPLICATE_NAME_ERROR,
+  // operations.cc:2058-2061) or -2 (not initialized / shut down).
+  int64_t Enqueue(RequestType type, const std::string& name, DataType dtype,
+                  const std::vector<int64_t>& shape, void* data,
+                  int root_rank);
+
+  int Poll(int64_t handle);                  // 0 pending, 1 ok, -1 error
+  int Wait(int64_t handle);                  // blocks; returns Poll result
+  std::string ErrorMessage(int64_t handle);
+  int64_t ResultNumDims(int64_t handle);
+  int64_t ResultDim(int64_t handle, int i);
+  int64_t ResultByteSize(int64_t handle);
+  int CopyResult(int64_t handle, void* dst, int64_t nbytes);
+  void ReleaseHandle(int64_t handle);
+
+ private:
+  Engine() = default;
+  void BackgroundLoop();
+  bool RunLoopOnce();                        // returns false on shutdown
+  ResponseList CoordinatorStep(std::vector<RequestList>& lists);
+  Response BuildResponse(const std::string& name);
+  void FuseResponses(std::vector<Response>& responses);
+  void PerformResponse(const Response& response);
+  void ExecAllreduce(const Response& response,
+                     std::vector<TensorTableEntry>& entries);
+  void ExecAllgather(const Response& response,
+                     std::vector<TensorTableEntry>& entries);
+  void ExecBroadcast(const Response& response,
+                     std::vector<TensorTableEntry>& entries);
+  void FinishEntry(TensorTableEntry& e, const Status& s);
+  void CheckForStalledTensors();
+
+  std::shared_ptr<HandleState> GetHandle(int64_t handle);
+
+  // -- identity / lifecycle --
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> shut_down_{false};
+  std::atomic<bool> shutdown_requested_{false};
+  int rank_ = 0, size_ = 1, local_rank_ = 0, local_size_ = 1;
+  std::string last_error_;
+  std::thread background_;
+
+  // -- knobs (reference operations.h:53-58 env vars) --
+  int cycle_time_ms_ = 5;
+  int64_t fusion_threshold_ = 64 * 1024 * 1024;
+  bool stall_check_disabled_ = false;
+  int stall_warning_sec_ = 60;
+
+  // -- pending work (guarded by mu_) --
+  std::mutex mu_;
+  std::unordered_map<std::string, TensorTableEntry> tensor_table_;
+  std::deque<Request> message_queue_;
+
+  // -- handles --
+  std::mutex handle_mu_;
+  std::unordered_map<int64_t, std::shared_ptr<HandleState>> handles_;
+  std::condition_variable handle_cv_;
+  std::atomic<int64_t> next_handle_{0};
+
+  // -- coordinator state (rank 0 only) --
+  struct PendingInfo {
+    std::vector<Request> requests;        // one per reporting rank
+    std::vector<bool> seen;               // which ranks reported
+    int count = 0;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  std::unordered_map<std::string, PendingInfo> message_table_;
+  std::chrono::steady_clock::time_point last_stall_check_;
+
+  // -- network --
+  Socket control_listener_;                // rank 0
+  std::vector<Socket> worker_conns_;       // rank 0: [size-1] control conns
+  Socket coordinator_conn_;                // rank != 0
+  Socket ring_next_, ring_prev_;           // data plane neighbors
+  Socket data_listener_;
+
+  // -- fusion scratch --
+  std::vector<uint8_t> fusion_buffer_;
+
+  // -- timeline --
+  Timeline timeline_;
+};
+
+// Element-wise sum of src into dst (the data-plane reduction kernel).
+// f16/bf16 accumulate via float, like the reference custom MPI op
+// (horovod/common/half.cc) but TPU-era: bf16 is first-class.
+void ReduceSumInto(void* dst, const void* src, int64_t count, DataType dtype);
+
+}  // namespace hvd

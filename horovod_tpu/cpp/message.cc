@@ -1,0 +1,82 @@
+#include "message.h"
+
+namespace hvd {
+
+static void SerializeRequest(const Request& q, Writer* w) {
+  w->i32(q.request_rank);
+  w->u8(static_cast<uint8_t>(q.type));
+  w->u8(static_cast<uint8_t>(q.dtype));
+  w->str(q.tensor_name);
+  w->i32(q.root_rank);
+  w->u32(static_cast<uint32_t>(q.shape.size()));
+  for (auto d : q.shape) w->i64(d);
+}
+
+static bool ParseRequest(Reader* r, Request* q) {
+  q->request_rank = r->i32();
+  q->type = static_cast<RequestType>(r->u8());
+  q->dtype = static_cast<DataType>(r->u8());
+  q->tensor_name = r->str();
+  q->root_rank = r->i32();
+  uint32_t nd = r->u32();
+  q->shape.clear();
+  for (uint32_t i = 0; i < nd && r->ok(); ++i) q->shape.push_back(r->i64());
+  return r->ok();
+}
+
+void SerializeRequestList(const RequestList& list, Writer* w) {
+  w->u8(list.shutdown ? 1 : 0);
+  w->u32(static_cast<uint32_t>(list.requests.size()));
+  for (const auto& q : list.requests) SerializeRequest(q, w);
+}
+
+bool ParseRequestList(Reader* r, RequestList* out) {
+  out->shutdown = r->u8() != 0;
+  uint32_t n = r->u32();
+  out->requests.resize(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    if (!ParseRequest(r, &out->requests[i])) return false;
+  }
+  return r->ok();
+}
+
+static void SerializeResponse(const Response& s, Writer* w) {
+  w->u8(static_cast<uint8_t>(s.type));
+  w->u32(static_cast<uint32_t>(s.tensor_names.size()));
+  for (const auto& n : s.tensor_names) w->str(n);
+  w->str(s.error_message);
+  w->u32(static_cast<uint32_t>(s.tensor_sizes.size()));
+  for (auto v : s.tensor_sizes) w->i64(v);
+  w->i32(s.root_rank);
+}
+
+static bool ParseResponse(Reader* r, Response* s) {
+  s->type = static_cast<ResponseType>(r->u8());
+  uint32_t n = r->u32();
+  s->tensor_names.resize(n);
+  for (uint32_t i = 0; i < n; ++i) s->tensor_names[i] = r->str();
+  s->error_message = r->str();
+  uint32_t m = r->u32();
+  s->tensor_sizes.clear();
+  for (uint32_t i = 0; i < m && r->ok(); ++i) s->tensor_sizes.push_back(r->i64());
+  s->root_rank = r->i32();
+  return r->ok();
+}
+
+void SerializeResponseList(const ResponseList& list, Writer* w) {
+  w->u8(list.shutdown ? 1 : 0);
+  w->u32(static_cast<uint32_t>(list.responses.size()));
+  for (const auto& s : list.responses) SerializeResponse(s, w);
+}
+
+bool ParseResponseList(Reader* r, ResponseList* out) {
+  out->shutdown = r->u8() != 0;
+  uint32_t n = r->u32();
+  out->responses.resize(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    if (!ParseResponse(r, &out->responses[i])) return false;
+  }
+  return r->ok();
+}
+
+}  // namespace hvd

@@ -1,0 +1,123 @@
+// Control-plane wire protocol.
+//
+// Role parity with the reference's FlatBuffers messages
+// (horovod/common/mpi_message.{h,cc} + wire/mpi_message.fbs): Request /
+// RequestList flow worker→coordinator, Response / ResponseList flow back.
+// The encoding here is a deliberately simple length-prefixed binary format
+// (no schema compiler, no vendored library): all peers run the same build
+// on the same arch, so cross-version schema evolution — FlatBuffers' reason
+// to exist — buys nothing for an in-cluster control plane.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvd {
+
+enum class RequestType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+};
+
+enum class ResponseType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ERROR = 3,
+};
+
+inline const char* RequestTypeName(RequestType t) {
+  switch (t) {
+    case RequestType::ALLREDUCE: return "allreduce";
+    case RequestType::ALLGATHER: return "allgather";
+    case RequestType::BROADCAST: return "broadcast";
+  }
+  return "?";
+}
+
+struct Request {
+  int32_t request_rank = 0;
+  RequestType type = RequestType::ALLREDUCE;
+  DataType dtype = DataType::FLOAT32;
+  std::string tensor_name;
+  int32_t root_rank = -1;   // broadcast only
+  std::vector<int64_t> shape;
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;    // shutdown piggybacks on the control stream
+};
+
+struct Response {
+  ResponseType type = ResponseType::ALLREDUCE;
+  // >1 names ⇒ fused batch executed as one collective.
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  // Allgather: per-rank dim-0 sizes (negotiated dynamic shape).
+  std::vector<int64_t> tensor_sizes;
+  int32_t root_rank = -1;
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+};
+
+// Flat byte-buffer serialization (host byte order; in-cluster only).
+class Writer {
+ public:
+  void u8(uint8_t v) { buf_.push_back(v); }
+  void u32(uint32_t v) { append(&v, 4); }
+  void i32(int32_t v) { append(&v, 4); }
+  void i64(int64_t v) { append(&v, 8); }
+  void str(const std::string& s) {
+    u32(static_cast<uint32_t>(s.size()));
+    append(s.data(), s.size());
+  }
+  const std::vector<uint8_t>& bytes() const { return buf_; }
+
+ private:
+  void append(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf_.insert(buf_.end(), b, b + n);
+  }
+  std::vector<uint8_t> buf_;
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t size) : p_(data), end_(data + size) {}
+  uint8_t u8() { return *take(1); }
+  uint32_t u32() { uint32_t v; memcpy(&v, take(4), 4); return v; }
+  int32_t i32() { int32_t v; memcpy(&v, take(4), 4); return v; }
+  int64_t i64() { int64_t v; memcpy(&v, take(8), 8); return v; }
+  std::string str() {
+    uint32_t n = u32();
+    const uint8_t* s = take(n);
+    return std::string(reinterpret_cast<const char*>(s), n);
+  }
+  bool ok() const { return ok_; }
+
+ private:
+  const uint8_t* take(size_t n) {
+    if (p_ + n > end_) { ok_ = false; static uint8_t zero[8] = {0}; return zero; }
+    const uint8_t* r = p_;
+    p_ += n;
+    return r;
+  }
+  const uint8_t* p_;
+  const uint8_t* end_;
+  bool ok_ = true;
+};
+
+void SerializeRequestList(const RequestList& list, Writer* w);
+bool ParseRequestList(Reader* r, RequestList* out);
+void SerializeResponseList(const ResponseList& list, Writer* w);
+bool ParseResponseList(Reader* r, ResponseList* out);
+
+}  // namespace hvd

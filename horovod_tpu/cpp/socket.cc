@@ -1,0 +1,172 @@
+#include "socket.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace hvd {
+
+Socket::~Socket() { Close(); }
+
+Socket& Socket::operator=(Socket&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+void Socket::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool Socket::SendAll(const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t sent = ::send(fd_, p, n, MSG_NOSIGNAL);
+    if (sent <= 0) {
+      if (sent < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += sent;
+    n -= static_cast<size_t>(sent);
+  }
+  return true;
+}
+
+bool Socket::RecvAll(void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t got = ::recv(fd_, p, n, 0);
+    if (got <= 0) {
+      if (got < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += got;
+    n -= static_cast<size_t>(got);
+  }
+  return true;
+}
+
+bool Socket::SendFrame(const std::vector<uint8_t>& payload) {
+  uint64_t len = payload.size();
+  if (!SendAll(&len, sizeof(len))) return false;
+  if (len == 0) return true;
+  return SendAll(payload.data(), payload.size());
+}
+
+bool Socket::RecvFrame(std::vector<uint8_t>* payload) {
+  uint64_t len = 0;
+  if (!RecvAll(&len, sizeof(len))) return false;
+  if (len > (1ull << 34)) return false;  // 16 GB sanity cap
+  payload->resize(len);
+  if (len == 0) return true;
+  return RecvAll(payload->data(), len);
+}
+
+static void SetNoDelay(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+Socket Listen(const std::string& host, int port, int backlog,
+              int* bound_port, std::string* error) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    *error = std::string("socket: ") + strerror(errno);
+    return Socket();
+  }
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (host.empty() || host == "0.0.0.0") {
+    addr.sin_addr.s_addr = INADDR_ANY;
+  } else if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    hostent* he = ::gethostbyname(host.c_str());
+    if (he == nullptr || he->h_addr_list[0] == nullptr) {
+      *error = "cannot resolve host " + host;
+      ::close(fd);
+      return Socket();
+    }
+    memcpy(&addr.sin_addr, he->h_addr_list[0], sizeof(addr.sin_addr));
+  }
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    *error = std::string("bind: ") + strerror(errno);
+    ::close(fd);
+    return Socket();
+  }
+  if (::listen(fd, backlog) != 0) {
+    *error = std::string("listen: ") + strerror(errno);
+    ::close(fd);
+    return Socket();
+  }
+  if (bound_port != nullptr) {
+    sockaddr_in got{};
+    socklen_t len = sizeof(got);
+    ::getsockname(fd, reinterpret_cast<sockaddr*>(&got), &len);
+    *bound_port = ntohs(got.sin_port);
+  }
+  return Socket(fd);
+}
+
+Socket Accept(Socket& listener, std::string* error) {
+  int fd = ::accept(listener.fd(), nullptr, nullptr);
+  if (fd < 0) {
+    *error = std::string("accept: ") + strerror(errno);
+    return Socket();
+  }
+  SetNoDelay(fd);
+  return Socket(fd);
+}
+
+Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
+                    std::string* error) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(deadline_ms);
+  std::string last_err;
+  while (std::chrono::steady_clock::now() < deadline) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) {
+      last_err = std::string("socket: ") + strerror(errno);
+      break;
+    }
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+      hostent* he = ::gethostbyname(host.c_str());
+      if (he == nullptr || he->h_addr_list[0] == nullptr) {
+        *error = "cannot resolve host " + host;
+        ::close(fd);
+        return Socket();
+      }
+      memcpy(&addr.sin_addr, he->h_addr_list[0], sizeof(addr.sin_addr));
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      SetNoDelay(fd);
+      return Socket(fd);
+    }
+    last_err = std::string("connect: ") + strerror(errno);
+    ::close(fd);
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  *error = "timed out connecting to " + host + ":" + std::to_string(port) +
+           " (" + last_err + ")";
+  return Socket();
+}
+
+}  // namespace hvd

@@ -1,0 +1,53 @@
+// Minimal TCP framing layer for the control and data planes.
+//
+// The reference delegates transport to MPI (MPI_Gather/Gatherv/Bcast for
+// control, MPI_Allreduce/Allgatherv/Bcast for data).  The TPU-native
+// runtime has no MPI: processes rendezvous at a coordinator address
+// (the same model as the JAX distributed runtime) and exchange
+// length-prefixed frames over TCP.  TCP_NODELAY is set everywhere —
+// the control plane sends many tiny frames per cycle.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class Socket {
+ public:
+  Socket() : fd_(-1) {}
+  explicit Socket(int fd) : fd_(fd) {}
+  ~Socket();
+  Socket(const Socket&) = delete;
+  Socket& operator=(const Socket&) = delete;
+  Socket(Socket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Socket& operator=(Socket&& o) noexcept;
+
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void Close();
+
+  // Blocking helpers; return false on error/EOF.
+  bool SendAll(const void* data, size_t n);
+  bool RecvAll(void* data, size_t n);
+
+  // Length-prefixed frames (u64 length + payload).
+  bool SendFrame(const std::vector<uint8_t>& payload);
+  bool RecvFrame(std::vector<uint8_t>* payload);
+
+ private:
+  int fd_;
+};
+
+// Listen on host:port (port 0 = ephemeral). Returns listening socket and
+// fills *bound_port.
+Socket Listen(const std::string& host, int port, int backlog,
+              int* bound_port, std::string* error);
+// Accept one connection (blocking).
+Socket Accept(Socket& listener, std::string* error);
+// Connect with retry until deadline_ms elapses (peer may not be up yet).
+Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
+                    std::string* error);
+
+}  // namespace hvd

@@ -1,0 +1,125 @@
+#include "timeline.h"
+
+namespace hvd {
+
+void Timeline::Initialize(const std::string& path) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ != nullptr) return;
+  file_ = std::fopen(path.c_str(), "w");
+  if (file_ == nullptr) {
+    std::fprintf(stderr, "horovod_tpu: cannot open timeline file %s\n",
+                 path.c_str());
+    return;
+  }
+  std::fputs("[\n", file_);
+  start_ = std::chrono::steady_clock::now();
+  last_flush_ = start_;
+}
+
+Timeline::~Timeline() {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ != nullptr) {
+    std::fflush(file_);
+    std::fclose(file_);
+    file_ = nullptr;
+  }
+}
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+int Timeline::TensorPid(const std::string& name) {
+  auto it = tensor_pids_.find(name);
+  if (it != tensor_pids_.end()) return it->second;
+  int pid = next_pid_++;
+  tensor_pids_[name] = pid;
+  // Metadata event naming the "process" after the tensor (reference
+  // timeline.cc:51-68).
+  std::fprintf(file_,
+               "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": %d, "
+               "\"args\": {\"name\": \"%s\"}},\n",
+               pid, name.c_str());
+  std::fprintf(file_,
+               "{\"name\": \"process_sort_index\", \"ph\": \"M\", "
+               "\"pid\": %d, \"args\": {\"sort_index\": %d}},\n",
+               pid, pid);
+  return pid;
+}
+
+void Timeline::WriteEvent(int pid, char phase, const std::string& category,
+                          const std::string& op_name) {
+  std::fprintf(file_, "{\"ph\": \"%c\", \"ts\": %lld, \"pid\": %d",
+               phase, static_cast<long long>(NowUs()), pid);
+  if (!category.empty()) {
+    std::fprintf(file_, ", \"cat\": \"%s\"", category.c_str());
+  }
+  if (!op_name.empty()) {
+    std::fprintf(file_, ", \"name\": \"%s\"", op_name.c_str());
+  }
+  std::fputs("},\n", file_);
+  FlushIfDue();
+}
+
+void Timeline::FlushIfDue() {
+  auto now = std::chrono::steady_clock::now();
+  if (now - last_flush_ > std::chrono::seconds(1)) {
+    std::fflush(file_);
+    last_flush_ = now;
+  }
+}
+
+void Timeline::NegotiateStart(const std::string& name) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'B', "NEGOTIATE", "NEGOTIATE");
+}
+
+void Timeline::NegotiateRankReady(const std::string& name, int rank) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'X', "NEGOTIATE",
+             "rank_" + std::to_string(rank) + "_ready");
+}
+
+void Timeline::NegotiateEnd(const std::string& name) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'E', "NEGOTIATE");
+}
+
+void Timeline::Start(const std::string& name) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'B', "OP", name);
+}
+
+void Timeline::ActivityStart(const std::string& name,
+                             const std::string& activity) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'B', "ACTIVITY", activity);
+}
+
+void Timeline::ActivityEnd(const std::string& name) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'E', "ACTIVITY");
+}
+
+void Timeline::End(const std::string& name, DataType dtype,
+                   const std::string& shape) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  int pid = TensorPid(name);
+  std::fprintf(file_,
+               "{\"ph\": \"E\", \"ts\": %lld, \"pid\": %d, \"args\": "
+               "{\"dtype\": \"%s\", \"shape\": \"%s\"}},\n",
+               static_cast<long long>(NowUs()), pid, DataTypeName(dtype),
+               shape.c_str());
+  FlushIfDue();
+}
+
+}  // namespace hvd

@@ -1,0 +1,50 @@
+// Chrome-tracing timeline writer.
+//
+// Feature parity with the reference Timeline (horovod/common/timeline.{h,cc}
+// + docs/timeline.md): rank-0 writes a chrome://tracing JSON stream; each
+// tensor is a trace "process" (pid); nested B/E events cover NEGOTIATE and
+// execution activities (QUEUE, FUSE, RING_ALLREDUCE, ...); enabled via
+// HOROVOD_TIMELINE=<path>.  Thread-safe; flushed once per second.
+#pragma once
+
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+#include "common.h"
+
+namespace hvd {
+
+class Timeline {
+ public:
+  void Initialize(const std::string& path);
+  bool Initialized() const { return file_ != nullptr; }
+
+  void NegotiateStart(const std::string& name);
+  void NegotiateRankReady(const std::string& name, int rank);
+  void NegotiateEnd(const std::string& name);
+  void Start(const std::string& name);                    // top-level op
+  void ActivityStart(const std::string& name, const std::string& activity);
+  void ActivityEnd(const std::string& name);
+  void End(const std::string& name, DataType dtype, const std::string& shape);
+
+  ~Timeline();
+
+ private:
+  int64_t NowUs() const;
+  int TensorPid(const std::string& name);
+  void WriteEvent(int pid, char phase, const std::string& category,
+                  const std::string& op_name = "");
+  void FlushIfDue();
+
+  FILE* file_ = nullptr;
+  std::recursive_mutex mu_;
+  std::unordered_map<std::string, int> tensor_pids_;
+  std::chrono::steady_clock::time_point start_;
+  std::chrono::steady_clock::time_point last_flush_;
+  int next_pid_ = 0;
+};
+
+}  // namespace hvd

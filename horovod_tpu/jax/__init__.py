@@ -219,6 +219,21 @@ class DistributedOptimizer:
         self._reduce = reduce_gradients
         self.name = name or "DistributedOptimizer"
 
+    @property
+    def inner(self):
+        """The wrapped optax transformation."""
+        return self._inner
+
+    def with_axis_name(self, axis_name):
+        """A copy bound to ``axis_name`` (used by train-step builders to pin
+        reduction to the mesh they run on)."""
+        return DistributedOptimizer(
+            self._inner, axis_name=axis_name, op=self._op,
+            compression=self._compression,
+            fusion_threshold_bytes=self._fusion_threshold,
+            reduce_gradients=self._reduce, name=self.name,
+        )
+
     def init(self, params):
         return self._inner.init(params)
 
@@ -277,15 +292,21 @@ def broadcast_optimizer_state(opt_state, root_rank=0, *, axis_name=None):
 # ---------------------------------------------------------------------------
 
 def make_train_step(loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
-                    *, donate=True):
+                    *, donate=True, has_aux=False):
     """Build a jitted SPMD train step: shard batch over data axes, compute
     grads, fused-allreduce them, apply the optimizer.
 
-    ``loss_fn(params, batch) -> scalar loss``.  ``optimizer`` may be a plain
-    optax transformation (it will be wrapped) or a ``DistributedOptimizer``.
+    ``loss_fn(params, batch) -> scalar loss``, or with ``has_aux=True``
+    ``loss_fn(params, aux_state, batch) -> (loss, new_aux_state)`` where
+    ``aux_state`` is non-differentiated model state (e.g. batch-norm
+    statistics), averaged across the data axes each step (cross-replica
+    batch norm).  ``optimizer`` may be a plain optax transformation (it will
+    be wrapped) or a ``DistributedOptimizer``.
 
     Returns ``step(params, opt_state, batch) -> (params, opt_state, loss)``
-    with params/opt_state replicated and batch sharded on the data axes.
+    (with ``has_aux``: ``step(params, opt_state, aux_state, batch) ->
+    (params, opt_state, aux_state, loss)``); params/opt_state replicated,
+    batch sharded on the data axes.
     """
     mesh = mesh or default_mesh()
     axes = _mesh.data_axes(mesh) or mesh.axis_names
@@ -294,30 +315,45 @@ def make_train_step(loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
     elif optimizer._axis_name is None:
         # Bind reduction to THIS mesh's data-like axes — resolving from the
         # thread-local default mesh would silently skip e.g. 'fsdp'.
-        optimizer = DistributedOptimizer(
-            optimizer._inner, axis_name=axes, op=optimizer._op,
-            compression=optimizer._compression,
-            fusion_threshold_bytes=optimizer._fusion_threshold,
-            reduce_gradients=optimizer._reduce, name=optimizer.name,
-        )
+        optimizer = optimizer.with_axis_name(axes)
+
+    import optax
 
     def _sharded_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        import optax
-
         params = optax.apply_updates(params, updates)
         loss = _cops.allreduce(loss, axis_name=axes, op=Average)
         return params, opt_state, loss
 
+    def _sharded_step_aux(params, opt_state, aux_state, batch):
+        (loss, aux_state), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, aux_state, batch)
+        aux_state = jax.tree.map(
+            lambda x: _cops.allreduce(x, axis_name=axes, op=Average)
+            if _is_inexact(x) else x,
+            aux_state,
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = _cops.allreduce(loss, axis_name=axes, op=Average)
+        return params, opt_state, aux_state, loss
+
     batch_spec = PartitionSpec(axes)
     replicated = PartitionSpec()
+    n_state = 3 if has_aux else 2
     step = jax.shard_map(
-        _sharded_step,
+        _sharded_step_aux if has_aux else _sharded_step,
         mesh=mesh,
-        in_specs=(replicated, replicated, batch_spec),
-        out_specs=(replicated, replicated, replicated),
+        in_specs=(replicated,) * n_state + (batch_spec,),
+        out_specs=(replicated,) * n_state + (replicated,),
         check_vma=False,
     )
-    donate_args = (0, 1) if donate else ()
+    donate_args = tuple(range(n_state)) if donate else ()
     return jax.jit(step, donate_argnums=donate_args)
+
+
+def _is_inexact(x) -> bool:
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)

@@ -146,7 +146,7 @@ def make_parallel_train_step(model, optimizer, mesh: Mesh, *,
     from horovod_tpu.jax import DistributedOptimizer
 
     if isinstance(optimizer, DistributedOptimizer):
-        inner = optimizer._inner
+        inner = optimizer.inner
     else:
         inner = optimizer
 

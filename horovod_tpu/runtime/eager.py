@@ -4,14 +4,19 @@ Reference parity: the enqueue → negotiate → execute pipeline
 (``EnqueueTensorAllreduce`` → ``RunLoopOnce`` → ``PerformOperation``,
 ``horovod/common/operations.cc:2029-2145, 1694-1907, 714-1362``).
 
-This module is the Python face of that pipeline.  At ``size() == 1`` the
-collectives are arithmetic identities (matching the reference under
+This module is the JAX-facing face of that pipeline.  At ``size() == 1``
+the collectives are arithmetic identities (matching the reference under
 ``mpirun -np 1``), with averaging/compression semantics still applied so
-code paths are identical at any scale.  At ``size() > 1`` calls are routed
-through the native negotiation engine (``horovod_tpu.cpp``) which establishes
-a globally agreed, identically ordered, fused batch of collectives per cycle
-— the reference's central correctness idea — and then executes them either
-over the global device mesh (XLA data plane) or the host socket data plane.
+code paths are identical at any scale.  At ``size() > 1`` calls go through
+the native engine (``horovod_tpu/cpp`` via ``runtime.engine``): a rank-0
+coordinator establishes a globally agreed, identically ordered, fused batch
+of collectives per cycle — the reference's central correctness idea — and
+executes them as ring collectives between the host processes.
+
+The wire reduction is SUM only (reference wire-protocol parity,
+``horovod/common/mpi_message.h``); averaging happens here, and MIN/MAX/
+PRODUCT eager reductions are not supported cross-process (they never were
+in the reference either).
 """
 
 from __future__ import annotations
@@ -19,6 +24,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax.numpy as jnp
+import numpy as np
 
 from horovod_tpu.common.basics import basics
 from horovod_tpu.ops.collective_ops import Average, ReduceOp, Sum
@@ -34,17 +40,11 @@ def _resolve_op(op, average):
 
 
 def _engine():
-    """The multi-process negotiation engine (None at size 1)."""
+    """The native multi-process engine (None at size 1)."""
     if basics.size() == 1:
         return None
-    try:
-        from horovod_tpu.runtime import engine
-    except ImportError as e:
-        raise NotImplementedError(
-            "eager collectives at size > 1 require the negotiation engine "
-            "(horovod_tpu.runtime.engine), which is not available: "
-            f"{e}"
-        ) from e
+    from horovod_tpu.runtime import engine
+
     return engine.get_engine()
 
 
@@ -52,35 +52,75 @@ def allreduce(tensor, *, op=Average, average=None,
               compression=Compression.none, name: Optional[str] = None):
     op = _resolve_op(op, average)
     eng = _engine()
+    arr = jnp.asarray(tensor)
+    wire, ctx = compression.compress(arr)
     if eng is None:
-        wire, ctx = compression.compress(jnp.asarray(tensor))
         return compression.decompress(wire, ctx)
-    return eng.allreduce(tensor, op=op, compression=compression, name=name)
+    if op not in (Average, Sum):
+        raise NotImplementedError(
+            f"eager cross-process allreduce supports SUM/AVERAGE only, "
+            f"got {op}"
+        )
+    host = np.ascontiguousarray(np.asarray(wire))
+    reduced = eng.allreduce(host, average=(op is Average), name=name)
+    return compression.decompress(jnp.asarray(reduced), ctx)
 
 
 def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
                       compression=Compression.none,
                       name: Optional[str] = None):
-    return [
-        allreduce(t, op=op, average=average, compression=compression,
-                  name=None if name is None else f"{name}.{i}")
-        for i, t in enumerate(tensors)
+    """Allreduce many tensors; cross-process they are enqueued together so
+    the coordinator fuses them into few ring collectives
+    (reference response fusion, operations.cc:1815-1842)."""
+    op = _resolve_op(op, average)
+    eng = _engine()
+    if eng is None:
+        return [
+            allreduce(t, op=op, compression=compression) for t in tensors
+        ]
+    if op not in (Average, Sum):
+        raise NotImplementedError(
+            "eager cross-process allreduce supports SUM/AVERAGE only"
+        )
+    ctxs, hosts = [], []
+    for t in tensors:
+        wire, ctx = compression.compress(jnp.asarray(t))
+        ctxs.append(ctx)
+        hosts.append(np.ascontiguousarray(np.asarray(wire)).copy())
+    handles = [
+        eng.enqueue_allreduce(
+            h, None if name is None else f"{name}.{i}")
+        for i, h in enumerate(hosts)
     ]
+    outs = [eng.synchronize(h) for h in handles]
+    n = basics.size()
+    results = []
+    for out, ctx in zip(outs, ctxs):
+        if op is Average:
+            # Same semantics as NativeEngine.allreduce(average=True):
+            # floor-divide integers, true-divide floats.
+            if np.issubdtype(out.dtype, np.integer):
+                out = out // n
+            else:
+                out = (out / np.asarray(n, dtype=out.dtype)).astype(out.dtype)
+        results.append(compression.decompress(jnp.asarray(out), ctx))
+    return results
 
 
 def allgather(tensor, *, name: Optional[str] = None):
     eng = _engine()
     if eng is None:
         return jnp.asarray(tensor)
-    return eng.allgather(tensor, name=name)
+    return jnp.asarray(eng.allgather(np.asarray(tensor), name=name))
 
 
 def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None):
+    if root_rank < 0 or root_rank >= basics.size():
+        raise ValueError(
+            f"root_rank {root_rank} out of range for size {basics.size()}"
+        )
     eng = _engine()
     if eng is None:
-        if root_rank != 0:
-            raise ValueError(
-                f"root_rank {root_rank} out of range for size 1"
-            )
         return jnp.asarray(tensor)
-    return eng.broadcast(tensor, root_rank=root_rank, name=name)
+    return jnp.asarray(eng.broadcast(np.asarray(tensor), root_rank,
+                                     name=name))

@@ -1,0 +1,235 @@
+"""ctypes bridge to the native engine (eager cross-process collectives).
+
+Reference parity: the Python↔C seam of the reference — op libraries calling
+``EnqueueTensorAllreduce/Allgather/Broadcast`` and the torch handle API
+(``poll``/``synchronize``, horovod/torch/mpi_ops.py:406-438) — merged into
+one handle-based surface:
+
+* ``enqueue_*`` → int handle (async; the background coordinator negotiates
+  readiness across processes and executes fused ring collectives)
+* ``poll(handle)`` / ``synchronize(handle)``
+* sync wrappers ``allreduce/allgather/broadcast`` = enqueue + synchronize.
+
+Works on host numpy buffers; the JAX/torch layers convert at their edges.
+This module deliberately does NOT import jax — the torch frontend and the
+multi-process tests use it standalone.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["NativeEngine", "get_engine", "HorovodInternalError"]
+
+
+class HorovodInternalError(RuntimeError):
+    """A collective failed (cross-rank mismatch, shutdown, transport)."""
+
+
+# DataType codes, keep in sync with cpp/common.h.
+_DTYPE_CODES = {
+    "uint8": 0,
+    "int8": 1,
+    "uint16": 2,
+    "int16": 3,
+    "int32": 4,
+    "int64": 5,
+    "float16": 6,
+    "float32": 7,
+    "float64": 8,
+    "bool": 9,
+    "bfloat16": 10,
+}
+
+_OP_ALLREDUCE, _OP_ALLGATHER, _OP_BROADCAST = 0, 1, 2
+
+
+def _dtype_code(dtype) -> int:
+    name = np.dtype(dtype).name if np.dtype(dtype).name in _DTYPE_CODES \
+        else str(dtype)
+    try:
+        return _DTYPE_CODES[name]
+    except KeyError:
+        raise TypeError(f"unsupported dtype for native collectives: {dtype}")
+
+
+class NativeEngine:
+    """Wraps the loaded ``libhorovod_core.so``."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self._declare(lib)
+        self._name_lock = threading.Lock()
+        self._name_counters: dict[str, int] = {}
+        # Keep buffers alive while their collective is in flight
+        # (reference _handle_map, torch/mpi_ops.py:51-54).
+        self._inflight: dict[int, np.ndarray] = {}
+        self._inflight_lock = threading.Lock()
+
+    @staticmethod
+    def _declare(lib: ctypes.CDLL) -> None:
+        lib.horovod_enqueue.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
+        ]
+        lib.horovod_enqueue.restype = ctypes.c_int64
+        lib.horovod_poll.argtypes = [ctypes.c_int64]
+        lib.horovod_poll.restype = ctypes.c_int
+        lib.horovod_wait.argtypes = [ctypes.c_int64]
+        lib.horovod_wait.restype = ctypes.c_int
+        lib.horovod_error_message.argtypes = [
+            ctypes.c_int64, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.horovod_error_message.restype = None
+        lib.horovod_result_ndim.argtypes = [ctypes.c_int64]
+        lib.horovod_result_ndim.restype = ctypes.c_int64
+        lib.horovod_result_dim.argtypes = [ctypes.c_int64, ctypes.c_int]
+        lib.horovod_result_dim.restype = ctypes.c_int64
+        lib.horovod_result_bytes.argtypes = [ctypes.c_int64]
+        lib.horovod_result_bytes.restype = ctypes.c_int64
+        lib.horovod_copy_result.argtypes = [
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.horovod_copy_result.restype = ctypes.c_int
+        lib.horovod_release_handle.argtypes = [ctypes.c_int64]
+        lib.horovod_release_handle.restype = None
+        lib.horovod_size.restype = ctypes.c_int
+
+    # -- naming (auto names must be identical across ranks, which holds when
+    #    ranks enqueue in the same program order — same contract as the
+    #    reference's op-name autogeneration) --
+
+    def _auto_name(self, kind: str, name: Optional[str]) -> str:
+        if name is not None:
+            return name
+        with self._name_lock:
+            idx = self._name_counters.get(kind, 0)
+            self._name_counters[kind] = idx + 1
+        return f"{kind}.noname.{idx}"
+
+    # -- async enqueue API --
+
+    def _enqueue(self, op: int, arr: np.ndarray, name: str,
+                 root_rank: int = -1) -> int:
+        shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+        handle = self._lib.horovod_enqueue(
+            op, name.encode(), _dtype_code(arr.dtype), arr.ndim, shape,
+            arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+        )
+        if handle == -1:
+            raise HorovodInternalError(
+                f"a collective named {name!r} is already in flight "
+                "(duplicate name)"
+            )
+        if handle < 0:
+            raise HorovodInternalError(
+                "engine is not running (init not called or already shut down)"
+            )
+        with self._inflight_lock:
+            self._inflight[handle] = arr
+        return handle
+
+    def enqueue_allreduce(self, arr: np.ndarray,
+                          name: Optional[str] = None) -> int:
+        """In-place sum-allreduce of a contiguous array. Returns handle."""
+        return self._enqueue(
+            _OP_ALLREDUCE, arr, self._auto_name("allreduce", name))
+
+    def enqueue_allgather(self, arr: np.ndarray,
+                          name: Optional[str] = None) -> int:
+        return self._enqueue(
+            _OP_ALLGATHER, arr, self._auto_name("allgather", name))
+
+    def enqueue_broadcast(self, arr: np.ndarray, root_rank: int,
+                          name: Optional[str] = None) -> int:
+        return self._enqueue(
+            _OP_BROADCAST, arr, self._auto_name("broadcast", name),
+            root_rank=root_rank)
+
+    # -- handle API --
+
+    def poll(self, handle: int) -> bool:
+        """True once the collective finished (ok or error)."""
+        return self._lib.horovod_poll(handle) != 0
+
+    def synchronize(self, handle: int) -> np.ndarray:
+        """Wait; raise on error; return the result buffer.
+
+        For allreduce/broadcast this is the (in-place updated) input array;
+        for allgather it is a fresh array with the negotiated shape.
+        """
+        status = self._lib.horovod_wait(handle)
+        with self._inflight_lock:
+            arr = self._inflight.pop(handle, None)
+        try:
+            if status < 0:
+                buf = ctypes.create_string_buffer(4096)
+                self._lib.horovod_error_message(handle, buf, len(buf))
+                raise HorovodInternalError(
+                    buf.value.decode(errors="replace") or "collective failed")
+            nbytes = self._lib.horovod_result_bytes(handle)
+            if nbytes > 0:  # allgather result
+                ndim = self._lib.horovod_result_ndim(handle)
+                shape = tuple(self._lib.horovod_result_dim(handle, i)
+                              for i in range(ndim))
+                out = np.empty(shape, dtype=arr.dtype)
+                rc = self._lib.horovod_copy_result(
+                    handle, out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+                if rc != 0:
+                    raise HorovodInternalError("result copy failed")
+                return out
+            return arr
+        finally:
+            self._lib.horovod_release_handle(handle)
+
+    # -- sync convenience wrappers --
+
+    def allreduce(self, tensor, *, average: bool = False,
+                  name: Optional[str] = None) -> np.ndarray:
+        arr = np.ascontiguousarray(tensor).copy()
+        out = self.synchronize(self.enqueue_allreduce(arr, name))
+        if average:
+            n = self._lib.horovod_size()
+            if np.issubdtype(out.dtype, np.integer):
+                out = out // n
+            else:
+                out = (out / np.asarray(n, dtype=out.dtype)).astype(out.dtype)
+        return out
+
+    def allgather(self, tensor, *, name: Optional[str] = None) -> np.ndarray:
+        arr = np.ascontiguousarray(tensor)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        return self.synchronize(self.enqueue_allgather(arr, name))
+
+    def broadcast(self, tensor, root_rank: int,
+                  *, name: Optional[str] = None) -> np.ndarray:
+        arr = np.ascontiguousarray(tensor).copy()
+        return self.synchronize(self.enqueue_broadcast(arr, root_rank, name))
+
+
+_engine: Optional[NativeEngine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> NativeEngine:
+    """The process-wide engine, bound to the lib loaded by HorovodBasics."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            from horovod_tpu.common.basics import basics
+
+            lib = basics.native_lib
+            if lib is None:
+                raise RuntimeError(
+                    "native engine library is not loaded; build it with "
+                    "`make -C horovod_tpu/cpp` (required for cross-process "
+                    "eager collectives)"
+                )
+            _engine = NativeEngine(lib)
+        return _engine

@@ -1,0 +1,157 @@
+"""Worker body for multi-process native-engine tests.
+
+The TPU-native analogue of the reference's ``mpirun -np 2 pytest`` strategy
+(reference .travis.yml:104-111): N identical processes run the same
+assertions simultaneously; here the launcher is plain ``subprocess`` + the
+engine's own TCP rendezvous instead of mpirun.  Run as:
+
+    python native_worker.py <scenario>
+
+with identity in HOROVOD_RANK/HOROVOD_SIZE/HOROVOD_COORDINATOR env vars.
+Deliberately jax-free: exercises the native engine + numpy only.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import (  # noqa: E402
+    HorovodInternalError,
+    get_engine,
+)
+
+
+def scenario_allreduce(rank, size, eng):
+    # Identity check: sum of per-rank constants (reference
+    # test_tensorflow.py:56-85 does tensor*size with random tensors).
+    x = np.full((32, 5), float(rank + 1), dtype=np.float32)
+    out = eng.allreduce(x)
+    expected = size * (size + 1) / 2.0
+    assert np.allclose(out, expected), (out[0, 0], expected)
+    # Average.
+    out = eng.allreduce(x, average=True)
+    assert np.allclose(out, expected / size)
+    # int64 + float64.
+    for dtype in (np.int64, np.float64):
+        x = (np.arange(7) + rank).astype(dtype)
+        out = eng.allreduce(x)
+        exp = size * np.arange(7, dtype=np.float64) + size * (size - 1) / 2
+        assert np.allclose(np.asarray(out, np.float64), exp), (dtype, out)
+
+
+def scenario_fused(rank, size, eng):
+    # Many small same-dtype tensors enqueued in one burst: the coordinator
+    # fuses them into few ring collectives (reference fused test,
+    # test_tensorflow.py:87-119).  Validates values per tensor.
+    arrs = [np.full((n + 1, 3), float(rank + n), np.float32)
+            for n in range(17)]
+    handles = [eng.enqueue_allreduce(a, name=f"fused.{i}")
+               for i, a in enumerate(arrs)]
+    for n, h in enumerate(handles):
+        out = eng.synchronize(h)
+        expected = sum(r + n for r in range(size))
+        assert np.allclose(out, expected), (n, out[0, 0], expected)
+    # bf16 via jax's ml_dtypes if available.
+    try:
+        import ml_dtypes
+
+        x = np.full((64,), 1.5, dtype=ml_dtypes.bfloat16) * (rank + 1)
+        out = eng.allreduce(x)
+        expected = 1.5 * size * (size + 1) / 2
+        assert np.allclose(np.asarray(out, np.float32), expected, rtol=0.02)
+    except ImportError:
+        pass
+
+
+def scenario_allgather(rank, size, eng):
+    # Variable dim-0 per rank — the negotiated-shape path (reference
+    # test_tensorflow.py:348-433, operations.cc:796-856).
+    x = np.full((rank + 1, 4), float(rank), dtype=np.float32)
+    out = eng.allgather(x)
+    assert out.shape == (size * (size + 1) // 2, 4), out.shape
+    off = 0
+    for r in range(size):
+        block = out[off:off + r + 1]
+        assert np.all(block == float(r)), (r, block)
+        off += r + 1
+
+
+def scenario_broadcast(rank, size, eng):
+    for root in range(size):
+        x = np.arange(10, dtype=np.float32) * (rank + 1)
+        out = eng.broadcast(x, root_rank=root)
+        assert np.allclose(out, np.arange(10, dtype=np.float32) * (root + 1))
+
+
+def scenario_shape_mismatch(rank, size, eng):
+    # Rank-dependent shapes must produce a typed error on every rank
+    # (reference negative tests, test_tensorflow.py:249-320).
+    x = np.zeros((rank + 2,), dtype=np.float32)
+    try:
+        eng.allreduce(x, name="bad_shape")
+    except HorovodInternalError as e:
+        assert "Mismatched" in str(e), str(e)
+        return
+    raise AssertionError("expected HorovodInternalError")
+
+
+def scenario_dtype_mismatch(rank, size, eng):
+    x = np.zeros((4,), dtype=np.float32 if rank == 0 else np.float64)
+    try:
+        eng.allreduce(x, name="bad_dtype")
+    except HorovodInternalError as e:
+        assert "Mismatched data types" in str(e), str(e)
+        return
+    raise AssertionError("expected HorovodInternalError")
+
+
+def scenario_root_mismatch(rank, size, eng):
+    x = np.zeros((4,), dtype=np.float32)
+    try:
+        eng.broadcast(x, root_rank=rank % size, name="bad_root")
+        if size == 1:
+            return  # single rank cannot disagree with itself
+    except HorovodInternalError as e:
+        assert "root rank" in str(e), str(e)
+        return
+    raise AssertionError("expected HorovodInternalError")
+
+
+def scenario_timeline(rank, size, eng):
+    scenario_allreduce(rank, size, eng)
+    scenario_broadcast(rank, size, eng)
+
+
+SCENARIOS = {
+    "allreduce": scenario_allreduce,
+    "fused": scenario_fused,
+    "allgather": scenario_allgather,
+    "broadcast": scenario_broadcast,
+    "shape_mismatch": scenario_shape_mismatch,
+    "dtype_mismatch": scenario_dtype_mismatch,
+    "root_mismatch": scenario_root_mismatch,
+    "timeline": scenario_timeline,
+    "all": None,
+}
+
+
+def main():
+    scenario = sys.argv[1] if len(sys.argv) > 1 else "all"
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    if scenario == "all":
+        for name in ("allreduce", "fused", "allgather", "broadcast"):
+            SCENARIOS[name](rank, size, eng)
+    else:
+        SCENARIOS[scenario](rank, size, eng)
+    basics.shutdown()
+    print(f"worker rank={rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
