@@ -1,0 +1,248 @@
+"""PyTorch frontend.
+
+Reference parity: ``horovod/torch/__init__.py`` (301 LoC) —
+``DistributedOptimizer`` (gradient hooks firing async allreduces during
+backward, so communication overlaps remaining compute),
+``broadcast_parameters`` and ``broadcast_optimizer_state`` (including the
+scalar tensor-ization dance), plus the full op surface re-exported from
+``mpi_ops``.
+
+TPU context: torch runs on host CPU here (no CUDA in a TPU pod); this
+frontend gives torch training scripts the same scaling API they had with
+the reference, with the native engine's ring collectives over DCN as the
+data plane.  The heavy-compute path on TPU is the JAX frontend; the torch
+frontend exists for capability parity and host-side workloads.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import torch
+
+from horovod_tpu.common.basics import basics
+from horovod_tpu.torch.compression import Compression
+from horovod_tpu.torch.mpi_ops import (
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    init,
+    local_rank,
+    local_size,
+    poll,
+    rank,
+    shutdown,
+    size,
+    synchronize,
+)
+
+is_initialized = basics.is_initialized
+mpi_threads_supported = basics.mpi_threads_supported
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "mpi_threads_supported",
+    "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
+    "poll", "synchronize", "Compression",
+    "DistributedOptimizer", "broadcast_parameters",
+    "broadcast_optimizer_state",
+]
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin pattern from the reference (torch/__init__.py:31-144):
+    dynamically combined with the user's optimizer class so
+    ``isinstance(opt, UserOptimizer)`` stays true and checkpoints load
+    without this library installed."""
+
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._bpps = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for param_group in self.param_groups
+                for i, v in enumerate(param_group["params"])
+            ]
+        # Sanity checks mirroring the reference (torch/__init__.py:41-67).
+        all_params = {
+            id(v) for group in self.param_groups for v in group["params"]
+        }
+        named_ids = {id(v) for _, v in named_parameters}
+        if len(named_parameters) != len(named_ids):
+            raise ValueError("named_parameters contains duplicate parameters")
+        unnamed = all_params - named_ids
+        if unnamed and len(named_parameters) > 0 and named_ids != all_params:
+            raise ValueError(
+                f"named_parameters covers {len(named_ids)} parameters but "
+                f"the optimizer has {len(all_params)}; provide names for all"
+            )
+        self._param_names = {id(v): k for k, v in named_parameters}
+
+        self._handles: dict = {}
+        self._grad_accs = []
+        self._passes_left = collections.defaultdict(
+            lambda: self._bpps)
+        # Hooks are registered at any size so behavior (incl. the
+        # force-allreduce-in-step contract) is identical at any scale.
+        self._register_hooks()
+
+    # -- hook pipeline (reference torch/__init__.py:72-96) --
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._grad_accs.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook()))
+
+    def _make_hook(self):
+        def hook(p):
+            self._passes_left[id(p)] -= 1
+            if self._passes_left[id(p)] == 0:
+                self._handles[p] = self._allreduce_grad_async(p)
+                self._passes_left[id(p)] = self._bpps
+        return hook
+
+    def _allreduce_grad_async(self, p):
+        name = self._param_names.get(id(p))
+        tensor_compressed, ctx = self._compression.compress(p.grad.data)
+        if tensor_compressed.data_ptr() == p.grad.data.data_ptr():
+            # In-place reduce directly into .grad when uncompressed.
+            handle = allreduce_async_(tensor_compressed, average=True,
+                                      name=name)
+        else:
+            handle = allreduce_async_(
+                tensor_compressed.contiguous(), average=True, name=name)
+        return handle, tensor_compressed, ctx
+
+    def synchronize(self):
+        """Finish all gradient allreduces and write results into ``.grad``
+        (reference torch/__init__.py:98-108).  Parameters whose hook never
+        fired (no grad this step) are still allreduced so ranks cannot
+        deadlock (the force-allreduce contract, reference test_torch.py
+        test_force_allreduce)."""
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p not in self._handles:
+                    if p.grad is None:
+                        p.grad = p.data.new_zeros(p.shape)
+                    self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, tensor_compressed, ctx) in self._handles.items():
+            output = synchronize(handle)
+            p.grad.data.set_(
+                self._compression.decompress(output, ctx).data)
+        self._handles.clear()
+
+    def step(self, closure=None):
+        self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1):
+    """Wrap a torch optimizer so gradients are averaged across ranks during
+    ``backward()`` (reference factory, torch/__init__.py:115-150)."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast a state_dict or list of (name, tensor) from root to all
+    (reference torch/__init__.py:153-182)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None or not torch.is_tensor(p):
+            continue
+        handles.append(broadcast_async_(p, root_rank, name=f"bcastp.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0):
+    """Broadcast optimizer state (momenta etc.) from root
+    (reference torch/__init__.py:185-301): state on non-root ranks is first
+    materialized with a zero-grad dummy step, scalar entries are
+    tensor-ized for the wire and restored to native python types after."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+
+    if len(state_dict["state"]) == 0:
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = p.data.new_zeros(p.shape)
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    callbacks = {}
+    occurrences = collections.defaultdict(int)
+
+    def _name(base):
+        occurrences[base] += 1
+        return f"{base}.{occurrences[base]}"
+
+    params_to_bcast = []
+
+    def _tensorize(value, dict_key, base, holder):
+        """Scalars travel as tensors; a callback restores the native type
+        into ``holder[dict_key]`` (reference _create_option_callback /
+        _create_state_callback)."""
+        if torch.is_tensor(value):
+            params_to_bcast.append((_name(base), value))
+            return
+        if isinstance(value, bool):
+            t = torch.tensor(int(value))
+            cast = lambda x: bool(x.item())  # noqa: E731
+        elif isinstance(value, int):
+            t = torch.tensor(value)
+            cast = lambda x: int(x.item())  # noqa: E731
+        elif isinstance(value, float):
+            t = torch.tensor(value, dtype=torch.float64)
+            cast = lambda x: float(x.item())  # noqa: E731
+        else:
+            return  # non-numeric options (None, str) assumed rank-consistent
+        name = _name(base)
+        params_to_bcast.append((name, t))
+        callbacks[name] = (holder, dict_key, t, cast)
+
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key, value in sorted(group.items()):
+            if key == "params":
+                continue
+            _tensorize(value, key, f"group.{gi}.{key}", group)
+    for pid, pstate in sorted(state_dict["state"].items(),
+                              key=lambda kv: str(kv[0])):
+        for key, value in sorted(pstate.items()):
+            _tensorize(value, key, f"state.{pid}.{key}", pstate)
+
+    broadcast_parameters(params_to_bcast, root_rank)
+
+    for name, (holder, dict_key, t, cast) in callbacks.items():
+        holder[dict_key] = cast(t)
+    optimizer.load_state_dict(state_dict)

@@ -1,0 +1,158 @@
+"""Torch frontend tests, size-1 (multi-process coverage lives in
+tests/torch_worker.py via test_torch_multiproc.py).
+
+Mirrors the reference test matrix (test/test_torch.py): op identity,
+async/in-place variants, autograd through collectives, optimizer hook
+pipeline, state broadcast round-trips, fp16 compression.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    hvd.init()
+
+
+def test_allreduce_identity_size1():
+    x = torch.randn(5, 3)
+    out = hvd.allreduce(x)
+    assert torch.allclose(out, x)
+    out = hvd.allreduce(x, average=False)
+    assert torch.allclose(out, x)
+
+
+def test_allreduce_inplace_and_async():
+    x = torch.ones(4)
+    handle = hvd.allreduce_async_(x, average=True)
+    assert hvd.poll(handle)
+    out = hvd.synchronize(handle)
+    assert torch.allclose(out, torch.ones(4))
+
+
+def test_allreduce_grad():
+    x = torch.randn(3, requires_grad=True)
+    y = hvd.allreduce(x, average=False).sum()
+    y.backward()
+    assert torch.allclose(x.grad, torch.ones(3))
+
+
+def test_allgather_size1():
+    x = torch.randn(2, 3)
+    out = hvd.allgather(x)
+    assert torch.allclose(out, x)
+
+
+def test_allgather_grad():
+    x = torch.randn(2, 3, requires_grad=True)
+    hvd.allgather(x).sum().backward()
+    assert torch.allclose(x.grad, torch.ones(2, 3))
+
+
+def test_broadcast_size1_and_grad():
+    x = torch.randn(4, requires_grad=True)
+    out = hvd.broadcast(x, root_rank=0)
+    out.sum().backward()
+    assert torch.allclose(x.grad, torch.ones(4))
+    with pytest.raises(ValueError):
+        hvd.broadcast(x, root_rank=5)
+
+
+def test_fp16_compression_roundtrip():
+    x = torch.randn(8)
+    out = hvd.allreduce(x, compression=hvd.Compression.fp16)
+    assert out.dtype == torch.float32
+    assert torch.allclose(out, x, atol=1e-2)
+
+
+def test_bf16_tensor_allreduce():
+    x = torch.ones(16, dtype=torch.bfloat16)
+    out = hvd.allreduce(x)
+    assert out.dtype == torch.bfloat16
+    assert torch.allclose(out.float(), torch.ones(16))
+
+
+def test_distributed_optimizer_matches_plain_sgd():
+    torch.manual_seed(0)
+    model1 = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                                 torch.nn.Linear(8, 1))
+    model2 = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                                 torch.nn.Linear(8, 1))
+    model2.load_state_dict(model1.state_dict())
+
+    opt1 = torch.optim.SGD(model1.parameters(), lr=0.1, momentum=0.9)
+    opt2 = hvd.DistributedOptimizer(
+        torch.optim.SGD(model2.parameters(), lr=0.1, momentum=0.9),
+        named_parameters=model2.named_parameters(),
+    )
+    assert isinstance(opt2, torch.optim.SGD)
+
+    X = torch.randn(16, 4)
+    Y = torch.randn(16, 1)
+    for _ in range(3):
+        for opt, model in ((opt1, model1), (opt2, model2)):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(X), Y)
+            loss.backward()
+            opt.step()
+    for p1, p2 in zip(model1.parameters(), model2.parameters()):
+        assert torch.allclose(p1, p2, atol=1e-6)
+
+
+def test_force_allreduce_params_without_grad():
+    """Params not touched by the loss still get allreduced in step() —
+    no deadlock (reference test_torch.py test_force_allreduce)."""
+    model = torch.nn.ModuleDict({
+        "used": torch.nn.Linear(2, 1),
+        "unused": torch.nn.Linear(2, 1),
+    })
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+    )
+    opt.zero_grad()
+    loss = model["used"](torch.randn(4, 2)).sum()
+    loss.backward()
+    opt.step()  # must not hang or raise
+    assert model["unused"].weight.grad is not None
+
+
+def test_broadcast_parameters_state_dict():
+    model = torch.nn.Linear(3, 2)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+
+@pytest.mark.parametrize("opt_cls,kwargs", [
+    (torch.optim.SGD, dict(lr=0.1, momentum=0.9)),
+    (torch.optim.Adam, dict(lr=1e-3)),
+    (torch.optim.AdamW, dict(lr=1e-3)),
+    (torch.optim.RMSprop, dict(lr=1e-3)),
+    (torch.optim.Adagrad, dict(lr=1e-2)),
+])
+def test_broadcast_optimizer_state(opt_cls, kwargs):
+    """State broadcast works for the torch.optim family with and without a
+    prior step (reference test_torch.py:734-936)."""
+    model = torch.nn.Linear(3, 2)
+    opt = opt_cls(model.parameters(), **kwargs)
+    # No prior step: state must be materialized internally.
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    assert len(opt.state_dict()["state"]) > 0
+    # After real steps too.
+    loss = model(torch.randn(5, 3)).sum()
+    loss.backward()
+    opt.step()
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    # Types preserved (lr stays float, step counts stay usable).
+    for group in opt.param_groups:
+        assert isinstance(group["lr"], float)
+
+
+def test_broadcast_optimizer_state_lbfgs_rejected():
+    model = torch.nn.Linear(2, 1)
+    opt = torch.optim.LBFGS(model.parameters())
+    with pytest.raises(ValueError):
+        hvd.broadcast_optimizer_state(opt)

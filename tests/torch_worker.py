@@ -1,0 +1,97 @@
+"""Multi-process torch-frontend worker (launched by
+test_torch_multiproc.py; identity via HOROVOD_RANK/SIZE/COORDINATOR env)."""
+
+import os
+import sys
+
+import numpy as np
+import torch
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def scenario_ops(rank, size):
+    # allreduce identity: sum of rank+1 over ranks.
+    x = torch.full((6, 2), float(rank + 1))
+    out = hvd.allreduce(x, average=False)
+    assert torch.allclose(out, torch.full((6, 2),
+                                          float(size * (size + 1) / 2))), out
+    # in-place average
+    y = torch.full((4,), float(rank))
+    hvd.allreduce_(y, average=True)
+    assert torch.allclose(y, torch.full((4,), (size - 1) / 2.0)), y
+    # allgather with unequal dim0
+    g = torch.full((rank + 1, 3), float(rank))
+    gat = hvd.allgather(g)
+    assert gat.shape == (size * (size + 1) // 2, 3)
+    # broadcast from each root
+    for root in range(size):
+        b = torch.arange(5, dtype=torch.float32) * (rank + 1)
+        out = hvd.broadcast(b, root_rank=root)
+        assert torch.allclose(out, torch.arange(5, dtype=torch.float32)
+                              * (root + 1))
+
+
+def scenario_optimizer(rank, size):
+    # Each rank different data; after DistributedOptimizer steps the models
+    # must be bit-identical across ranks (the whole point of data-parallel
+    # gradient averaging).
+    torch.manual_seed(42)  # same init on all ranks
+    model = torch.nn.Sequential(torch.nn.Linear(4, 16), torch.nn.Tanh(),
+                                torch.nn.Linear(16, 1))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05, momentum=0.9),
+        named_parameters=model.named_parameters(),
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    torch.manual_seed(1000 + rank)  # different data per rank
+    for _ in range(4):
+        X, Y = torch.randn(8, 4), torch.randn(8, 1)
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(model(X), Y).backward()
+        opt.step()
+    # Cross-rank equality check via allgather of a param hash vector.
+    flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+    gathered = hvd.allgather(flat.reshape(1, -1))
+    for r in range(size):
+        assert torch.allclose(gathered[r], flat, atol=0), (
+            f"rank {rank}: params diverged from rank {r}")
+
+
+def scenario_state_bcast(rank, size):
+    # Optimizer state must equalize across ranks after broadcast
+    # (reference test_broadcast_state).
+    torch.manual_seed(7 + rank)  # deliberately different init
+    model = torch.nn.Linear(3, 3)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3 * (rank + 1))
+    model(torch.randn(4, 3)).sum().backward()
+    opt.step()
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    assert opt.param_groups[0]["lr"] == 1e-3  # root's lr won
+    flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+    gathered = hvd.allgather(flat.reshape(1, -1))
+    for r in range(size):
+        assert torch.allclose(gathered[r], flat)
+
+
+SCENARIOS = {
+    "ops": scenario_ops,
+    "optimizer": scenario_optimizer,
+    "state_bcast": scenario_state_bcast,
+}
+
+
+def main():
+    scenario = sys.argv[1]
+    hvd.init()
+    SCENARIOS[scenario](hvd.rank(), hvd.size())
+    hvd.shutdown()
+    print(f"torch worker rank={os.environ['HOROVOD_RANK']} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
