@@ -1,0 +1,119 @@
+"""Flax frontend — the Keras-role layer of the framework.
+
+Reference parity: ``horovod/keras`` + ``horovod/tensorflow/keras``
+(P8-P10 in SURVEY.md §2.2): optimizer wrapping, the four callbacks,
+``load_model``-style checkpoint restore, metric averaging.  Keras's
+``model.fit`` becomes :func:`fit` — a callback-orchestrated epoch loop over
+a user-supplied jitted train step; flax's ``TrainState`` plays the role of
+the compiled Keras model (params + optimizer + step in one pytree).
+
+Typical use::
+
+    import horovod_tpu.flax as hvdk
+    import horovod_tpu.jax as hvd
+
+    hvd.init()
+    opt = optax.inject_hyperparams(optax.sgd)(
+        learning_rate=0.01 * hvd.num_chips(), momentum=0.9)
+    state = TrainState.create(apply_fn=model.apply, params=params,
+                              tx=hvd.DistributedOptimizer(opt))
+    state = hvdk.fit(
+        state, data_fn, epochs=90, steps_per_epoch=spe,
+        train_step=step,
+        callbacks=[
+            hvdk.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvdk.callbacks.MetricAverageCallback(),
+            hvdk.callbacks.LearningRateWarmupCallback(0.01, 5,
+                                                      steps_per_epoch=spe),
+        ])
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional, Sequence
+
+from horovod_tpu.common import (
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    rank,
+    shutdown,
+    size,
+)
+from horovod_tpu.flax import callbacks
+from horovod_tpu.flax.callbacks import (
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    get_learning_rate,
+    set_learning_rate,
+)
+from horovod_tpu.flax.checkpoint import (
+    latest_checkpoint,
+    load_checkpoint,
+    restore_and_broadcast,
+    resume_epoch,
+    save_checkpoint,
+)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size",
+    "callbacks", "Callback",
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+    "get_learning_rate", "set_learning_rate",
+    "save_checkpoint", "load_checkpoint", "latest_checkpoint",
+    "resume_epoch", "restore_and_broadcast",
+    "fit",
+]
+
+
+def fit(state, data_fn, *, epochs: int, train_step: Callable,
+        steps_per_epoch: Optional[int] = None,
+        callbacks: Sequence[Callback] = (),
+        initial_epoch: int = 0, verbose: Optional[bool] = None):
+    """Callback-orchestrated training loop (the ``model.fit`` role).
+
+    ``data_fn(epoch) -> iterable of batches`` (or a re-iterable passed
+    directly); ``train_step(state, batch) -> (state, logs)`` is the user's
+    jitted step.  Callbacks receive functional hooks in Keras order.
+    Rank 0 prints per-epoch logs when ``verbose`` (default: rank 0 only).
+    """
+    import horovod_tpu.jax as hvd
+
+    if verbose is None:
+        verbose = hvd.rank() == 0
+
+    cbs = list(callbacks)
+    for cb in cbs:
+        state = cb.on_train_begin(state)
+    for epoch in range(initial_epoch, epochs):
+        for cb in cbs:
+            state = cb.on_epoch_begin(epoch, state)
+        batches = data_fn(epoch) if callable(data_fn) else data_fn
+        logs: dict = {}
+        n_batches = 0
+        for batch_idx, batch in enumerate(batches):
+            if steps_per_epoch is not None and batch_idx >= steps_per_epoch:
+                break
+            for cb in cbs:
+                state = cb.on_batch_begin(epoch, batch_idx, state)
+            state, step_logs = train_step(state, batch)
+            n_batches += 1
+            for k, v in dict(step_logs).items():
+                logs[k] = logs.get(k, 0.0) + float(v)
+            for cb in cbs:
+                state = cb.on_batch_end(epoch, batch_idx, state, step_logs)
+        logs = {k: v / max(n_batches, 1) for k, v in logs.items()}
+        for cb in cbs:
+            state = cb.on_epoch_end(epoch, state, logs)
+        if verbose:
+            rendered = ", ".join(f"{k}={v:.4f}" for k, v in logs.items())
+            print(f"Epoch {epoch + 1}/{epochs}: {rendered}", flush=True)
+    for cb in cbs:
+        state = cb.on_train_end(state)
+    return state

@@ -1,0 +1,260 @@
+"""Training callbacks — the Keras callback stack, flax-style.
+
+Reference parity: ``horovod/keras/callbacks.py`` + ``callbacks_impl.py``
+(317 LoC): ``BroadcastGlobalVariablesCallback``, ``MetricAverageCallback``,
+``LearningRateScheduleCallback`` (staircase or smooth, with momentum
+correction), ``LearningRateWarmupCallback`` (Goyal et al. linear warmup).
+
+TPU-native design: flax has no ``model.fit``, so callbacks plug into the
+``horovod_tpu.flax.fit`` loop and are *functional*: each hook takes and
+returns the train state.  Learning-rate control uses
+``optax.inject_hyperparams`` state (the optax-idiomatic mutable-lr
+mechanism) instead of mutating a tf Variable; momentum correction rescales
+the SGD trace by new_lr/old_lr exactly as the reference does to keep the
+effective update magnitude continuous across lr steps
+(callbacks_impl.py:70-147).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+__all__ = [
+    "Callback",
+    "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback",
+    "LearningRateScheduleCallback",
+    "LearningRateWarmupCallback",
+    "get_learning_rate",
+    "set_learning_rate",
+]
+
+
+class Callback:
+    """Hook protocol for ``horovod_tpu.flax.fit``.  All hooks are
+    functional: they receive the ``TrainState`` and return it (possibly
+    updated)."""
+
+    def on_train_begin(self, state):
+        return state
+
+    def on_epoch_begin(self, epoch: int, state):
+        return state
+
+    def on_batch_begin(self, epoch: int, batch: int, state):
+        return state
+
+    def on_batch_end(self, epoch: int, batch: int, state, logs: dict):
+        return state
+
+    def on_epoch_end(self, epoch: int, state, logs: dict):
+        return state
+
+    def on_train_end(self, state):
+        return state
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate state plumbing (optax.inject_hyperparams)
+# ---------------------------------------------------------------------------
+
+def _find_hyperparams(opt_state):
+    """Locate InjectHyperparamsState dicts holding 'learning_rate'."""
+    found = []
+
+    def visit(s):
+        hp = getattr(s, "hyperparams", None)
+        if isinstance(hp, dict) and "learning_rate" in hp:
+            found.append(s)
+        if isinstance(s, tuple) and not hasattr(s, "hyperparams"):
+            for item in s:
+                visit(item)
+
+    visit(opt_state)
+    return found
+
+
+def get_learning_rate(opt_state) -> float:
+    states = _find_hyperparams(opt_state)
+    if not states:
+        raise ValueError(
+            "optimizer state carries no mutable learning_rate; build the "
+            "optimizer with optax.inject_hyperparams, e.g. "
+            "optax.inject_hyperparams(optax.sgd)(learning_rate=0.01)"
+        )
+    return float(states[0].hyperparams["learning_rate"])
+
+
+def set_learning_rate(opt_state, lr: float):
+    """Return opt_state with learning_rate replaced (functional)."""
+    states = _find_hyperparams(opt_state)
+    if not states:
+        raise ValueError(
+            "optimizer state carries no mutable learning_rate; build the "
+            "optimizer with optax.inject_hyperparams"
+        )
+
+    def replace(s):
+        if getattr(s, "hyperparams", None) is not None and \
+                "learning_rate" in s.hyperparams:
+            hp = dict(s.hyperparams)
+            hp["learning_rate"] = jnp.asarray(
+                lr, dtype=jnp.asarray(hp["learning_rate"]).dtype)
+            return s._replace(hyperparams=hp)
+        if isinstance(s, tuple) and not hasattr(s, "hyperparams") and \
+                not hasattr(s, "_fields"):
+            return tuple(replace(item) for item in s)
+        return s
+
+    return replace(opt_state)
+
+
+def _scale_momentum(opt_state, factor: float):
+    """Momentum correction: scale SGD trace by new_lr/old_lr (reference
+    callbacks_impl.py:81-91 restarts momentum at the corrected magnitude)."""
+
+    def visit(s):
+        if isinstance(s, optax.TraceState):
+            return s._replace(
+                trace=jax.tree.map(lambda t: t * factor, s.trace))
+        if hasattr(s, "inner_state"):
+            return s._replace(inner_state=visit(s.inner_state))
+        if isinstance(s, tuple) and not hasattr(s, "_fields"):
+            return tuple(visit(item) for item in s)
+        return s
+
+    return visit(opt_state)
+
+
+# ---------------------------------------------------------------------------
+# Callbacks
+# ---------------------------------------------------------------------------
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Sync initial params + optimizer state from ``root_rank`` at train
+    start (reference callbacks_impl.py:20-30 / TF hook
+    tensorflow/__init__.py:101-132)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state):
+        import horovod_tpu.jax as hvd
+
+        params = hvd.broadcast_parameters(state.params, self.root_rank)
+        opt_state = hvd.broadcast_optimizer_state(state.opt_state,
+                                                  self.root_rank)
+        return state.replace(params=params, opt_state=opt_state)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over all processes before reporting
+    (reference callbacks_impl.py:33-67)."""
+
+    def on_epoch_end(self, epoch: int, state, logs: dict):
+        import horovod_tpu.jax as hvd
+
+        for key in list(logs.keys()):
+            value = logs[key]
+            if isinstance(value, (int, float, np.floating, jnp.ndarray,
+                                  np.ndarray)):
+                logs[key] = float(np.asarray(
+                    hvd.allreduce(jnp.asarray(value, jnp.float32),
+                                  op=hvd.Average, name=f"metric.{key}")))
+        return state
+
+
+class LearningRateScheduleCallback(Callback):
+    """Epoch-windowed LR multiplier, staircase or per-batch smooth, with
+    momentum correction (reference callbacks_impl.py:70-147).
+
+    ``multiplier``: constant or ``f(epoch) -> factor`` applied to
+    ``initial_lr``.  With ``staircase=False``, ``epoch`` is fractional
+    (epoch + batch/steps_per_epoch) and the lr updates every batch.
+    """
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+        self._last_lr: Optional[float] = None
+
+    def _in_window(self, epoch: int) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _apply(self, state, epoch: float):
+        lr = self.initial_lr * self.multiplier(epoch)
+        old = self._last_lr
+        opt_state = set_learning_rate(state.opt_state, lr)
+        if self.momentum_correction and old is not None and old > 0 \
+                and lr != old:
+            opt_state = _scale_momentum(opt_state, lr / old)
+        self._last_lr = lr
+        return state.replace(opt_state=opt_state)
+
+    def on_epoch_begin(self, epoch: int, state):
+        if self.staircase and self._in_window(epoch):
+            return self._apply(state, epoch)
+        return state
+
+    def on_batch_begin(self, epoch: int, batch: int, state):
+        if not self.staircase and self._in_window(epoch):
+            if self.steps_per_epoch is None:
+                raise ValueError(
+                    "staircase=False requires steps_per_epoch")
+            return self._apply(state, epoch + batch / self.steps_per_epoch)
+        return state
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warmup from lr/size to lr over ``warmup_epochs`` (Goyal et
+    al., reference callbacks_impl.py:149-168): at the start of large-batch
+    training each process's lr ramps so the size-scaled rate arrives after
+    warmup instead of at step 0."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None,
+                 verbose: bool = False):
+        import horovod_tpu.jax as hvd
+
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        size = hvd.size() if hvd.is_initialized() else 1
+        n = max(hvd.num_chips(), size)
+
+        def multiplier(epoch: float) -> float:
+            if epoch >= warmup_epochs:
+                return 1.0
+            # epoch/warmup linear ramp from 1/n to 1.
+            return 1.0 / n * (epoch * (n - 1) / warmup_epochs + 1)
+
+        super().__init__(initial_lr, multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs + 1, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch: int, state, logs: dict):
+        if self.verbose and epoch < self.warmup_epochs \
+                and self._last_lr is not None:
+            print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                  f"warmup to {self._last_lr:.6g}.")
+        return state

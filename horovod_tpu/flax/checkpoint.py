@@ -1,0 +1,108 @@
+"""Checkpoint / resume: the rank-0-writes, broadcast-on-resume pattern.
+
+Reference parity: checkpointing in the reference is an application-level
+pattern, not a library feature (SURVEY.md §5.4): rank 0 alone writes
+(``examples/keras_imagenet_resnet50.py:156-158``), the resume epoch is
+discovered on rank 0 and broadcast (``keras_imagenet_resnet50.py:64-73``),
+and state re-syncs via broadcast / ``hvd.load_model``
+(``keras/impl.py:93-109``).  Here the pattern is a library feature built on
+orbax (the TPU-native checkpointing stack) with flax.serialization msgpack
+as the in-file format for portability.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "latest_checkpoint",
+    "resume_epoch",
+    "restore_and_broadcast",
+]
+
+
+def _ckpt_path(directory: str, epoch: int) -> str:
+    return os.path.join(directory, f"checkpoint-{epoch}.msgpack")
+
+
+def save_checkpoint(directory: str, state: Any, epoch: int,
+                    *, only_rank0: bool = True) -> Optional[str]:
+    """Serialize ``state`` (any pytree / flax TrainState) for ``epoch``.
+
+    Writes on rank 0 only by default — the reference's pattern
+    (examples/tensorflow_mnist.py:106-108).  Returns the path (or None on
+    non-writing ranks).
+    """
+    import horovod_tpu.jax as hvd
+
+    if only_rank0 and hvd.rank() != 0:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = _ckpt_path(directory, epoch)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(jax.device_get(state)))
+    os.replace(tmp, path)  # atomic: resume never sees partial files
+    return path
+
+
+def latest_checkpoint(directory: str) -> Optional[tuple[str, int]]:
+    """(path, epoch) of the newest checkpoint, or None."""
+    if not os.path.isdir(directory):
+        return None
+    best = None
+    for fname in os.listdir(directory):
+        m = re.fullmatch(r"checkpoint-(\d+)\.msgpack", fname)
+        if m:
+            epoch = int(m.group(1))
+            if best is None or epoch > best[1]:
+                best = (os.path.join(directory, fname), epoch)
+    return best
+
+
+def load_checkpoint(path: str, target: Any) -> Any:
+    """Deserialize into the structure of ``target``."""
+    with open(path, "rb") as f:
+        return serialization.from_bytes(target, f.read())
+
+
+def resume_epoch(directory: str) -> int:
+    """Discover the resume epoch on rank 0 and broadcast it so all ranks
+    agree even when the filesystem is not shared (reference
+    keras_imagenet_resnet50.py:64-73).  Returns 0 when starting fresh."""
+    import horovod_tpu.jax as hvd
+    import jax.numpy as jnp
+
+    found = latest_checkpoint(directory) if hvd.rank() == 0 else None
+    epoch = 0 if found is None else found[1] + 1
+    agreed = hvd.broadcast(jnp.asarray(epoch, jnp.int32), root_rank=0,
+                           name="resume_epoch")
+    return int(np.asarray(agreed))
+
+
+def restore_and_broadcast(directory: str, target: Any,
+                          *, root_rank: int = 0) -> tuple[Any, int]:
+    """Full resume: rank 0 loads the newest checkpoint, every rank receives
+    it by broadcast, and the next epoch index is agreed globally.
+
+    Returns ``(state, start_epoch)``; ``(target, 0)`` if no checkpoint.
+    """
+    import horovod_tpu.jax as hvd
+
+    start_epoch = resume_epoch(directory)
+    if start_epoch == 0:
+        return target, 0
+    state = target
+    if hvd.rank() == root_rank:
+        found = latest_checkpoint(directory)
+        state = load_checkpoint(found[0], target)
+    state = hvd.broadcast_parameters(state, root_rank=root_rank)
+    return state, start_epoch

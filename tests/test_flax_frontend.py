@@ -1,0 +1,178 @@
+"""Flax (Keras-role) frontend tests: fit loop, callbacks, checkpointing.
+
+Mirrors reference test_keras.py semantics: training smoke through the
+callback stack, lr schedule values, load/save round trips with resume.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax.training.train_state import TrainState
+
+import horovod_tpu.flax as hvdk
+import horovod_tpu.jax as hvd
+from horovod_tpu.models import MnistMLP
+
+
+def _make_state(lr=0.1, momentum=0.9):
+    model = MnistMLP(dtype=jnp.float32, hidden=16)
+    x = jnp.zeros((2, 28, 28, 1))
+    params = model.init(jax.random.key(0), x)["params"]
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=lr,
+                                             momentum=momentum)
+    return model, TrainState.create(apply_fn=model.apply, params=params,
+                                    tx=tx)
+
+
+def _train_step(model):
+    @jax.jit
+    def step(state, batch):
+        x, y = batch
+
+        def loss_fn(params):
+            logits = model.apply({"params": params}, x)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), {"loss": loss}
+
+    return step
+
+
+def _data(n=4, batch=8):
+    rng = np.random.default_rng(0)
+    return [
+        (jnp.asarray(rng.standard_normal((batch, 28, 28, 1),
+                                         dtype=np.float32)),
+         jnp.asarray(rng.integers(0, 10, batch)))
+        for _ in range(n)
+    ]
+
+
+def test_fit_trains_and_reports(capsys):
+    model, state = _make_state()
+    step = _train_step(model)
+    data = _data(6)
+    state = hvdk.fit(state, lambda e: data, epochs=3, train_step=step,
+                     callbacks=[hvdk.MetricAverageCallback()], verbose=True)
+    out = capsys.readouterr().out
+    assert "Epoch 3/3" in out and "loss=" in out
+    assert int(state.step) == 18
+
+
+def test_broadcast_callback_identity_size1():
+    model, state = _make_state()
+    step = _train_step(model)
+    state2 = hvdk.fit(state, lambda e: _data(1), epochs=1, train_step=step,
+                      callbacks=[hvdk.BroadcastGlobalVariablesCallback(0)],
+                      verbose=False)
+    assert int(state2.step) == 1
+
+
+def test_get_set_learning_rate():
+    _, state = _make_state(lr=0.05)
+    assert hvdk.get_learning_rate(state.opt_state) == pytest.approx(0.05)
+    new = hvdk.set_learning_rate(state.opt_state, 0.01)
+    assert hvdk.get_learning_rate(new) == pytest.approx(0.01)
+    # Un-injected optimizer raises a useful error.
+    plain = optax.sgd(0.1).init({"w": jnp.zeros(2)})
+    with pytest.raises(ValueError, match="inject_hyperparams"):
+        hvdk.get_learning_rate(plain)
+
+
+def test_lr_schedule_staircase():
+    model, state = _make_state(lr=1.0)
+    step = _train_step(model)
+    cb = hvdk.LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** (e // 2),
+        momentum_correction=False)
+    seen = []
+
+    class Spy(hvdk.Callback):
+        def on_epoch_begin(self, epoch, state):
+            seen.append(hvdk.get_learning_rate(state.opt_state))
+            return state
+
+    hvdk.fit(state, lambda e: _data(1), epochs=5, train_step=step,
+             callbacks=[cb, Spy()], verbose=False)
+    assert seen == pytest.approx([1.0, 1.0, 0.1, 0.1, 0.01])
+
+
+def test_lr_warmup_ramps_to_full():
+    model, state = _make_state(lr=0.8)
+    step = _train_step(model)
+    cb = hvdk.LearningRateWarmupCallback(initial_lr=0.8, warmup_epochs=2,
+                                         steps_per_epoch=3,
+                                         momentum_correction=True)
+    lrs = []
+
+    class Spy(hvdk.Callback):
+        def on_batch_end(self, epoch, batch, state, logs):
+            lrs.append(hvdk.get_learning_rate(state.opt_state))
+            return state
+
+    hvdk.fit(state, lambda e: _data(3), epochs=4, train_step=step,
+             steps_per_epoch=3, callbacks=[cb, Spy()], verbose=False)
+    n = hvd.num_chips()
+    assert lrs[0] == pytest.approx(0.8 / n)
+    # After warmup the full rate holds.
+    assert lrs[-1] == pytest.approx(0.8)
+    assert all(b >= a - 1e-9 for a, b in zip(lrs, lrs[1:])), lrs
+
+
+def test_momentum_correction_scales_trace():
+    _, state = _make_state(lr=1.0)
+    # Seed a fake momentum trace.
+    from horovod_tpu.flax.callbacks import _scale_momentum
+
+    grads = jax.tree.map(jnp.ones_like, state.params)
+    state = state.apply_gradients(grads=grads)
+    before = jax.tree.leaves(state.opt_state)[0]
+    scaled = _scale_momentum(state.opt_state, 0.5)
+
+    def traces(s):
+        import optax as ox
+        out = []
+
+        def visit(x):
+            if isinstance(x, ox.TraceState):
+                out.append(x.trace)
+            elif hasattr(x, "inner_state"):
+                visit(x.inner_state)
+            elif isinstance(x, tuple) and not hasattr(x, "_fields"):
+                for i in x:
+                    visit(i)
+        visit(s)
+        return out
+
+    t0 = traces(state.opt_state)
+    t1 = traces(scaled)
+    assert t0 and t1
+    for a, b in zip(jax.tree.leaves(t0), jax.tree.leaves(t1)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a) * 0.5,
+                                   rtol=1e-6)
+
+
+def test_checkpoint_save_load_resume(tmp_path):
+    model, state = _make_state()
+    step = _train_step(model)
+    data = _data(2)
+    state = hvdk.fit(state, lambda e: data, epochs=2, train_step=step,
+                     verbose=False)
+    path = hvdk.save_checkpoint(str(tmp_path), state, epoch=1)
+    assert path is not None
+
+    # Fresh state restores to the trained one.
+    _, fresh = _make_state()
+    restored, start_epoch = hvdk.restore_and_broadcast(str(tmp_path), fresh)
+    assert start_epoch == 2
+    for a, b in zip(jax.tree.leaves(state.params),
+                    jax.tree.leaves(restored.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    # Empty dir → fresh start.
+    _, epoch0 = hvdk.restore_and_broadcast(str(tmp_path / "none"), fresh)
+    assert epoch0 == 0
