@@ -79,11 +79,12 @@ class RMSNorm(nn.Module):
 
 
 def rope_freqs(head_dim: int, seq_len: int, theta: float,
-               offset: int = 0) -> tuple[jax.Array, jax.Array]:
-    """cos/sin tables [S, head_dim/2] in fp32."""
+               offset=0) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [S, head_dim/2] in fp32.  ``offset`` may be a traced
+    value (sequence-parallel shards pass ``axis_index * S_local``)."""
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                            / head_dim))
-    t = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    t = jnp.arange(seq_len, dtype=jnp.float32) + offset
     ang = jnp.outer(t, inv)
     return jnp.cos(ang), jnp.sin(ang)
 

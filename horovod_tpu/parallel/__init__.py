@@ -29,3 +29,34 @@ __all__ = [
     "set_default_mesh",
     "use_mesh",
 ]
+
+from horovod_tpu.parallel.api import (
+    SHARDING_RULES,
+    infer_param_spec,
+    lm_loss_fn,
+    make_parallel_train_step,
+    param_shardings,
+    shard_params,
+)
+from horovod_tpu.parallel.pipeline import (
+    init_pipelined_llama,
+    make_pipelined_llama_train_step,
+    pipeline_apply,
+    stack_pytrees,
+    unstack_pytree,
+)
+from horovod_tpu.parallel.ring_attention import (
+    make_ring_attention_fn,
+    ring_attention,
+    ulysses_attention,
+)
+from horovod_tpu.parallel.seq import make_context_parallel_train_step
+
+__all__ += [
+    "SHARDING_RULES", "infer_param_spec", "lm_loss_fn",
+    "make_parallel_train_step", "param_shardings", "shard_params",
+    "init_pipelined_llama", "make_pipelined_llama_train_step",
+    "pipeline_apply", "stack_pytrees", "unstack_pytree",
+    "make_ring_attention_fn", "ring_attention", "ulysses_attention",
+    "make_context_parallel_train_step",
+]

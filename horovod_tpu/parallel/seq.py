@@ -1,0 +1,100 @@
+"""Context-parallel (sequence-parallel) LM training.
+
+Builds a shard_map train step where the *sequence* dimension is sharded
+over the ``seq`` mesh axis and attention runs as ring attention
+(``horovod_tpu.parallel.ring_attention``), composing with data parallelism
+on the batch axes.  This is the long-context training path: activation
+memory per chip scales as S/seq_size, KV blocks ride nearest-neighbor ICI.
+
+No reference equivalent (SURVEY.md §5.7: the reference predates sequence
+parallelism); TPU-native new work.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.models.llama import LlamaConfig, LlamaModel
+from horovod_tpu.parallel.ring_attention import make_ring_attention_fn
+
+__all__ = ["make_context_parallel_train_step"]
+
+
+def make_context_parallel_train_step(cfg: LlamaConfig, optimizer,
+                                     mesh: Mesh, *,
+                                     seq_axis: str = "seq",
+                                     attention: str = "ring",
+                                     donate: bool = True):
+    """Jitted LM train step with sequence sharded over ``seq_axis`` and
+    batch sharded over the data-like axes.
+
+    ``step(params, opt_state, inputs, targets) ->
+    (params, opt_state, loss)`` where inputs/targets are [B, S] token ids
+    (S divisible by the seq-axis size, B by the data axes' product).
+    ``attention``: "ring" (blockwise ppermute ring) or "ulysses"
+    (all-to-all head scatter).
+    """
+    import optax
+
+    from horovod_tpu.jax import DistributedOptimizer
+    from horovod_tpu.parallel.mesh import data_axes
+    from horovod_tpu.parallel.ring_attention import ulysses_attention
+
+    if attention == "ring":
+        attention_fn = make_ring_attention_fn(seq_axis)
+    elif attention == "ulysses":
+        def attention_fn(q, k, v, *a, **kw):
+            return ulysses_attention(q, k, v, axis_name=seq_axis)
+    else:
+        raise ValueError(f"unknown attention {attention!r}")
+
+    model = LlamaModel(cfg, attention_fn=attention_fn)
+    batch_axes = data_axes(mesh) or ()
+    reduce_axes = tuple(batch_axes) + (seq_axis,)
+
+    from horovod_tpu.ops.collective_ops import Sum
+
+    # Per-shard gradients are partial SUMS of the global token mean (each
+    # shard holds different tokens), so the cross-shard reduction must be
+    # SUM, not average.
+    inner = optimizer.inner if isinstance(optimizer, DistributedOptimizer) \
+        else optimizer
+    optimizer = DistributedOptimizer(inner, axis_name=reduce_axes, op=Sum)
+
+    def _local_loss(params, inputs, targets):
+        offset = lax.axis_index(seq_axis) * inputs.shape[1]
+        logits = model.apply(params, inputs, positions_offset=offset)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        # Local *sum*; the mean denominator is the global token count so
+        # the psum over data+seq axes reconstructs the global mean.
+        return jnp.sum(nll)
+
+    def _step(params, opt_state, inputs, targets):
+        n_global = (inputs.shape[0] * lax.axis_size(batch_axes)
+                    if batch_axes else inputs.shape[0])
+        s_global = inputs.shape[1] * lax.axis_size(seq_axis)
+        denom = n_global * s_global
+        loss_sum, grads = jax.value_and_grad(_local_loss)(
+            params, inputs, targets)
+        grads = jax.tree.map(lambda g: g / denom, grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = lax.psum(loss_sum, reduce_axes) / denom
+        return params, opt_state, loss
+
+    batch_spec = P(tuple(batch_axes) if batch_axes else None, seq_axis)
+    step = jax.shard_map(
+        _step,
+        mesh=mesh,
+        in_specs=(P(), P(), batch_spec, batch_spec),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    donate_args = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_args)

@@ -1,0 +1,105 @@
+"""Context-parallel and pipelined Llama train steps: equivalence with the
+plain single-shard training step."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu.jax as hvd
+from horovod_tpu.models import LlamaConfig, LlamaModel
+from horovod_tpu.parallel.pipeline import (
+    init_pipelined_llama,
+    make_pipelined_llama_train_step,
+)
+from horovod_tpu.parallel.seq import make_context_parallel_train_step
+
+
+def _cfg(num_layers=2):
+    return dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                               num_layers=num_layers)
+
+
+def _dense_reference(cfg, params, tokens, lr=0.01):
+    """One plain SGD LM step on a single device."""
+    model = LlamaModel(cfg)
+
+    def loss_fn(params):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = model.apply(params, inputs)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.mean(-jnp.take_along_axis(logp, targets[..., None], -1))
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    opt = optax.sgd(lr)
+    updates, _ = opt.update(grads, opt.init(params), params)
+    return loss, optax.apply_updates(params, updates)
+
+
+def _tokens(cfg, B=4, S=33, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).integers(
+        0, cfg.vocab_size, (B, S), dtype=np.int32))
+
+
+@pytest.mark.parametrize("attention", ["ring", "ulysses"])
+def test_context_parallel_step_matches_dense(n_devices, attention):
+    cfg = _cfg()
+    tokens = _tokens(cfg, B=4, S=33)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0), tokens[:, :-1])
+    loss0, params0 = _dense_reference(cfg, params, tokens)
+
+    # ulysses shards heads over seq: tiny cfg has 2 kv heads, so seq<=2.
+    seq_size = 4 if attention == "ring" else 2
+    mesh = hvd.build_mesh({"data": 2, "seq": seq_size},
+                          devices=jax.devices()[:2 * seq_size])
+    step = make_context_parallel_train_step(
+        cfg, optax.sgd(0.01), mesh, attention=attention, donate=False)
+    opt_state = jax.jit(optax.sgd(0.01).init)(params)
+    params1, _, loss1 = step(params, opt_state, tokens[:, :-1],
+                             tokens[:, 1:])
+    assert np.asarray(loss1) == pytest.approx(float(loss0), abs=2e-5)
+    for a, b in zip(jax.tree.leaves(params0), jax.tree.leaves(params1)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def test_pipelined_llama_step_matches_dense(n_devices):
+    cfg = _cfg(num_layers=4)
+    tokens = _tokens(cfg, B=8, S=17)
+    # Dense reference needs params in the standard layout; build pipelined
+    # params first, then reassemble the dense layout from them.
+    pp = init_pipelined_llama(cfg, jax.random.key(0), n_stages=4)
+    dense_params = {"params": dict(pp["rest"])}
+    flat_stages = jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), pp["stages"])
+    for i in range(cfg.num_layers):
+        dense_params["params"][f"layer_{i}"] = jax.tree.map(
+            lambda a: a[i], flat_stages)
+    loss0, params0 = _dense_reference(cfg, dense_params, tokens)
+
+    mesh = hvd.build_mesh({"pipe": 4, "data": 2})
+    opt = optax.sgd(0.01)
+    step = make_pipelined_llama_train_step(
+        cfg, opt, mesh, n_microbatches=2, donate=False)
+    opt_state = jax.jit(opt.init)(pp)
+    pp1, _, loss1 = step(pp, opt_state, tokens[:, :-1], tokens[:, 1:])
+    assert np.asarray(loss1) == pytest.approx(float(loss0), abs=2e-5)
+
+    # Compare stage params against the dense-updated layers.
+    flat1 = jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), pp1["stages"])
+    for i in range(cfg.num_layers):
+        got_i = jax.tree.map(lambda a: a[i], flat1)
+        exp_i = params0["params"][f"layer_{i}"]
+        for a, b in zip(jax.tree.leaves(exp_i), jax.tree.leaves(got_i)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-5, rtol=1e-4)
+    for key in ("tok_emb", "norm_f", "lm_head"):
+        for a, b in zip(jax.tree.leaves(params0["params"][key]),
+                        jax.tree.leaves(pp1["rest"][key])):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=1e-5, rtol=1e-4)
