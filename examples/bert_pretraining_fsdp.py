@@ -1,0 +1,90 @@
+"""BERT pretraining with FSDP-style sharding — the baseline's transformer
+data-parallel workload (SURVEY.md §5.7: BASELINE adds a BERT FSDP config;
+no reference example exists — Horovod 0.15.1 predates BERT).
+
+Demonstrates the GSPMD path: parameters sharded over the ``fsdp`` axis
+(ZeRO-style), batch over ``data``×``fsdp``, XLA inserting the
+all-gather/reduce-scatter pairs the reference would have done with NCCL.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu.jax as hvd
+from examples.common import example_args
+from horovod_tpu.models import BertConfig, BertForPretraining
+from horovod_tpu.parallel.api import shard_params
+
+
+def main():
+    args = example_args("BERT pretraining (FSDP, synthetic)", batch_size=8,
+                        lr=1e-4, steps=40, seq_len=128, fsdp=-1)
+    hvd.init()
+    n = hvd.num_chips()
+    fsdp = n if args.fsdp == -1 else args.fsdp
+    mesh = hvd.build_mesh({"data": n // fsdp, "fsdp": fsdp})
+
+    cfg = BertConfig.tiny() if args.smoke else BertConfig.base()
+    seq = 32 if args.smoke else args.seq_len
+    steps = 4 if args.smoke else args.steps
+    model = BertForPretraining(cfg)
+
+    ids = jnp.zeros((args.batch_size, seq), jnp.int32)
+    params = jax.jit(lambda: model.init(jax.random.key(0), ids))()
+    params = shard_params(params, mesh)
+
+    opt = optax.adamw(args.lr)
+    opt_state = jax.jit(opt.init)(params)
+
+    def loss_fn(params, batch):
+        input_ids, mlm_labels, mask_positions, nsp_labels = batch
+        mlm_logits, nsp_logits = model.apply(params, input_ids,
+                                             train=False)
+        logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), -1)
+        mlm_nll = -jnp.take_along_axis(logp, mlm_labels[..., None], -1)
+        mlm_loss = jnp.sum(mlm_nll[..., 0] * mask_positions) / \
+            jnp.maximum(jnp.sum(mask_positions), 1.0)
+        nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), -1)
+        nsp_loss = -jnp.mean(
+            jnp.take_along_axis(nsp_logp, nsp_labels[:, None], -1))
+        return mlm_loss + nsp_loss
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        batch = jax.lax.with_sharding_constraint(
+            batch, NamedSharding(mesh, P(("data", "fsdp"))))
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    rng = np.random.default_rng(hvd.rank())
+    for i in range(steps):
+        input_ids = rng.integers(0, cfg.vocab_size,
+                                 (args.batch_size, seq), dtype=np.int32)
+        mask_positions = (rng.random((args.batch_size, seq)) < 0.15) \
+            .astype(np.float32)
+        mlm_labels = rng.integers(0, cfg.vocab_size,
+                                  (args.batch_size, seq), dtype=np.int32)
+        nsp_labels = rng.integers(0, 2, args.batch_size, dtype=np.int32)
+        params, opt_state, loss = step(
+            params, opt_state,
+            (jnp.asarray(input_ids), jnp.asarray(mlm_labels),
+             jnp.asarray(mask_positions), jnp.asarray(nsp_labels)))
+        if i % max(steps // 5, 1) == 0 and hvd.rank() == 0:
+            print(f"step {i}: loss={float(loss):.4f}", flush=True)
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,54 @@
+"""Shared helpers for the example scripts.
+
+Synthetic data stands in for MNIST/ImageNet downloads (examples must run
+in air-gapped CI; the reference downloads real datasets in its examples,
+which is orthogonal to what they demonstrate).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def example_args(description: str, **extra) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=description)
+    p.add_argument("--epochs", type=int, default=extra.pop("epochs", 4))
+    p.add_argument("--batch-size", type=int,
+                   default=extra.pop("batch_size", 64))
+    p.add_argument("--lr", type=float, default=extra.pop("lr", 0.01))
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes / few steps, for CI")
+    for name, default in extra.items():
+        arg = "--" + name.replace("_", "-")
+        if isinstance(default, bool):
+            p.add_argument(arg, action="store_true")
+        else:
+            p.add_argument(arg, type=type(default), default=default)
+    return p.parse_args()
+
+
+def synthetic_mnist(n: int = 2048, seed: int = 0):
+    """Deterministic stand-in for MNIST: class-dependent blobs, so models
+    actually learn (accuracy climbs above chance within an epoch)."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, n)
+    centers = rng.standard_normal((10, 28, 28, 1)).astype(np.float32)
+    images = centers[labels] + 0.3 * rng.standard_normal(
+        (n, 28, 28, 1)).astype(np.float32)
+    return images.astype(np.float32), labels.astype(np.int32)
+
+
+def synthetic_imagenet(n: int, size: int = 224, classes: int = 1000,
+                       seed: int = 0):
+    rng = np.random.default_rng(seed)
+    images = rng.standard_normal((n, size, size, 3)).astype(np.float32)
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    return images, labels
+
+
+def shard_for_rank(arrays, rank: int, size: int):
+    """1/N sampling per rank — the reference's DistributedSampler role
+    (examples/pytorch_mnist.py:50, keras_imagenet_resnet50.py:161-173)."""
+    return tuple(a[rank::size] for a in arrays)

@@ -1,0 +1,84 @@
+"""MNIST with the full callback stack — the flax/Keras-role workload.
+
+Role parity with reference ``examples/keras_mnist_advanced.py``: broadcast
+callback (ref :87), MetricAverage (:93), LR warmup (:98), rank-0
+checkpointing (:106); plus ``keras_mnist.py``'s epochs÷size convention
+(:25).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.training.train_state import TrainState
+
+import horovod_tpu.flax as hvdk
+import horovod_tpu.jax as hvd
+from examples.common import example_args, shard_for_rank, synthetic_mnist
+from horovod_tpu.models import MnistConvNet
+
+
+def main():
+    args = example_args("flax MNIST (full callback stack)",
+                        checkpoint_dir="")
+    hvd.init()
+    n = hvd.num_chips()
+
+    images, labels = synthetic_mnist(512 if args.smoke else 4096)
+    images, labels = shard_for_rank((images, labels), hvd.rank(), hvd.size())
+
+    model = MnistConvNet(dtype=jnp.float32)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))
+    # inject_hyperparams makes the LR visible to the schedule callbacks.
+    tx = optax.inject_hyperparams(optax.sgd)(
+        learning_rate=args.lr * n, momentum=0.9)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+    @jax.jit
+    def train_step(state, batch):
+        x, y = batch
+
+        def loss_fn(params):
+            logits = state.apply_fn(params, x)
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+            acc = jnp.mean(jnp.argmax(logits, -1) == y)
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        return state.apply_gradients(grads=grads), {"loss": loss,
+                                                    "accuracy": acc}
+
+    batch = args.batch_size
+    steps = max(len(images) // batch, 1)
+
+    def data_fn(epoch):
+        perm = np.random.default_rng(epoch).permutation(len(images))
+        for i in range(steps):
+            idx = perm[i * batch:(i + 1) * batch]
+            yield jnp.asarray(images[idx]), jnp.asarray(labels[idx])
+
+    epochs = 1 if args.smoke else args.epochs
+    callbacks = [
+        hvdk.BroadcastGlobalVariablesCallback(0),
+        hvdk.MetricAverageCallback(),
+        hvdk.LearningRateWarmupCallback(initial_lr=args.lr * n,
+                                        warmup_epochs=min(3, epochs),
+                                        steps_per_epoch=steps, verbose=True),
+    ]
+    state = hvdk.fit(state, data_fn, epochs=epochs, train_step=train_step,
+                     steps_per_epoch=steps, callbacks=callbacks)
+
+    if args.checkpoint_dir and hvd.rank() == 0:
+        hvdk.save_checkpoint(args.checkpoint_dir, state, epochs - 1)
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,81 @@
+"""Skip-gram word2vec with NCE loss — the embedding-gradient workload.
+
+Role parity with reference ``examples/tensorflow_word2vec.py``: skip-gram
+batches from a synthetic corpus (the reference downloads text8, ref
+:54-78), direct ``broadcast_parameters`` use (:199 uses the broadcast op
+directly), embedding lookups whose gradients exercise the
+sparse-to-dense reduction path (``sparse_as_dense``; on TPU embedding
+grads are dense scatters, SURVEY.md §2.3).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu.jax as hvd
+from examples.common import example_args
+from horovod_tpu.models import SkipGramModel, nce_loss
+
+
+def synthetic_corpus(vocab, n_tokens, seed=0):
+    """Zipf-distributed token stream (word2vec's natural input shape)."""
+    rng = np.random.default_rng(seed)
+    freq = 1.0 / np.arange(1, vocab + 1)
+    return rng.choice(vocab, size=n_tokens, p=freq / freq.sum())
+
+
+def skipgram_batches(corpus, batch, window, negatives, vocab, seed):
+    rng = np.random.default_rng(seed)
+    while True:
+        centers = rng.integers(window, len(corpus) - window, batch)
+        offsets = rng.integers(1, window + 1, batch) * \
+            rng.choice([-1, 1], batch)
+        yield (corpus[centers], corpus[centers + offsets],
+               rng.integers(0, vocab, (batch, negatives)))
+
+
+def main():
+    args = example_args("JAX word2vec", batch_size=128, lr=0.2,
+                        vocab=2000, embedding=64, negatives=8,
+                        steps=400)
+    hvd.init()
+
+    vocab = 200 if args.smoke else args.vocab
+    steps = 20 if args.smoke else args.steps
+    model = SkipGramModel(vocab_size=vocab, embedding_size=args.embedding)
+    params = model.init(jax.random.key(0), jnp.zeros((2,), jnp.int32))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    opt = hvd.DistributedOptimizer(optax.adagrad(args.lr * hvd.num_chips()))
+    opt_state = opt.init(params)
+
+    mesh = hvd.data_parallel_mesh()
+
+    def loss_fn(params, batch):
+        centers, labels, negs = batch
+        return nce_loss(model, params, centers, labels, negs)
+
+    step = hvd.make_train_step(loss_fn, opt, mesh, donate=False)
+
+    corpus = synthetic_corpus(vocab, 10000 if args.smoke else 100000,
+                              seed=hvd.rank())
+    batches = skipgram_batches(corpus, args.batch_size, 2, args.negatives,
+                               vocab, seed=hvd.rank())
+    for i in range(steps):
+        centers, labels, negs = next(batches)
+        params, opt_state, loss = step(
+            params, opt_state,
+            (jnp.asarray(centers), jnp.asarray(labels), jnp.asarray(negs)))
+        if i % max(steps // 5, 1) == 0 and hvd.rank() == 0:
+            print(f"step {i}: nce loss={float(loss):.4f}", flush=True)
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -67,10 +67,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def __init__(self, params, named_parameters=None,
                  compression=Compression.none,
-                 backward_passes_per_step=1):
+                 backward_passes_per_step=1,
+                 sparse_as_dense=False):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._bpps = backward_passes_per_step
+        self._sparse_as_dense = sparse_as_dense
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -123,6 +125,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _allreduce_grad_async(self, p):
         name = self._param_names.get(id(p))
+        if p.grad.is_sparse:
+            if not self._sparse_as_dense:
+                raise ValueError(
+                    "sparse gradients need DistributedOptimizer("
+                    "sparse_as_dense=True) — the collective data plane is "
+                    "dense (reference sparse_as_dense option, "
+                    "tensorflow/__init__.py:189-199)")
+            p.grad = p.grad.to_dense()
         tensor_compressed, ctx = self._compression.compress(p.grad.data)
         if tensor_compressed.data_ptr() == p.grad.data.data_ptr():
             # In-place reduce directly into .grad when uncompressed.
@@ -158,13 +168,16 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
-                         backward_passes_per_step=1):
+                         backward_passes_per_step=1,
+                         sparse_as_dense=False):
     """Wrap a torch optimizer so gradients are averaged across ranks during
-    ``backward()`` (reference factory, torch/__init__.py:115-150)."""
+    ``backward()`` (reference factory, torch/__init__.py:115-150).
+    ``sparse_as_dense`` densifies sparse gradients (e.g. from
+    ``nn.Embedding(sparse=True)``) before reduction."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step)
+               backward_passes_per_step, sparse_as_dense)
 
 
 def broadcast_parameters(params, root_rank: int = 0):

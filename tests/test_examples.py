@@ -1,0 +1,62 @@
+"""Example smoke tests (reference CI runs sed-shrunk examples under
+mpirun, .travis.yml:113-137; here each runs --smoke on the 8-device CPU
+mesh, single process)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    ("jax_mnist.py", []),
+    ("flax_mnist_advanced.py", []),
+    ("jax_imagenet_resnet50.py", []),
+    ("jax_word2vec.py", []),
+    ("torch_mnist.py", []),
+    ("torch_synthetic_benchmark.py", []),
+    ("bert_pretraining_fsdp.py", []),
+    ("llama_training_5d.py", ["--strategy", "gspmd"]),
+    ("llama_training_5d.py", ["--strategy", "seq"]),
+    ("llama_training_5d.py", ["--strategy", "pipeline"]),
+]
+
+
+@pytest.mark.parametrize("script,extra", EXAMPLES,
+                         ids=[f"{s}{'-' + e[1] if e else ''}"
+                              for s, e in EXAMPLES])
+def test_example_smoke(script, extra, tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable, os.path.join(REPO, "examples", script),
+           "--smoke"] + extra
+    if script in ("jax_imagenet_resnet50.py",):
+        cmd += ["--checkpoint-dir", str(tmp_path / "ckpt")]
+    p = subprocess.run(cmd, env=env, capture_output=True, timeout=420)
+    assert p.returncode == 0, (
+        f"{script} failed:\nstdout: {p.stdout.decode()[-2000:]}\n"
+        f"stderr: {p.stderr.decode()[-3000:]}")
+    assert b"done" in p.stdout
+
+
+def test_resnet50_example_resumes(tmp_path):
+    """Checkpoint/resume round trip (reference keras_imagenet_resnet50
+    resume pattern)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    cmd = [sys.executable,
+           os.path.join(REPO, "examples", "jax_imagenet_resnet50.py"),
+           "--smoke", "--checkpoint-dir", str(tmp_path / "ckpt")]
+    p1 = subprocess.run(cmd, env=env, capture_output=True, timeout=420)
+    assert p1.returncode == 0, p1.stderr.decode()[-2000:]
+    p2 = subprocess.run(cmd, env=env, capture_output=True, timeout=420)
+    assert p2.returncode == 0, p2.stderr.decode()[-2000:]
+    assert b"resuming from epoch" in p2.stdout

@@ -156,3 +156,28 @@ def test_broadcast_optimizer_state_lbfgs_rejected():
     opt = torch.optim.LBFGS(model.parameters())
     with pytest.raises(ValueError):
         hvd.broadcast_optimizer_state(opt)
+
+
+def test_sparse_as_dense():
+    """Sparse embedding grads are densified when requested, rejected with
+    a clear error otherwise (reference sparse_as_dense)."""
+    emb = torch.nn.EmbeddingBag(10, 4, sparse=True, mode="sum")
+    opt_bad = hvd.DistributedOptimizer(
+        torch.optim.SGD(emb.parameters(), lr=0.1),
+        named_parameters=emb.named_parameters(),
+    )
+    with pytest.raises(ValueError, match="sparse_as_dense"):
+        # The hook fires the allreduce during backward, so the rejection
+        # surfaces there (communication/compute overlap by design).
+        emb(torch.tensor([[1, 2], [3, 4]])).sum().backward()
+        opt_bad.step()
+
+    emb2 = torch.nn.EmbeddingBag(10, 4, sparse=True, mode="sum")
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(emb2.parameters(), lr=0.1),
+        named_parameters=emb2.named_parameters(),
+        sparse_as_dense=True,
+    )
+    emb2(torch.tensor([[1, 2], [3, 4]])).sum().backward()
+    opt.step()
+    assert not emb2.weight.grad.is_sparse
