@@ -1,0 +1,118 @@
+"""Process launcher: ``python -m horovod_tpu.run -np N -- python train.py``.
+
+The reference delegates process orchestration entirely to ``mpirun``
+(reference docs/running.md:25-42; Horovod 0.15 has no horovodrun).  On TPU
+there is no MPI: this launcher spawns N copies of the command with
+HOROVOD_RANK/SIZE/LOCAL_RANK/LOCAL_SIZE/COORDINATOR set, picks a free
+coordinator port, streams output with rank prefixes, and propagates the
+first failure (terminating the rest, like mpirun's default behavior).
+
+Multi-host: run the launcher once per host with ``--hosts-total`` /
+``--host-index`` / ``--coordinator host0:port`` so ranks are globally
+numbered and all processes rendezvous at host 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(prefix: str, pipe, out):
+    for line in iter(pipe.readline, b""):
+        out.write(f"[{prefix}] ".encode() + line)
+        out.flush()
+    pipe.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.run",
+        description="Launch N coordinated worker processes.")
+    parser.add_argument("-np", "--num-proc", type=int, required=True,
+                        help="processes on this host")
+    parser.add_argument("--coordinator", default=None,
+                        help="host:port of rank 0's coordinator "
+                             "(default: 127.0.0.1:<free port>)")
+    parser.add_argument("--host-index", type=int, default=0,
+                        help="this host's index (multi-host)")
+    parser.add_argument("--procs-per-host", type=int, default=None,
+                        help="ranks per host (default: -np)")
+    parser.add_argument("--hosts-total", type=int, default=1)
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="command to run (prefix with --)")
+    args = parser.parse_args(argv)
+
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given")
+
+    pph = args.procs_per_host or args.num_proc
+    world = pph * args.hosts_total
+    coordinator = args.coordinator or f"127.0.0.1:{_free_port()}"
+
+    procs: list[subprocess.Popen] = []
+    threads = []
+    for local_rank in range(args.num_proc):
+        rank = args.host_index * pph + local_rank
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(world),
+            "HOROVOD_LOCAL_RANK": str(local_rank),
+            "HOROVOD_LOCAL_SIZE": str(pph),
+            "HOROVOD_COORDINATOR": coordinator,
+        })
+        p = subprocess.Popen(command, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT)
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(str(rank), p.stdout,
+                                                   sys.stdout.buffer),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    rc = 0
+    try:
+        remaining = set(range(len(procs)))
+        while remaining:
+            for i in list(remaining):
+                code = procs[i].poll()
+                if code is None:
+                    continue
+                remaining.discard(i)
+                if code != 0 and rc == 0:
+                    rc = code
+                    sys.stderr.write(
+                        f"rank {i} exited with code {code}; "
+                        "terminating remaining ranks\n")
+                    for j in remaining:
+                        procs[j].terminate()
+            if remaining:
+                import time
+
+                time.sleep(0.1)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        rc = 130
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

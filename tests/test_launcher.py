@@ -1,0 +1,38 @@
+"""Launcher tests (python -m horovod_tpu.run)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(np_, body, timeout=120):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_), "--",
+         sys.executable, "-c", body],
+        cwd=REPO, env=env, capture_output=True, timeout=timeout)
+
+
+def test_launcher_spawns_and_coordinates():
+    p = _run(2, (
+        "import horovod_tpu.torch as hvd\n"
+        "import torch\n"
+        "hvd.init()\n"
+        "out = hvd.allreduce(torch.ones(2), average=False)\n"
+        "assert out[0].item() == 2.0\n"
+        "print('rank', hvd.rank(), 'ok')\n"
+        "hvd.shutdown()\n"))
+    assert p.returncode == 0, p.stdout.decode() + p.stderr.decode()
+    out = p.stdout.decode()
+    assert "[0] rank 0 ok" in out and "[1] rank 1 ok" in out
+
+
+def test_launcher_propagates_failure():
+    p = _run(2, (
+        "import os, sys\n"
+        "sys.exit(3 if os.environ['HOROVOD_RANK'] == '1' else 0)\n"))
+    assert p.returncode == 3
+    assert b"terminating remaining" in p.stderr or p.returncode == 3
