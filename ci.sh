@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# CI entry point: build the native engine, run the full test suite (incl.
+# example smokes) on an 8-device virtual CPU mesh, then gate the driver
+# artifacts (multichip dry run + bench smoke).
+#
+# Reference parity: .travis.yml:101-137 builds the wheel and runs
+# `mpirun -np 2 pytest -v` plus shrunken examples; the TPU-native
+# equivalent of the mpirun matrix is the virtual CPU mesh (SURVEY.md §4).
+#
+# Usage: ./ci.sh [pytest-args...]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== build native engine =="
+make -C horovod_tpu/cpp
+
+echo "== test suite (8-device virtual CPU mesh) =="
+# conftest.py forces the CPU platform in-process; PALLAS_AXON_POOL_IPS=
+# keeps the image's sitecustomize from registering the TPU plugin so CI
+# never touches (or requires) real hardware.
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}"
+
+echo "== multichip sharding dry run =="
+PALLAS_AXON_POOL_IPS= python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8) OK')"
+
+echo "== bench smoke (CPU) =="
+PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python bench.py
+
+echo "CI PASSED"

@@ -1,0 +1,77 @@
+"""MNIST with the Estimator harness — the train_and_evaluate workflow.
+
+Role parity with reference ``examples/tensorflow_mnist_estimator.py``:
+model_fn producing loss + eval metrics (ref :58-118), DistributedOptimizer
+inside the model_fn (:114), warm-start from model_dir, rank-0 checkpoints,
+broadcast at start (:164), steps divided by world size (:177), final
+evaluate.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu.jax as hvd
+from examples.common import example_args, shard_for_rank, synthetic_mnist
+from horovod_tpu.flax.estimator import Estimator
+from horovod_tpu.models import MnistConvNet
+
+
+def main():
+    args = example_args("JAX MNIST estimator", model_dir="")
+    hvd.init()
+    n = hvd.num_chips()
+
+    images, labels = synthetic_mnist(512 if args.smoke else 4096)
+    images, labels = shard_for_rank((images, labels), hvd.rank(), hvd.size())
+    split = max(len(images) // 5, args.batch_size)
+    eval_images, eval_labels = images[:split], labels[:split]
+    images, labels = images[split:], labels[split:]
+
+    model = MnistConvNet(dtype=jnp.float32)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+        accuracy = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, {"loss": loss, "accuracy": accuracy}
+
+    est = Estimator(
+        loss_fn,
+        init_fn=lambda rng: model.init(rng, jnp.zeros((1, 28, 28, 1))),
+        optimizer=optax.sgd(args.lr * n, momentum=0.9),
+        model_dir=args.model_dir or None,
+    )
+
+    batch = args.batch_size
+
+    def batches(x, y):
+        def input_fn():
+            steps = max(len(x) // batch, 1)
+            for i in range(steps):
+                idx = slice(i * batch, (i + 1) * batch)
+                xi, yi = x[idx], y[idx]
+                usable = len(xi) - len(xi) % n
+                if usable:
+                    yield jnp.asarray(xi[:usable]), jnp.asarray(yi[:usable])
+        return input_fn
+
+    epochs = 1 if args.smoke else args.epochs
+    metrics = est.train_and_evaluate(
+        batches(images, labels), batches(eval_images, eval_labels),
+        epochs=epochs)
+    if hvd.rank() == 0:
+        print(f"final accuracy: {metrics['accuracy']:.3f}", flush=True)
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,13 +51,15 @@ def _env_int(names: Sequence[str]) -> Optional[int]:
 
 def _find_native_lib() -> Optional[str]:
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    for candidate in (
-        os.path.join(here, "cpp", "libhorovod_core.so"),
-        os.path.join(here, "libhorovod_core.so"),
-    ):
-        if os.path.exists(candidate):
-            return candidate
-    return None
+    candidate = os.path.join(here, "libhorovod_core.so")
+    if os.path.exists(candidate):
+        return candidate
+    # Primary locations + self-healing compile from the shipped sources
+    # (install-time build is setup.py's job; this covers source checkouts
+    # and compiler-at-runtime installs).
+    from horovod_tpu.common.native_build import ensure_native_lib
+
+    return ensure_native_lib()
 
 
 class HorovodBasics:

@@ -58,6 +58,7 @@ from horovod_tpu.flax.checkpoint import (
     resume_epoch,
     save_checkpoint,
 )
+from horovod_tpu.flax.estimator import Estimator
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
@@ -68,6 +69,7 @@ __all__ = [
     "get_learning_rate", "set_learning_rate",
     "save_checkpoint", "load_checkpoint", "latest_checkpoint",
     "resume_epoch", "restore_and_broadcast",
+    "Estimator",
     "fit",
 ]
 

@@ -116,11 +116,28 @@ def set_learning_rate(opt_state, lr: float):
 
 
 def _scale_momentum(opt_state, factor: float):
-    """Momentum correction: scale SGD trace by new_lr/old_lr (reference
-    callbacks_impl.py:81-91 restarts momentum at the corrected magnitude)."""
+    """Momentum correction: scale the SGD velocity by new_lr/old_lr
+    (reference callbacks_impl.py:81-91 restarts momentum at the corrected
+    magnitude).
+
+    Only momentum-SGD-style traces are corrected — the reference likewise
+    applies correction only to optimizers with a ``momentum`` slot;
+    adaptive optimizers (adam, lamb, ...) need none.  Returns
+    ``(opt_state, found)`` so callers can warn when correction was
+    requested but the optimizer carries no momentum trace.
+    """
+    momentum_types = [optax.TraceState]
+    for name in ("ScaleByMomentumState",):  # newer optax momentum variants
+        t = getattr(optax, name, None)
+        if t is not None:
+            momentum_types.append(t)
+    momentum_types = tuple(momentum_types)
+    found = False
 
     def visit(s):
-        if isinstance(s, optax.TraceState):
+        nonlocal found
+        if isinstance(s, momentum_types):
+            found = True
             return s._replace(
                 trace=jax.tree.map(lambda t: t * factor, s.trace))
         if hasattr(s, "inner_state"):
@@ -129,7 +146,7 @@ def _scale_momentum(opt_state, factor: float):
             return tuple(visit(item) for item in s)
         return s
 
-    return visit(opt_state)
+    return visit(opt_state), found
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +211,7 @@ class LearningRateScheduleCallback(Callback):
         else:
             self.multiplier = lambda epoch: multiplier
         self._last_lr: Optional[float] = None
+        self._warned_no_momentum = False
 
     def _in_window(self, epoch: int) -> bool:
         if epoch < self.start_epoch:
@@ -206,7 +224,17 @@ class LearningRateScheduleCallback(Callback):
         opt_state = set_learning_rate(state.opt_state, lr)
         if self.momentum_correction and old is not None and old > 0 \
                 and lr != old:
-            opt_state = _scale_momentum(opt_state, lr / old)
+            opt_state, found = _scale_momentum(opt_state, lr / old)
+            if not found and not self._warned_no_momentum:
+                self._warned_no_momentum = True
+                import warnings
+
+                warnings.warn(
+                    "momentum_correction=True but the optimizer state "
+                    "carries no SGD momentum trace (adaptive optimizers "
+                    "like adam need no correction) — correction is a "
+                    "no-op; pass momentum_correction=False to silence",
+                    stacklevel=2)
         self._last_lr = lr
         return state.replace(opt_state=opt_state)
 

@@ -5,9 +5,11 @@ pattern, not a library feature (SURVEY.md §5.4): rank 0 alone writes
 (``examples/keras_imagenet_resnet50.py:156-158``), the resume epoch is
 discovered on rank 0 and broadcast (``keras_imagenet_resnet50.py:64-73``),
 and state re-syncs via broadcast / ``hvd.load_model``
-(``keras/impl.py:93-109``).  Here the pattern is a library feature built on
-orbax (the TPU-native checkpointing stack) with flax.serialization msgpack
-as the in-file format for portability.
+(``keras/impl.py:93-109``).  Here the pattern is a library feature:
+flax.serialization msgpack files with atomic rank-0 writes and
+broadcast-on-resume.  (Orbax sharded/async checkpointing is not used; for
+multi-host sharded checkpoints bring orbax directly — these helpers cover
+the reference's replicated-state pattern.)
 """
 
 from __future__ import annotations

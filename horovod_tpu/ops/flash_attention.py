@@ -354,6 +354,15 @@ def flash_attention(q, k, v, *, causal: bool = True):
     return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
 
 
-def flash_attention_fn(q, k, v, *args, **kwargs):
-    """Adapter matching the model zoo's pluggable ``attention_fn``."""
+def flash_attention_fn(q, k, v, mask=None, **kwargs):
+    """Adapter matching the model zoo's pluggable ``attention_fn``.
+
+    The kernel only implements causal masking; an explicit padding mask
+    (e.g. BERT's attention seam) must not be silently dropped."""
+    if mask is not None:
+        raise NotImplementedError(
+            "flash_attention_fn only supports causal masking; got an "
+            "explicit mask — use the dense attention path for masked "
+            "(e.g. padded bidirectional) attention"
+        )
     return flash_attention(q, k, v, causal=True)

@@ -96,9 +96,11 @@ def main(argv=None) -> int:
                 remaining.discard(i)
                 if code != 0 and rc == 0:
                     rc = code
+                    # Report the global rank, matching the stream prefixes
+                    # (local index i != rank when --host-index > 0).
                     sys.stderr.write(
-                        f"rank {i} exited with code {code}; "
-                        "terminating remaining ranks\n")
+                        f"rank {args.host_index * pph + i} exited with "
+                        f"code {code}; terminating remaining ranks\n")
                     for j in remaining:
                         procs[j].terminate()
             if remaining:

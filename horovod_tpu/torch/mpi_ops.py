@@ -103,9 +103,13 @@ def synchronize(handle: int) -> torch.Tensor:
         with _handle_lock:
             return _local_results.pop(handle)
     eng = _engine()
-    out_np = eng.synchronize(handle)
-    with _handle_lock:
-        tensor, postprocess = _handle_map.pop(handle)
+    try:
+        out_np = eng.synchronize(handle)
+    finally:
+        # Release the kept-alive tensors even when the collective errored,
+        # or the map entry leaks for the process lifetime.
+        with _handle_lock:
+            tensor, postprocess = _handle_map.pop(handle)
     return postprocess(tensor, out_np)
 
 

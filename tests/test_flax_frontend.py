@@ -131,7 +131,8 @@ def test_momentum_correction_scales_trace():
     grads = jax.tree.map(jnp.ones_like, state.params)
     state = state.apply_gradients(grads=grads)
     before = jax.tree.leaves(state.opt_state)[0]
-    scaled = _scale_momentum(state.opt_state, 0.5)
+    scaled, found = _scale_momentum(state.opt_state, 0.5)
+    assert found
 
     def traces(s):
         import optax as ox
@@ -176,3 +177,77 @@ def test_checkpoint_save_load_resume(tmp_path):
     # Empty dir → fresh start.
     _, epoch0 = hvdk.restore_and_broadcast(str(tmp_path / "none"), fresh)
     assert epoch0 == 0
+
+
+def test_estimator_train_evaluate_resume(tmp_path):
+    """Estimator harness (reference tensorflow_mnist_estimator.py role):
+    train_and_evaluate drops the loss, metrics are rank-averaged, and a
+    second Estimator on the same model_dir warm-starts from the
+    checkpoint instead of re-broadcasting fresh params."""
+    model = MnistMLP(dtype=jnp.float32, hidden=16)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, 64).astype(np.int32)
+
+    def loss_fn(params, batch):
+        bx, by = batch
+        logits = model.apply(params, bx)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, by[:, None], -1))
+        acc = jnp.mean((jnp.argmax(logits, -1) == by).astype(jnp.float32))
+        return loss, {"loss": loss, "accuracy": acc}
+
+    def input_fn():
+        for i in range(4):
+            yield (jnp.asarray(x[i * 16:(i + 1) * 16]),
+                   jnp.asarray(y[i * 16:(i + 1) * 16]))
+
+    def make(model_dir):
+        return hvdk.Estimator(
+            loss_fn,
+            init_fn=lambda r: model.init(r, jnp.zeros((1, 28, 28, 1))),
+            optimizer=optax.sgd(0.1),
+            model_dir=model_dir,
+        )
+
+    est = make(str(tmp_path))
+    first = est.evaluate(input_fn)
+    metrics = est.train_and_evaluate(input_fn, input_fn, epochs=3)
+    assert metrics["loss"] < first["loss"]
+    assert set(metrics) == {"loss", "accuracy"}
+
+    # Warm start: a new Estimator over the same dir resumes at epoch 3
+    # with the trained params (same eval), and training further epochs
+    # starts from there.
+    est2 = make(str(tmp_path))
+    assert est2._start_epoch == 3
+    m2 = est2.evaluate(input_fn)
+    np.testing.assert_allclose(m2["loss"], metrics["loss"], rtol=1e-5)
+
+    # No model_dir: broadcast-only init still works end to end.
+    est3 = make(None)
+    est3.train(input_fn, epochs=1)
+
+
+def test_momentum_correction_warns_for_adaptive(recwarn):
+    """Adam has no SGD momentum trace: correction must be a no-op with a
+    warning, not silent (the reference only corrects momentum-slot
+    optimizers)."""
+    import warnings
+
+    model = MnistMLP(dtype=jnp.float32, hidden=8)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    tx = optax.inject_hyperparams(optax.adam)(learning_rate=0.1)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    cb = hvdk.LearningRateScheduleCallback(0.1, lambda e: 0.5 ** e)
+
+    step = _train_step(model)
+    data = _data(2)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        hvdk.fit(state, lambda e: data, epochs=3, train_step=step,
+                 callbacks=[cb], verbose=False)
+    msgs = [str(w.message) for w in caught]
+    assert any("no SGD momentum trace" in m for m in msgs)
+    # warned once, not per epoch
+    assert sum("no SGD momentum trace" in m for m in msgs) == 1

@@ -16,15 +16,12 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "native_worker.py")
-LIB = os.path.join(REPO, "horovod_tpu", "cpp", "libhorovod_core.so")
 
 
 def _ensure_lib():
-    if not os.path.exists(LIB):
-        subprocess.run(
-            ["make", "-C", os.path.join(REPO, "horovod_tpu", "cpp")],
-            check=True, capture_output=True,
-        )
+    from horovod_tpu.common.native_build import ensure_native_lib
+
+    assert ensure_native_lib() is not None, "native engine build failed"
 
 
 def _free_port():
