@@ -1,21 +1,49 @@
-"""Benchmark harness: ResNet-50 synthetic training throughput.
+"""Benchmark harness: ResNet-50 synthetic training throughput + MFU +
+scaling efficiency.
 
 Mirrors the reference's img/sec methodology
 (``examples/pytorch_synthetic_benchmark.py:73-110``: timed fwd+bwd+step loop
 over synthetic ImageNet batches, img/sec per device) on TPU via the
-framework's own train-step path.
+framework's own train-step path, and the reference's scaling-efficiency
+metric (``docs/benchmarks.md:5-6``: throughput at N devices / N x
+throughput at 1).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line with {"metric", "value", "unit", "vs_baseline"} plus:
+
+- ``mfu``: model-FLOPs utilization — XLA cost-analysis FLOPs of the
+  compiled train step (fwd+bwd+update, MAC=2 convention) divided by the
+  device's peak bf16 FLOP/s.
+- ``model_tflops_per_step`` / ``sustained_tflops``: the raw numbers.
+- ``scaling_efficiency_8dev``: weak-scaling efficiency of the SAME
+  distributed train step on an 8-device mesh vs a 1-device mesh
+  (per-device batch held constant).  On a multi-chip host this runs on
+  real chips; on a single-chip/CPU host it runs on the virtual CPU mesh
+  (host cores shared between virtual devices, so it measures the
+  *structural* collective overhead of the distributed graph, not real ICI
+  scaling).
+
 ``vs_baseline`` compares against the reference's only published absolute
-throughput: tf_cnn_benchmarks ResNet-101 at 1656.82 total img/s on 16 Pascal
-GPUs = 103.55 img/s/GPU (``docs/benchmarks.md:22-37``; the reference
-publishes no ResNet-50 or TPU numbers — BASELINE.md).
+throughput: tf_cnn_benchmarks ResNet-101 at 1656.82 total img/s on 16
+Pascal GPUs = 103.55 img/s/GPU (``docs/benchmarks.md:22-37``; the
+reference publishes no ResNet-50 or TPU numbers — BASELINE.md).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
+
+# The scaling-efficiency mode needs an 8-device CPU platform alongside the
+# accelerator; both knobs must be in place before the backends initialize.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+if os.environ.get("JAX_PLATFORMS") and \
+        "cpu" not in os.environ["JAX_PLATFORMS"]:
+    os.environ["JAX_PLATFORMS"] += ",cpu"
 
 import jax
 import jax.numpy as jnp
@@ -23,10 +51,114 @@ import numpy as np
 
 REFERENCE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.md:22-37
 
+#: Peak dense bf16 FLOP/s per chip by device kind (published specs).
+_PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5": 459e12,        # v5p
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+}
 
-def main() -> None:
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "")
+    for prefix in sorted(_PEAK_BF16_FLOPS, key=len, reverse=True):
+        if kind.startswith(prefix):
+            return _PEAK_BF16_FLOPS[prefix]
+    return None
+
+
+def _make_step_and_state(model, mesh, batch_per_chip, image_size, n_chips,
+                         devices=None):
     import optax
 
+    import horovod_tpu.jax as hvd
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal(
+        (batch_per_chip * n_chips, image_size, image_size, 3),
+        dtype=np.float32)
+    labels = rng.integers(0, 1000, batch_per_chip * n_chips)
+    if devices is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        data_sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+        repl = NamedSharding(mesh, P())
+        images = jax.device_put(jnp.asarray(images), data_sharding)
+        labels = jax.device_put(jnp.asarray(labels), data_sharding)
+        put = lambda t: jax.tree.map(lambda a: jax.device_put(a, repl), t)
+    else:
+        images, labels = jnp.asarray(images), jnp.asarray(labels)
+        put = lambda t: t
+
+    variables = jax.jit(
+        lambda: model.init(jax.random.key(0), images[:1], train=False)
+    )()
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    # Reference recipe: momentum SGD, LR scaled by world size
+    # (examples/pytorch_synthetic_benchmark.py:57-62, keras LR x size);
+    # gradients averaged by the framework's DistributedOptimizer.
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01 * n_chips, momentum=0.9))
+
+    def loss_fn(params, batch_stats, batch):
+        x, y = batch
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            x, train=True, mutable=["batch_stats"],
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+        return loss, updates["batch_stats"]
+
+    train_step = hvd.make_train_step(loss_fn, opt, mesh, has_aux=True)
+    opt_state = jax.jit(opt.inner.init)(params)
+    state = (put(params), put(opt_state), put(batch_stats))
+    return train_step, state, (images, labels)
+
+
+def _time_step(train_step, state, data, iters, warmup):
+    for _ in range(warmup):
+        *state, loss = train_step(*state, data)
+    # Sync via host fetch: the final loss depends on the whole step chain.
+    # (block_until_ready alone has proven unreliable over remote-device
+    # tunnels, returning before execution finishes.)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        *state, loss = train_step(*state, data)
+    float(loss)
+    return time.perf_counter() - t0
+
+
+def _scaling_efficiency(model_cls, image_size, batch_per_dev, iters, warmup):
+    """Weak-scaling: total throughput on an 8-device mesh vs 8x the
+    1-device throughput, identical per-device batch and train step."""
+    import horovod_tpu.jax as hvd
+
+    accel = jax.devices()
+    if len(accel) >= 8:
+        devices, note = accel[:8], "8 real chips"
+    else:
+        try:
+            devices, note = jax.devices("cpu")[:8], "virtual CPU mesh (structural)"
+        except RuntimeError:
+            return None, "no 8-device platform available"
+        if len(devices) < 8:
+            return None, "no 8-device platform available"
+
+    model = model_cls(dtype=jnp.bfloat16)
+    rates = {}
+    for n in (1, 8):
+        mesh = hvd.build_mesh({"data": n}, devices=devices[:n])
+        step, state, data = _make_step_and_state(
+            model, mesh, batch_per_dev, image_size, n, devices=devices[:n])
+        dt = _time_step(step, state, data, iters, warmup)
+        rates[n] = batch_per_dev * n * iters / dt
+    return rates[8] / (8 * rates[1]), note
+
+
+def main() -> None:
     import horovod_tpu.jax as hvd
     from horovod_tpu.models import ResNet50
 
@@ -35,68 +167,54 @@ def main() -> None:
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         batch_per_chip, image_size, iters, warmup = 256, 224, 30, 10
+        scale_batch, scale_size, scale_iters, scale_warmup = 8, 64, 5, 2
     else:  # CPU smoke mode so the harness is runnable anywhere
         batch_per_chip, image_size, iters, warmup = 8, 32, 3, 1
+        scale_batch, scale_size, scale_iters, scale_warmup = 4, 32, 2, 1
 
     n_chips = jax.device_count()
     mesh = hvd.data_parallel_mesh()
     model = ResNet50(dtype=jnp.bfloat16)
 
-    rng = np.random.default_rng(0)
-    images = jnp.asarray(
-        rng.standard_normal(
-            (batch_per_chip * n_chips, image_size, image_size, 3),
-            dtype=np.float32,
-        )
-    )
-    labels = jnp.asarray(rng.integers(0, 1000, batch_per_chip * n_chips))
+    train_step, state, data = _make_step_and_state(
+        model, mesh, batch_per_chip, image_size, n_chips)
 
-    variables = jax.jit(
-        lambda: model.init(jax.random.key(0), images[:1], train=False)
-    )()
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    flops_per_step = None
+    try:
+        cost = train_step.lower(*state, data).compile().cost_analysis()
+        if not isinstance(cost, dict):  # older jax returns a list
+            cost = cost[0]
+        flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
 
-    # Reference recipe: momentum SGD, LR scaled by world size
-    # (examples/pytorch_synthetic_benchmark.py:57-62, keras LR×size);
-    # gradients averaged by the framework's DistributedOptimizer.
-    opt = hvd.DistributedOptimizer(optax.sgd(0.01 * n_chips, momentum=0.9))
-
-    def loss_fn(params, batch_stats, batch):
-        images, labels = batch
-        logits, updates = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            images, train=True, mutable=["batch_stats"],
-        )
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
-        return loss, updates["batch_stats"]
-
-    train_step = hvd.make_train_step(loss_fn, opt, mesh, has_aux=True)
-    opt_state = jax.jit(opt.inner.init)(params)
-
-    state = (params, opt_state, batch_stats)
-    for _ in range(warmup):
-        *state, loss = train_step(*state, (images, labels))
-    # Sync via host fetch: the final loss depends on the whole step chain.
-    # (block_until_ready alone has proven unreliable over remote-device
-    # tunnels, returning before execution finishes.)
-    float(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        *state, loss = train_step(*state, (images, labels))
-    float(loss)
-    dt = time.perf_counter() - t0
-
+    dt = _time_step(train_step, state, data, iters, warmup)
     total_img_per_sec = batch_per_chip * n_chips * iters / dt
     per_chip = total_img_per_sec / n_chips
-    print(json.dumps({
+
+    result = {
         "metric": "resnet50_train_images_per_sec_per_chip"
                   if on_tpu else "resnet50_train_images_per_sec_per_chip_cpu_smoke",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_IMG_PER_SEC_PER_DEVICE, 3),
-    }))
+    }
+
+    if flops_per_step is not None:
+        sustained = flops_per_step * iters / dt / n_chips
+        result["model_tflops_per_step"] = round(flops_per_step / 1e12, 3)
+        result["sustained_tflops"] = round(sustained / 1e12, 2)
+        peak = _peak_flops(jax.devices()[0]) if on_tpu else None
+        if peak:
+            result["mfu"] = round(sustained / peak, 4)
+
+    eff, note = _scaling_efficiency(
+        ResNet50, scale_size, scale_batch, scale_iters, scale_warmup)
+    if eff is not None:
+        result["scaling_efficiency_8dev"] = round(eff, 4)
+        result["scaling_mode"] = note
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

