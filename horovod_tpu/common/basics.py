@@ -93,9 +93,18 @@ class HorovodBasics:
     ) -> None:
         """Initialize the runtime.
 
-        ``comm`` accepts a rank subset for API parity with the reference
-        (common/__init__.py:58-84) but sub-communicators are not yet
-        supported; pass None/[] for world.
+        ``comm`` accepts a rank subset (a list of WORLD ranks), matching the
+        reference's ``hvd.init(comm=...)`` (common/__init__.py:58-84,
+        operations.cc:1469-1488): the listed ranks form their own
+        communicator — own rank numbering, own coordinator, own ring —
+        and collectives span only them.  Processes NOT in the list
+        initialize as a world of one (their collectives are identities),
+        where the reference leaves them outside the MPI group entirely; a
+        self-communicator is the functional equivalent without a second
+        process group concept.  The subset coordinator listens on the world
+        coordinator's port + 1 + min(comm) (deterministic and distinct for
+        disjoint subsets); pass ``coordinator=`` to choose explicitly.
+        mpi4py communicator objects are not accepted — there is no MPI here.
 
         Identity resolution order: explicit kwargs > HOROVOD_*/OMPI_*/PMI_*
         env vars > JAX distributed runtime (process_index/process_count) >
@@ -106,9 +115,10 @@ class HorovodBasics:
         with self._lock:
             if self._initialized:
                 return
-            if comm:
-                raise NotImplementedError(
-                    "sub-communicators (hvd.init(comm=...)) are not supported yet"
+            if comm is not None and not isinstance(comm, (list, tuple)):
+                raise TypeError(
+                    "comm must be a list of world ranks (mpi4py communicators "
+                    "are not supported in the TPU-native runtime)"
                 )
 
             if rank is None:
@@ -143,6 +153,37 @@ class HorovodBasics:
 
             rank, size = int(rank), int(size)
             local_rank, local_size = int(local_rank), int(local_size)
+
+            if comm:
+                members = sorted({int(r) for r in comm})
+                if members[0] < 0 or members[-1] >= size:
+                    raise ValueError(
+                        f"comm={members} contains ranks outside the world "
+                        f"[0, {size})"
+                    )
+                world_rank, world_local_size = rank, local_size
+                if world_rank not in members:
+                    # Excluded process: world of one, no coordinator.
+                    rank, size, local_rank, local_size = 0, 1, 0, 1
+                else:
+                    rank = members.index(world_rank)
+                    size = len(members)
+                    # Local identity follows the WORLD node layout so a
+                    # subset spanning hosts still gets a meaningful
+                    # intra-host split.
+                    my_node = world_rank // world_local_size
+                    same_node = [m for m in members
+                                 if m // world_local_size == my_node]
+                    local_rank = same_node.index(world_rank)
+                    local_size = len(same_node)
+                    if coordinator is None and size > 1:
+                        base = os.environ.get("HOROVOD_COORDINATOR", "")
+                        if base and ":" in base:
+                            host, _, port = base.rpartition(":")
+                            coordinator = (
+                                f"{host}:{int(port) + 1 + members[0]}"
+                            )
+
             if not (0 < size and 0 <= rank < size):
                 raise ValueError(
                     f"invalid identity: rank={rank}, size={size}"

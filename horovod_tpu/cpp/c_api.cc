@@ -40,7 +40,8 @@ const char* horovod_last_error() {
   return Engine::Get().last_error().c_str();
 }
 
-// op: 0 = allreduce, 1 = allgather, 2 = broadcast (RequestType values).
+// op: 0 = allreduce, 1 = allgather, 2 = broadcast, 3 = reducescatter,
+// 4 = alltoall (RequestType values).
 // Returns handle >= 0, -1 on duplicate in-flight name, -2 if not running.
 int64_t horovod_enqueue(int op, const char* name, int dtype, int ndim,
                         const int64_t* shape, void* data, int root_rank) {

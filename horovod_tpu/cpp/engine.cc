@@ -152,6 +152,9 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   stall_check_disabled_ = EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) != 0;
   stall_warning_sec_ =
       static_cast<int>(EnvInt64("HOROVOD_STALL_WARNING_SEC", 60));
+  socket_timeout_sec_ =
+      static_cast<int>(EnvInt64("HOROVOD_SOCKET_TIMEOUT_SEC", 120));
+  abort_reason_.clear();
   const char* timeline_path = std::getenv("HOROVOD_TIMELINE");
   if (timeline_path != nullptr && timeline_path[0] != '\0' && rank_ == 0) {
     timeline_.Initialize(timeline_path);
@@ -175,7 +178,7 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
 
     // Every rank opens an ephemeral data listener for ring neighbors.
     int data_port = 0;
-    data_listener_ = Listen("0.0.0.0", 0, 4, &data_port, &err);
+    data_listener_ = Listen("0.0.0.0", 0, 8, &data_port, &err);
     if (!data_listener_.valid()) {
       last_error_ = "data listener: " + err;
       return 1;
@@ -195,6 +198,9 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       }
       peer_hosts[0] = my_host;
       peer_ports[0] = data_port;
+      std::vector<int32_t> peer_lr(size_, 0), peer_ls(size_, 1);
+      peer_lr[0] = local_rank_;
+      peer_ls[0] = local_size_;
       worker_conns_.clear();
       worker_conns_.resize(size_);
       for (int i = 1; i < size_; ++i) {
@@ -212,15 +218,39 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         int32_t peer_rank = r.i32();
         std::string peer_host = r.str();
         int32_t peer_port = r.i32();
+        int32_t lr = r.i32(), ls = r.i32();
         if (!r.ok() || peer_rank < 1 || peer_rank >= size_) {
           last_error_ = "bad rendezvous frame";
           return 1;
         }
         peer_hosts[peer_rank] = peer_host;
         peer_ports[peer_rank] = peer_port;
+        peer_lr[peer_rank] = lr;
+        peer_ls[peer_rank] = ls;
         worker_conns_[peer_rank] = std::move(conn);
       }
+      // Coordinator decides the two-level topology GLOBALLY (the
+      // reference's is_homogeneous check, operations.cc:1511-1525):
+      // every rank must report the same local_size, block placement
+      // (local_rank == rank % local_size), and the layout must span >1
+      // node.  Per-rank guessing would let half the job wire hierarchical
+      // rings while the other half expects a flat ring.
+      bool want_hier = EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0;
+      bool hier_ok = want_hier && local_size_ > 1 &&
+                     size_ % local_size_ == 0 && size_ > local_size_;
+      for (int i = 0; hier_ok && i < size_; ++i) {
+        hier_ok = peer_ls[i] == local_size_ && peer_lr[i] == i % local_size_;
+      }
+      if (want_hier && !hier_ok) {
+        std::fprintf(stderr,
+                     "horovod_tpu: HOROVOD_HIERARCHICAL_ALLREDUCE ignored — "
+                     "needs a homogeneous block layout (equal local_size > 1 "
+                     "dividing size, local_rank == rank %% local_size on "
+                     "every rank); using the flat ring.\n");
+      }
+      hierarchical_ = hier_ok;
       Writer w;
+      w.u8(hierarchical_ ? 1 : 0);
       for (int i = 0; i < size_; ++i) {
         w.str(peer_hosts[i]);
         w.i32(peer_ports[i]);
@@ -241,6 +271,8 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       w.i32(rank_);
       w.str(my_host);
       w.i32(data_port);
+      w.i32(local_rank_);
+      w.i32(local_size_);
       if (!coordinator_conn_.SendFrame(w.bytes())) {
         last_error_ = "rendezvous send failed";
         return 1;
@@ -251,6 +283,7 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         return 1;
       }
       Reader r(frame.data(), frame.size());
+      hierarchical_ = r.u8() != 0;
       for (int i = 0; i < size_; ++i) {
         peer_hosts[i] = r.str();
         peer_ports[i] = r.i32();
@@ -261,30 +294,91 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       }
     }
 
-    // Ring wiring: connect to (rank+1) % size, accept from (rank-1) % size.
-    // Connect cannot deadlock: every listener already exists, so the
-    // connect completes from the backlog even before the peer accepts.
-    int next = (rank_ + 1) % size_;
-    ring_next_ = ConnectRetry(peer_hosts[next], peer_ports[next], 60000, &err);
-    if (!ring_next_.valid()) {
-      last_error_ = "ring connect: " + err;
-      return 1;
+    node_id_ = rank_ / local_size_;
+    nnodes_ = size_ / local_size_;
+
+    // Ring wiring.  Each directed ring edge is its own TCP connection,
+    // opened by the edge's source, identified by an (origin rank, ring id)
+    // handshake.  Connect cannot deadlock: every listener already exists,
+    // so connects complete from the backlog even before the peer accepts.
+    enum RingId : int32_t { GLOBAL = 0, LOCAL = 1, CROSS = 2 };
+    struct Edge {
+      int peer;
+      int32_t ring;
+      Socket* slot;
+    };
+    std::vector<Edge> outgoing, incoming;
+    outgoing.push_back({(rank_ + 1) % size_, GLOBAL, &ring_next_});
+    incoming.push_back({(rank_ - 1 + size_) % size_, GLOBAL, &ring_prev_});
+    if (hierarchical_) {
+      int L = local_size_, lr = local_rank_, base = node_id_ * L;
+      outgoing.push_back({base + (lr + 1) % L, LOCAL, &local_next_});
+      incoming.push_back({base + (lr - 1 + L) % L, LOCAL, &local_prev_});
+      if (lr == 0) {  // node leader: ring over one rank per node
+        outgoing.push_back(
+            {((node_id_ + 1) % nnodes_) * L, CROSS, &cross_next_});
+        incoming.push_back(
+            {((node_id_ - 1 + nnodes_) % nnodes_) * L, CROSS, &cross_prev_});
+      }
     }
-    int32_t my_rank32 = rank_;
-    if (!ring_next_.SendAll(&my_rank32, 4)) {
-      last_error_ = "ring handshake send failed";
-      return 1;
+    for (auto& edge : outgoing) {
+      *edge.slot = ConnectRetry(peer_hosts[edge.peer], peer_ports[edge.peer],
+                                60000, &err);
+      if (!edge.slot->valid()) {
+        last_error_ = "ring connect to rank " + std::to_string(edge.peer) +
+                      ": " + err;
+        return 1;
+      }
+      int32_t hello[2] = {rank_, edge.ring};
+      if (!edge.slot->SendAll(hello, sizeof(hello))) {
+        last_error_ = "ring handshake send failed";
+        return 1;
+      }
     }
-    ring_prev_ = Accept(data_listener_, &err);
-    if (!ring_prev_.valid()) {
-      last_error_ = "ring accept: " + err;
-      return 1;
+    for (size_t i = 0; i < incoming.size(); ++i) {
+      Socket conn = Accept(data_listener_, &err);
+      if (!conn.valid()) {
+        last_error_ = "ring accept: " + err;
+        return 1;
+      }
+      int32_t hello[2] = {-1, -1};
+      if (!conn.RecvAll(hello, sizeof(hello))) {
+        last_error_ = "ring handshake recv failed";
+        return 1;
+      }
+      bool matched = false;
+      for (auto& edge : incoming) {
+        if (edge.peer == hello[0] && edge.ring == hello[1] &&
+            !edge.slot->valid()) {
+          *edge.slot = std::move(conn);
+          matched = true;
+          break;
+        }
+      }
+      if (!matched) {
+        last_error_ = "unexpected ring handshake from rank " +
+                      std::to_string(hello[0]) + " ring " +
+                      std::to_string(hello[1]);
+        return 1;
+      }
     }
-    int32_t prev_rank32 = -1;
-    if (!ring_prev_.RecvAll(&prev_rank32, 4) ||
-        prev_rank32 != (rank_ - 1 + size_) % size_) {
-      last_error_ = "ring handshake mismatch";
-      return 1;
+
+    // Robustness: bound every blocking transport op and probe idle peers
+    // so a dead/hung process surfaces as a clean error, not a hang.
+    Socket* socks[] = {&ring_next_,  &ring_prev_,  &coordinator_conn_,
+                       &local_next_, &local_prev_, &cross_next_,
+                       &cross_prev_};
+    for (Socket* s : socks) {
+      if (s->valid()) {
+        s->SetTimeouts(socket_timeout_sec_);
+        s->EnableKeepalive();
+      }
+    }
+    for (auto& c : worker_conns_) {
+      if (c.valid()) {
+        c.SetTimeouts(socket_timeout_sec_);
+        c.EnableKeepalive();
+      }
     }
   }
 
@@ -309,7 +403,12 @@ void Engine::BackgroundLoop() {
   while (RunLoopOnce()) {
   }
   // Fail anything still in flight (reference SHUT_DOWN_ERROR,
-  // operations.cc:1647-1662).
+  // operations.cc:1647-1662).  A transport abort carries the specific
+  // reason (which peer died, during what) to every waiter.
+  std::string reason = abort_reason_.empty()
+      ? "Horovod has been shut down. This was caused by an exception on one "
+        "of the ranks or an attempt to enqueue after shutdown."
+      : abort_reason_;
   std::vector<TensorTableEntry> leftovers;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -318,11 +417,35 @@ void Engine::BackgroundLoop() {
     message_queue_.clear();
   }
   for (auto& e : leftovers) {
-    FinishEntry(e, Status::Aborted(
-        "Horovod has been shut down. This was caused by an exception on one "
-        "of the ranks or an attempt to enqueue after shutdown."));
+    FinishEntry(e, Status::Aborted(reason));
   }
+  // Close every connection so peers blocked in recv see EOF immediately and
+  // the failure propagates around the ring instead of stranding them until
+  // their own timeout.
+  CloseSockets();
   shut_down_.store(true);
+}
+
+void Engine::CloseSockets() {
+  ring_next_.Close();
+  ring_prev_.Close();
+  local_next_.Close();
+  local_prev_.Close();
+  cross_next_.Close();
+  cross_prev_.Close();
+  coordinator_conn_.Close();
+  for (auto& c : worker_conns_) c.Close();
+  control_listener_.Close();
+  data_listener_.Close();
+}
+
+std::string Engine::TransportError(const std::string& op,
+                                   const std::string& name,
+                                   const std::string& detail, int next_rank,
+                                   int prev_rank) const {
+  int peer = detail.rfind("recv", 0) == 0 ? prev_rank : next_rank;
+  return "rank " + std::to_string(peer) + " disconnected during " + op +
+         " of '" + name + "': " + detail;
 }
 
 bool Engine::RunLoopOnce() {
@@ -362,18 +485,27 @@ bool Engine::RunLoopOnce() {
   if (rank_ == 0) {
     std::vector<RequestList> lists(size_);
     lists[0] = std::move(my_list);
+    // A worker's next frame only arrives after it finished executing the
+    // previous cycle's collectives, which can legitimately span several
+    // socket-timeout rounds on slow links — hence the size-scaled patience
+    // (a crashed worker still fails immediately via EOF/keepalive).
     for (int r = 1; r < size_; ++r) {
       std::vector<uint8_t> frame;
-      if (!worker_conns_[r].RecvFrame(&frame)) {
-        std::fprintf(stderr,
-                     "horovod_tpu coordinator: lost connection to rank %d\n",
-                     r);
+      if (!worker_conns_[r].RecvFrame(&frame, size_ + 4)) {
+        abort_reason_ = "coordinator lost connection to rank " +
+                        std::to_string(r) +
+                        " — that process likely crashed or hung; check its "
+                        "logs.";
+        std::fprintf(stderr, "horovod_tpu coordinator: %s\n",
+                     abort_reason_.c_str());
         return false;
       }
       Reader reader(frame.data(), frame.size());
       if (!ParseRequestList(&reader, &lists[r])) {
-        std::fprintf(stderr, "horovod_tpu coordinator: bad frame from %d\n",
-                     r);
+        abort_reason_ = "coordinator received a corrupt control frame from "
+                        "rank " + std::to_string(r) + ".";
+        std::fprintf(stderr, "horovod_tpu coordinator: %s\n",
+                     abort_reason_.c_str());
         return false;
       }
     }
@@ -382,8 +514,11 @@ bool Engine::RunLoopOnce() {
     SerializeResponseList(response_list, &w);
     for (int r = 1; r < size_; ++r) {
       if (!worker_conns_[r].SendFrame(w.bytes())) {
-        std::fprintf(stderr,
-                     "horovod_tpu coordinator: send to rank %d failed\n", r);
+        abort_reason_ = "coordinator could not reach rank " +
+                        std::to_string(r) +
+                        " — that process likely crashed; check its logs.";
+        std::fprintf(stderr, "horovod_tpu coordinator: %s\n",
+                     abort_reason_.c_str());
         return false;
       }
     }
@@ -396,19 +531,26 @@ bool Engine::RunLoopOnce() {
   Writer w;
   SerializeRequestList(my_list, &w);
   if (!coordinator_conn_.SendFrame(w.bytes())) {
-    std::fprintf(stderr, "horovod_tpu rank %d: coordinator send failed\n",
-                 rank_);
+    abort_reason_ = "lost connection to the coordinator (rank 0) — it "
+                    "likely crashed or another rank failed; check rank 0's "
+                    "logs.";
+    std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
+                 abort_reason_.c_str());
     return false;
   }
   std::vector<uint8_t> frame;
-  if (!coordinator_conn_.RecvFrame(&frame)) {
-    std::fprintf(stderr, "horovod_tpu rank %d: coordinator recv failed\n",
-                 rank_);
+  if (!coordinator_conn_.RecvFrame(&frame, size_ + 4)) {
+    abort_reason_ = "lost connection to the coordinator (rank 0) — it "
+                    "likely crashed or another rank failed; check rank 0's "
+                    "logs.";
+    std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
+                 abort_reason_.c_str());
     return false;
   }
   Reader reader(frame.data(), frame.size());
   ResponseList response_list;
   if (!ParseResponseList(&reader, &response_list)) {
+    abort_reason_ = "corrupt control frame from the coordinator.";
     std::fprintf(stderr, "horovod_tpu rank %d: bad response frame\n", rank_);
     return false;
   }
@@ -491,6 +633,49 @@ Response Engine::BuildResponse(const std::string& name) {
     }
   }
 
+  if (first.type == RequestType::REDUCESCATTER ||
+      first.type == RequestType::ALLTOALL) {
+    // Both need identical shapes on every rank (the output partitioning is
+    // computed from the common shape).
+    for (int r = 1; r < size_; ++r) {
+      if (info.requests[r].shape != first.shape) {
+        err << "Mismatched " << RequestTypeName(first.type)
+            << " tensor shapes: all ranks must pass identical shapes for "
+               "tensor " << name << ".";
+        resp.type = ResponseType::ERROR;
+        resp.error_message = err.str();
+        return resp;
+      }
+    }
+    if (first.shape.empty()) {
+      err << RequestTypeName(first.type) << " requires a tensor with at "
+          << "least one dimension for tensor " << name << ".";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+    if (first.type == RequestType::ALLTOALL) {
+      if (first.shape[0] % size_ != 0) {
+        err << "alltoall requires dimension 0 (" << first.shape[0]
+            << ") to be divisible by the number of ranks (" << size_
+            << ") for tensor " << name << ".";
+        resp.type = ResponseType::ERROR;
+        resp.error_message = err.str();
+        return resp;
+      }
+      resp.type = ResponseType::ALLTOALL;
+      return resp;
+    }
+    // Reducescatter: rows split as evenly as possible, earlier ranks get
+    // the remainder (same convention as the ring segments).
+    resp.type = ResponseType::REDUCESCATTER;
+    int64_t rows = first.shape[0];
+    for (int r = 0; r < size_; ++r) {
+      resp.tensor_sizes.push_back(rows / size_ +
+                                  (r < rows % size_ ? 1 : 0));
+    }
+    return resp;
+  }
   if (first.type == RequestType::ALLREDUCE ||
       first.type == RequestType::BROADCAST) {
     for (int r = 1; r < size_; ++r) {
@@ -590,6 +775,12 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
 // Execution (the host data plane)
 // ---------------------------------------------------------------------------
 
+// Chunk size for streamed (pipelined) relay transfers: broadcast rings and
+// the hierarchical local chains.  Large enough to amortize syscalls, small
+// enough that a relay's first-byte latency is hops·chunk_time, not
+// hops·full_transfer.
+static constexpr size_t kRelayChunk = 4u << 20;
+
 void Engine::PerformResponse(const Response& response) {
   std::vector<TensorTableEntry> entries;
   {
@@ -619,64 +810,191 @@ void Engine::PerformResponse(const Response& response) {
     case ResponseType::BROADCAST:
       ExecBroadcast(response, entries);
       break;
+    case ResponseType::REDUCESCATTER:
+      ExecReducescatter(response, entries);
+      break;
+    case ResponseType::ALLTOALL:
+      ExecAlltoall(response, entries);
+      break;
     default:
       break;
   }
 }
 
 // Bandwidth-optimal ring allreduce: reduce-scatter + allgather over the
-// neighbor sockets.  Send and recv run concurrently (sender thread) so the
-// ring never deadlocks on socket buffers.
-static bool RingAllreduce(void* data, int64_t count, DataType dtype,
-                          int rank, int size, Socket& next, Socket& prev,
-                          std::string* err) {
+// neighbor sockets.  Send and recv are multiplexed with poll (SendRecvAll)
+// so the ring never deadlocks on socket buffers and the hot path spawns no
+// threads (the round-1 design spent 2(N-1) thread creations per
+// collective).
+//
+// `vrank` is the rank used for segment arithmetic.  With vrank == rank,
+// after the reduce-scatter phase rank r owns the fully-reduced segment
+// (r + 1) mod size; ExecReducescatter passes vrank = rank - 1 so each rank
+// ends owning exactly segment `rank` (its scatter output).
+static bool RingReduceScatterPhase(uint8_t* base,
+                                   const std::vector<int64_t>& seg_count,
+                                   const std::vector<int64_t>& seg_off,
+                                   DataType dtype, int vrank, int size,
+                                   Socket& next, Socket& prev, int timeout_ms,
+                                   std::string* err) {
   const size_t esize = DataTypeSize(dtype);
-  uint8_t* base = static_cast<uint8_t*>(data);
-  std::vector<int64_t> seg_count(size), seg_off(size);
-  int64_t off = 0;
-  for (int s = 0; s < size; ++s) {
-    seg_count[s] = count / size + (s < count % size ? 1 : 0);
-    seg_off[s] = off;
-    off += seg_count[s];
-  }
-  std::vector<uint8_t> tmp(static_cast<size_t>(seg_count[0]) * esize);
-
-  // Reduce-scatter: after step t, rank r owns the full sum of segment
-  // (r - t - 1) mod size's partials seen so far.
+  int64_t max_seg = 0;
+  for (auto c : seg_count) max_seg = std::max(max_seg, c);
+  std::vector<uint8_t> tmp(static_cast<size_t>(max_seg) * esize);
   for (int step = 0; step < size - 1; ++step) {
-    int send_seg = (rank - step + size) % size;
-    int recv_seg = (rank - step - 1 + size) % size;
-    bool send_ok = true;
-    std::thread sender([&] {
-      send_ok = next.SendAll(base + seg_off[send_seg] * esize,
-                             static_cast<size_t>(seg_count[send_seg]) * esize);
-    });
-    bool recv_ok = prev.RecvAll(
-        tmp.data(), static_cast<size_t>(seg_count[recv_seg]) * esize);
-    sender.join();
-    if (!send_ok || !recv_ok) {
-      *err = "ring reduce-scatter transport failure";
+    int send_seg = (vrank - step + 2 * size) % size;
+    int recv_seg = (vrank - step - 1 + 2 * size) % size;
+    if (!SendRecvAll(next, base + seg_off[send_seg] * esize,
+                     static_cast<size_t>(seg_count[send_seg]) * esize, prev,
+                     tmp.data(),
+                     static_cast<size_t>(seg_count[recv_seg]) * esize,
+                     timeout_ms, err)) {
       return false;
     }
     ReduceSumInto(base + seg_off[recv_seg] * esize, tmp.data(),
                   seg_count[recv_seg], dtype);
   }
+  return true;
+}
+
+static void EvenSegments(int64_t count, int size,
+                         std::vector<int64_t>* seg_count,
+                         std::vector<int64_t>* seg_off) {
+  seg_count->resize(size);
+  seg_off->resize(size);
+  int64_t off = 0;
+  for (int s = 0; s < size; ++s) {
+    (*seg_count)[s] = count / size + (s < count % size ? 1 : 0);
+    (*seg_off)[s] = off;
+    off += (*seg_count)[s];
+  }
+}
+
+static bool RingAllreduce(void* data, int64_t count, DataType dtype,
+                          int rank, int size, Socket& next, Socket& prev,
+                          int timeout_ms, std::string* err) {
+  const size_t esize = DataTypeSize(dtype);
+  uint8_t* base = static_cast<uint8_t*>(data);
+  std::vector<int64_t> seg_count, seg_off;
+  EvenSegments(count, size, &seg_count, &seg_off);
+
+  if (!RingReduceScatterPhase(base, seg_count, seg_off, dtype, rank, size,
+                              next, prev, timeout_ms, err)) {
+    return false;
+  }
   // Allgather: circulate the fully-reduced segments.
   for (int step = 0; step < size - 1; ++step) {
     int send_seg = (rank - step + 1 + size) % size;
     int recv_seg = (rank - step + size) % size;
-    bool send_ok = true;
-    std::thread sender([&] {
-      send_ok = next.SendAll(base + seg_off[send_seg] * esize,
-                             static_cast<size_t>(seg_count[send_seg]) * esize);
-    });
-    bool recv_ok = prev.RecvAll(
-        base + seg_off[recv_seg] * esize,
-        static_cast<size_t>(seg_count[recv_seg]) * esize);
-    sender.join();
-    if (!send_ok || !recv_ok) {
-      *err = "ring allgather transport failure";
+    if (!SendRecvAll(next, base + seg_off[send_seg] * esize,
+                     static_cast<size_t>(seg_count[send_seg]) * esize, prev,
+                     base + seg_off[recv_seg] * esize,
+                     static_cast<size_t>(seg_count[recv_seg]) * esize,
+                     timeout_ms, err)) {
       return false;
+    }
+  }
+  return true;
+}
+
+// Two-level allreduce (HOROVOD_HIERARCHICAL_ALLREDUCE): chain-reduce each
+// node's buffers onto its leader over loopback/shm-speed local links, ring
+// allreduce across the (few) leaders over the real network, then chain-
+// broadcast back down.  Reference decomposition: NCCL reduce-scatter →
+// cross-node MPI allreduce → NCCL allgather (operations.cc:1025-1187); on
+// the host plane the intra-node links are not the bottleneck, so the
+// simpler chain keeps the cross-node traffic identical (one buffer per
+// leader-ring hop) without per-local-rank cross rings.
+bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
+                                   const std::string& name,
+                                   std::string* status_msg) {
+  const size_t esize = DataTypeSize(dtype);
+  const size_t nbytes = static_cast<size_t>(count) * esize;
+  const int L = local_size_, lr = local_rank_, base = node_id_ * L;
+  const size_t chunk_elems = kRelayChunk / esize;
+  std::string err;
+
+  // 1. Reduce up the local chain: data flows from local_rank L-1 down to
+  //    the leader at local_rank 0 (all sockets are duplex; "toward prev"
+  //    writes ride the connection the prev rank opened to us).  Streamed
+  //    in chunks so every link is busy at once and a rank's legitimate
+  //    zero-byte wait is bounded by chain_hops·chunk_time (see
+  //    kRelayChunk).
+  if (lr == L - 1) {
+    if (!local_prev_.SendAll(data, nbytes)) {
+      *status_msg = TransportError("hierarchical allreduce (local reduce)",
+                                   name, "send to peer: transport failure",
+                                   base + lr - 1, base + lr - 1);
+      return false;
+    }
+  } else {
+    std::vector<uint8_t> tmp(std::min(nbytes, kRelayChunk));
+    uint8_t* p = static_cast<uint8_t*>(data);
+    for (int64_t eoff = 0; eoff < count;
+         eoff += static_cast<int64_t>(chunk_elems)) {
+      int64_t n_elems =
+          std::min<int64_t>(static_cast<int64_t>(chunk_elems), count - eoff);
+      size_t n = static_cast<size_t>(n_elems) * esize;
+      if (!local_next_.RecvAllPatient(tmp.data(), n, L + 2)) {
+        *status_msg = TransportError("hierarchical allreduce (local reduce)",
+                                     name,
+                                     "recv from peer: transport failure",
+                                     base + lr + 1, base + lr + 1);
+        return false;
+      }
+      ReduceSumInto(p + eoff * esize, tmp.data(), n_elems, dtype);
+      if (lr > 0 && !local_prev_.SendAll(p + eoff * esize, n)) {
+        *status_msg = TransportError("hierarchical allreduce (local reduce)",
+                                     name,
+                                     "send to peer: transport failure",
+                                     base + lr - 1, base + lr - 1);
+        return false;
+      }
+    }
+  }
+
+  // 2. Leaders ring-allreduce the node sums across nodes.
+  if (lr == 0 && nnodes_ > 1) {
+    if (!RingAllreduce(data, count, dtype, node_id_, nnodes_, cross_next_,
+                       cross_prev_, socket_timeout_sec_ * 1000, &err)) {
+      int next_leader = ((node_id_ + 1) % nnodes_) * L;
+      int prev_leader = ((node_id_ - 1 + nnodes_) % nnodes_) * L;
+      *status_msg = TransportError("hierarchical allreduce (cross ring)",
+                                   name, err, next_leader, prev_leader);
+      return false;
+    }
+  }
+
+  // 3. Broadcast the result back up the local chain, streamed in chunks.
+  //    The first chunk's legitimate idle time covers the leaders' whole
+  //    cross-node ring — 2(nnodes-1) SendRecvAll steps, each of which may
+  //    consume most of a timeout round on a slow link — hence the
+  //    2·nnodes-based budget.
+  uint8_t* p = static_cast<uint8_t*>(data);
+  for (size_t off = 0; off < nbytes; off += kRelayChunk) {
+    size_t n = std::min(kRelayChunk, nbytes - off);
+    if (lr == 0) {
+      if (!local_next_.SendAll(p + off, n)) {
+        *status_msg = TransportError("hierarchical allreduce (local bcast)",
+                                     name, "send to peer: transport failure",
+                                     base + 1, base + 1);
+        return false;
+      }
+    } else {
+      if (!local_prev_.RecvAllPatient(p + off, n, 2 * nnodes_ + L + 2)) {
+        *status_msg = TransportError("hierarchical allreduce (local bcast)",
+                                     name,
+                                     "recv from peer: transport failure",
+                                     base + lr - 1, base + lr - 1);
+        return false;
+      }
+      if (lr < L - 1 && !local_next_.SendAll(p + off, n)) {
+        *status_msg = TransportError("hierarchical allreduce (local bcast)",
+                                     name,
+                                     "send to peer: transport failure",
+                                     base + lr + 1, base + lr + 1);
+        return false;
+      }
     }
   }
   return true;
@@ -707,15 +1025,26 @@ void Engine::ExecAllreduce(const Response& response,
       buf = fusion_buffer_.data();
       timeline_.ActivityEnd(tname);
     }
-    timeline_.ActivityStart(tname, "RING_ALLREDUCE");
-    std::string err;
-    if (!RingAllreduce(buf, total, dtype, rank_, size_, ring_next_,
-                       ring_prev_, &err)) {
-      timeline_.ActivityEnd(tname);
-      for (auto& e : entries) FinishEntry(e, Status::Aborted(err));
-      return;
+    bool ok;
+    std::string msg;
+    if (hierarchical_) {
+      timeline_.ActivityStart(tname, "HIERARCHICAL_ALLREDUCE");
+      ok = HierarchicalAllreduce(buf, total, dtype, tname, &msg);
+    } else {
+      timeline_.ActivityStart(tname, "RING_ALLREDUCE");
+      std::string err;
+      ok = RingAllreduce(buf, total, dtype, rank_, size_, ring_next_,
+                         ring_prev_, socket_timeout_sec_ * 1000, &err);
+      if (!ok) {
+        msg = TransportError("allreduce", tname, err, (rank_ + 1) % size_,
+                             (rank_ - 1 + size_) % size_);
+      }
     }
     timeline_.ActivityEnd(tname);
+    if (!ok) {
+      for (auto& e : entries) FinishEntry(e, Status::Aborted(msg));
+      return;
+    }
     if (entries.size() > 1) {
       timeline_.ActivityStart(tname, "MEMCPY_OUT_FUSION_BUFFER");
       int64_t off = 0;
@@ -768,25 +1097,23 @@ void Engine::ExecAllgather(const Response& response,
   if (size_ > 1) {
     timeline_.ActivityStart(e.name, "RING_ALLGATHER");
     // Circulate blocks around the ring; after size-1 steps everyone has all.
+    std::string err;
     bool failed = false;
     for (int step = 0; step < size_ - 1 && !failed; ++step) {
       int send_block = (rank_ - step + size_) % size_;
       int recv_block = (rank_ - step - 1 + size_) % size_;
-      bool send_ok = true;
-      std::thread sender([&] {
-        send_ok = ring_next_.SendAll(
-            hs->result.data() + block_off[send_block],
-            static_cast<size_t>(block_bytes[send_block]));
-      });
-      bool recv_ok = ring_prev_.RecvAll(
+      failed = !SendRecvAll(
+          ring_next_, hs->result.data() + block_off[send_block],
+          static_cast<size_t>(block_bytes[send_block]), ring_prev_,
           hs->result.data() + block_off[recv_block],
-          static_cast<size_t>(block_bytes[recv_block]));
-      sender.join();
-      failed = !send_ok || !recv_ok;
+          static_cast<size_t>(block_bytes[recv_block]),
+          socket_timeout_sec_ * 1000, &err);
     }
     timeline_.ActivityEnd(e.name);
     if (failed) {
-      FinishEntry(e, Status::Aborted("ring allgather transport failure"));
+      FinishEntry(e, Status::Aborted(TransportError(
+          "allgather", e.name, err, (rank_ + 1) % size_,
+          (rank_ - 1 + size_) % size_)));
       return;
     }
   }
@@ -804,19 +1131,153 @@ void Engine::ExecBroadcast(const Response& response,
                     DataTypeSize(e.dtype);
     int root = response.root_rank;
     bool ok = true;
-    // Pipeline root → root+1 → ... → root-1 along the ring.
-    if (rank_ == root) {
-      if (size_ > 1) ok = ring_next_.SendAll(e.data, nbytes);
-    } else {
-      ok = ring_prev_.RecvAll(e.data, nbytes);
-      int next = (rank_ + 1) % size_;
-      if (ok && next != root) ok = ring_next_.SendAll(e.data, nbytes);
+    std::string detail;
+    // Pipeline root → root+1 → ... → root-1 along the ring, STREAMED in
+    // chunks: each relay forwards chunk k while chunk k+1 is in flight
+    // upstream, so (a) total time ≈ one transfer + hops·chunk_time instead
+    // of hops·transfer, and (b) the longest legitimate zero-byte wait is
+    // hops·chunk_time, comfortably inside one socket-timeout round even on
+    // slow links (RecvAllPatient rides out skew; EOF from a crashed peer
+    // still fails immediately).
+    uint8_t* p = static_cast<uint8_t*>(e.data);
+    bool forward = rank_ != root && (rank_ + 1) % size_ != root;
+    int hops = (rank_ - root + size_) % size_;
+    for (size_t off = 0; ok && off < nbytes; off += kRelayChunk) {
+      size_t n = std::min(kRelayChunk, nbytes - off);
+      if (rank_ == root) {
+        ok = ring_next_.SendAll(p + off, n);
+        if (!ok) detail = "send to peer: transport failure";
+      } else {
+        ok = ring_prev_.RecvAllPatient(p + off, n, hops + 2);
+        if (!ok) {
+          detail = "recv from peer: transport failure";
+        } else if (forward) {
+          ok = ring_next_.SendAll(p + off, n);
+          if (!ok) detail = "send to peer: transport failure";
+        }
+      }
     }
     timeline_.ActivityEnd(e.name);
     if (!ok) {
-      FinishEntry(e, Status::Aborted("ring broadcast transport failure"));
+      FinishEntry(e, Status::Aborted(TransportError(
+          "broadcast", e.name, detail, (rank_ + 1) % size_,
+          (rank_ - 1 + size_) % size_)));
       return;
     }
+  }
+  timeline_.End(e.name, e.dtype, e.shape.DebugString());
+  FinishEntry(e, Status::OK());
+}
+
+void Engine::ExecReducescatter(const Response& response,
+                               std::vector<TensorTableEntry>& entries) {
+  // Never fused; one entry.  Ring reduce-scatter phase only (the first half
+  // of the ring allreduce), on a scratch copy so the caller's input stays
+  // intact; each rank keeps its own row-aligned segment.
+  TensorTableEntry& e = entries[0];
+  timeline_.Start(e.name);
+  const size_t esize = DataTypeSize(e.dtype);
+  int64_t row_elems = 1;
+  for (int d = 1; d < e.shape.ndim(); ++d) row_elems *= e.shape.dim(d);
+
+  auto hs = GetHandle(e.handle);
+  if (hs == nullptr) return;
+
+  std::vector<int64_t> seg_count(size_), seg_off(size_);
+  int64_t off = 0;
+  for (int r = 0; r < size_; ++r) {
+    seg_count[r] = response.tensor_sizes[r] * row_elems;
+    seg_off[r] = off;
+    off += seg_count[r];
+  }
+
+  int64_t my_rows = response.tensor_sizes[rank_];
+  hs->result_shape.clear();
+  hs->result_shape.push_back(my_rows);
+  for (int d = 1; d < e.shape.ndim(); ++d) {
+    hs->result_shape.push_back(e.shape.dim(d));
+  }
+
+  const uint8_t* input = static_cast<const uint8_t*>(e.data);
+  if (size_ == 1) {
+    hs->result.assign(input, input + static_cast<size_t>(seg_count[0]) * esize);
+    timeline_.End(e.name, e.dtype, e.shape.DebugString());
+    FinishEntry(e, Status::OK());
+    return;
+  }
+
+  timeline_.ActivityStart(e.name, "RING_REDUCESCATTER");
+  std::vector<uint8_t> scratch(
+      input, input + static_cast<size_t>(off) * esize);
+  // vrank = rank-1 so the phase leaves THIS rank owning segment `rank`
+  // (see RingReduceScatterPhase).
+  std::string err;
+  bool ok = RingReduceScatterPhase(
+      scratch.data(), seg_count, seg_off, e.dtype,
+      (rank_ - 1 + size_) % size_, size_, ring_next_, ring_prev_,
+      socket_timeout_sec_ * 1000, &err);
+  timeline_.ActivityEnd(e.name);
+  if (!ok) {
+    FinishEntry(e, Status::Aborted(TransportError(
+        "reducescatter", e.name, err, (rank_ + 1) % size_,
+        (rank_ - 1 + size_) % size_)));
+    return;
+  }
+  hs->result.assign(
+      scratch.data() + seg_off[rank_] * esize,
+      scratch.data() + (seg_off[rank_] + seg_count[rank_]) * esize);
+  timeline_.End(e.name, e.dtype, e.shape.DebugString());
+  FinishEntry(e, Status::OK());
+}
+
+void Engine::ExecAlltoall(const Response& response,
+                          std::vector<TensorTableEntry>& entries) {
+  // Ring-rotation alltoall: circulate each rank's full input around the
+  // ring; at step t a rank holds the input of rank (rank - t) and keeps
+  // the block addressed to it.  Link traffic is (size-1)·input — fine for
+  // the host control/data plane this engine serves (the accelerator
+  // alltoall is an XLA collective, ops/collective_ops.py); a pairwise
+  // exchange would need all-to-all sockets the ring deliberately avoids.
+  TensorTableEntry& e = entries[0];
+  timeline_.Start(e.name);
+  const size_t esize = DataTypeSize(e.dtype);
+  int64_t total = e.shape.num_elements();
+  int64_t block = total / size_;  // elements per destination block
+
+  auto hs = GetHandle(e.handle);
+  if (hs == nullptr) return;
+  hs->result.resize(static_cast<size_t>(total) * esize);
+  hs->result_shape.clear();
+  for (int d = 0; d < e.shape.ndim(); ++d) {
+    hs->result_shape.push_back(e.shape.dim(d));
+  }
+
+  const uint8_t* input = static_cast<const uint8_t*>(e.data);
+  const size_t block_bytes = static_cast<size_t>(block) * esize;
+  // Own block stays put.
+  memcpy(hs->result.data() + rank_ * block_bytes, input + rank_ * block_bytes,
+         block_bytes);
+  if (size_ > 1) {
+    timeline_.ActivityStart(e.name, "RING_ALLTOALL");
+    std::vector<uint8_t> cur(input, input + static_cast<size_t>(total) * esize);
+    std::vector<uint8_t> nxt(cur.size());
+    for (int step = 1; step < size_; ++step) {
+      std::string err;
+      if (!SendRecvAll(ring_next_, cur.data(), cur.size(), ring_prev_,
+                       nxt.data(), nxt.size(), socket_timeout_sec_ * 1000,
+                       &err)) {
+        timeline_.ActivityEnd(e.name);
+        FinishEntry(e, Status::Aborted(TransportError(
+            "alltoall", e.name, err, (rank_ + 1) % size_,
+            (rank_ - 1 + size_) % size_)));
+        return;
+      }
+      int src = (rank_ - step + size_) % size_;
+      memcpy(hs->result.data() + src * block_bytes,
+             nxt.data() + rank_ * block_bytes, block_bytes);
+      cur.swap(nxt);
+    }
+    timeline_.ActivityEnd(e.name);
   }
   timeline_.End(e.name, e.dtype, e.shape.DebugString());
   FinishEntry(e, Status::OK());

@@ -110,8 +110,18 @@ class Engine {
                      std::vector<TensorTableEntry>& entries);
   void ExecBroadcast(const Response& response,
                      std::vector<TensorTableEntry>& entries);
+  void ExecReducescatter(const Response& response,
+                         std::vector<TensorTableEntry>& entries);
+  void ExecAlltoall(const Response& response,
+                    std::vector<TensorTableEntry>& entries);
   void FinishEntry(TensorTableEntry& e, const Status& s);
   void CheckForStalledTensors();
+  void CloseSockets();
+  // "rank N disconnected during allreduce of 'x': detail" — maps a
+  // SendRecvAll error (prefixed send/recv) to the guilty neighbor rank.
+  std::string TransportError(const std::string& op, const std::string& name,
+                             const std::string& detail, int next_rank,
+                             int prev_rank) const;
 
   std::shared_ptr<HandleState> GetHandle(int64_t handle);
 
@@ -128,6 +138,15 @@ class Engine {
   int64_t fusion_threshold_ = 64 * 1024 * 1024;
   bool stall_check_disabled_ = false;
   int stall_warning_sec_ = 60;
+  // No-progress bound for any single transport operation
+  // (HOROVOD_SOCKET_TIMEOUT_SEC; 0 disables).  A hung-but-connected peer
+  // fails collectives with a descriptive error instead of blocking forever.
+  int socket_timeout_sec_ = 120;
+
+  // Why the background loop aborted (set by the background thread before
+  // RunLoopOnce returns false on a transport failure, read by it right
+  // after — single-thread access, no lock needed).
+  std::string abort_reason_;
 
   // -- pending work (guarded by mu_) --
   std::mutex mu_;
@@ -154,8 +173,22 @@ class Engine {
   Socket control_listener_;                // rank 0
   std::vector<Socket> worker_conns_;       // rank 0: [size-1] control conns
   Socket coordinator_conn_;                // rank != 0
-  Socket ring_next_, ring_prev_;           // data plane neighbors
+  Socket ring_next_, ring_prev_;           // data plane neighbors (global)
   Socket data_listener_;
+
+  // -- hierarchical (two-level) allreduce --
+  // HOROVOD_HIERARCHICAL_ALLREDUCE: reduce within each host first, ring
+  // across one leader per host, then broadcast back down — the reference's
+  // NCCL-reduce-scatter → cross-node MPI → NCCL-allgather decomposition
+  // (operations.cc:1025-1187, 1500-1532) mapped onto the host plane using
+  // local_rank/local_size for the intra/inter split.
+  bool hierarchical_ = false;
+  int node_id_ = 0, nnodes_ = 1;
+  Socket local_next_, local_prev_;         // intra-node ring (duplex chain)
+  Socket cross_next_, cross_prev_;         // leader ring across nodes
+  bool HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
+                             const std::string& name,
+                             std::string* status_msg);
 
   // -- fusion scratch --
   std::vector<uint8_t> fusion_buffer_;

@@ -21,6 +21,10 @@ enum class RequestType : uint8_t {
   ALLREDUCE = 0,
   ALLGATHER = 1,
   BROADCAST = 2,
+  // Extensions beyond the reference wire protocol (the reference's eager
+  // surface stops at the three ops above); negotiated identically.
+  REDUCESCATTER = 3,
+  ALLTOALL = 4,
 };
 
 enum class ResponseType : uint8_t {
@@ -28,6 +32,8 @@ enum class ResponseType : uint8_t {
   ALLGATHER = 1,
   BROADCAST = 2,
   ERROR = 3,
+  REDUCESCATTER = 4,
+  ALLTOALL = 5,
 };
 
 inline const char* RequestTypeName(RequestType t) {
@@ -35,6 +41,8 @@ inline const char* RequestTypeName(RequestType t) {
     case RequestType::ALLREDUCE: return "allreduce";
     case RequestType::ALLGATHER: return "allgather";
     case RequestType::BROADCAST: return "broadcast";
+    case RequestType::REDUCESCATTER: return "reducescatter";
+    case RequestType::ALLTOALL: return "alltoall";
   }
   return "?";
 }

@@ -1,10 +1,13 @@
 #include "socket.h"
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <cerrno>
@@ -30,6 +33,26 @@ void Socket::Close() {
     ::close(fd_);
     fd_ = -1;
   }
+}
+
+void Socket::SetTimeouts(int timeout_sec) {
+  if (fd_ < 0 || timeout_sec <= 0) return;
+  timeval tv{};
+  tv.tv_sec = timeout_sec;
+  ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+void Socket::EnableKeepalive() {
+  if (fd_ < 0) return;
+  int one = 1;
+  ::setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  // Aggressive probing: detect a dead-but-ESTABLISHED peer in ~30 s
+  // instead of the kernel's multi-hour default.
+  int idle = 10, intvl = 5, cnt = 4;
+  ::setsockopt(fd_, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+  ::setsockopt(fd_, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+  ::setsockopt(fd_, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
 }
 
 bool Socket::SendAll(const void* data, size_t n) {
@@ -60,6 +83,26 @@ bool Socket::RecvAll(void* data, size_t n) {
   return true;
 }
 
+bool Socket::RecvAllPatient(void* data, size_t n, int max_idle_rounds) {
+  char* p = static_cast<char*>(data);
+  int idle = 0;
+  while (n > 0) {
+    ssize_t got = ::recv(fd_, p, n, 0);
+    if (got <= 0) {
+      if (got < 0 && errno == EINTR) continue;
+      if (got < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) &&
+          ++idle <= max_idle_rounds) {
+        continue;  // waiting its turn in the relay chain, peer still alive
+      }
+      return false;
+    }
+    idle = 0;
+    p += got;
+    n -= static_cast<size_t>(got);
+  }
+  return true;
+}
+
 bool Socket::SendFrame(const std::vector<uint8_t>& payload) {
   uint64_t len = payload.size();
   if (!SendAll(&len, sizeof(len))) return false;
@@ -67,9 +110,9 @@ bool Socket::SendFrame(const std::vector<uint8_t>& payload) {
   return SendAll(payload.data(), payload.size());
 }
 
-bool Socket::RecvFrame(std::vector<uint8_t>* payload) {
+bool Socket::RecvFrame(std::vector<uint8_t>* payload, int max_idle_rounds) {
   uint64_t len = 0;
-  if (!RecvAll(&len, sizeof(len))) return false;
+  if (!RecvAllPatient(&len, sizeof(len), max_idle_rounds)) return false;
   if (len > (1ull << 34)) return false;  // 16 GB sanity cap
   payload->resize(len);
   if (len == 0) return true;
@@ -167,6 +210,86 @@ Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
   *error = "timed out connecting to " + host + ":" + std::to_string(port) +
            " (" + last_err + ")";
   return Socket();
+}
+
+namespace {
+
+// Scoped O_NONBLOCK toggle: SendRecvAll multiplexes with poll and must not
+// block inside send/recv; the blocking mode is restored on exit so the
+// frame-based control plane keeps its simple blocking reads.
+class NonblockGuard {
+ public:
+  explicit NonblockGuard(int fd) : fd_(fd), flags_(::fcntl(fd, F_GETFL, 0)) {
+    if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_ | O_NONBLOCK);
+  }
+  ~NonblockGuard() {
+    if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_);
+  }
+
+ private:
+  int fd_;
+  int flags_;
+};
+
+}  // namespace
+
+bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
+                 Socket& rcv, void* recv_buf, size_t rn,
+                 int timeout_ms, std::string* err) {
+  const char* sp = static_cast<const char*>(send_buf);
+  char* rp = static_cast<char*>(recv_buf);
+  NonblockGuard g1(snd.fd());
+  NonblockGuard g2(rcv.fd());
+  while (sn > 0 || rn > 0) {
+    pollfd fds[2];
+    int nfds = 0;
+    int si = -1, ri = -1;
+    if (sn > 0) {
+      fds[nfds] = {snd.fd(), POLLOUT, 0};
+      si = nfds++;
+    }
+    if (rn > 0) {
+      fds[nfds] = {rcv.fd(), POLLIN, 0};
+      ri = nfds++;
+    }
+    int rc = ::poll(fds, nfds, timeout_ms > 0 ? timeout_ms : -1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      *err = std::string("poll: ") + strerror(errno);
+      return false;
+    }
+    if (rc == 0) {
+      *err = (sn > 0 ? "send to peer: " : "recv from peer: ") +
+             std::string("no progress for ") +
+             std::to_string(timeout_ms / 1000) + "s (peer hung?)";
+      return false;
+    }
+    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP)) != 0) {
+      ssize_t k = ::send(snd.fd(), sp, sn, MSG_NOSIGNAL);
+      if (k > 0) {
+        sp += k;
+        sn -= static_cast<size_t>(k);
+      } else if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                 errno != EINTR) {
+        *err = std::string("send to peer: ") + strerror(errno);
+        return false;
+      }
+    }
+    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP)) != 0) {
+      ssize_t k = ::recv(rcv.fd(), rp, rn, 0);
+      if (k > 0) {
+        rp += k;
+        rn -= static_cast<size_t>(k);
+      } else if (k == 0) {
+        *err = "recv from peer: connection closed (peer process exited?)";
+        return false;
+      } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        *err = std::string("recv from peer: ") + strerror(errno);
+        return false;
+      }
+    }
+  }
+  return true;
 }
 
 }  // namespace hvd

@@ -28,17 +28,44 @@ class Socket {
   int fd() const { return fd_; }
   void Close();
 
-  // Blocking helpers; return false on error/EOF.
+  // Robustness knobs (a hung-but-connected peer must not block forever —
+  // the reference's stall story covers negotiation only; transport hangs
+  // were invisible).  Timeout 0 = never time out.
+  void SetTimeouts(int timeout_sec);
+  void EnableKeepalive();
+
+  // Blocking helpers; return false on error/EOF/timeout.
   bool SendAll(const void* data, size_t n);
   bool RecvAll(void* data, size_t n);
 
-  // Length-prefixed frames (u64 length + payload).
+  // RecvAll for store-and-forward waits (broadcast relays, hierarchical
+  // chain hops) where zero bytes for a while can mean "upstream hops still
+  // in flight", not "peer hung": tolerates up to `max_idle_rounds`
+  // consecutive SO_RCVTIMEO expiries before failing; EOF / hard errors
+  // still fail immediately.
+  bool RecvAllPatient(void* data, size_t n, int max_idle_rounds);
+
+  // Length-prefixed frames (u64 length + payload).  `max_idle_rounds` > 0
+  // tolerates that many SO_RCVTIMEO expiries while waiting for the frame —
+  // the control plane must ride out ranks that are legitimately busy
+  // executing a long data-plane collective before their next cycle frame.
   bool SendFrame(const std::vector<uint8_t>& payload);
-  bool RecvFrame(std::vector<uint8_t>* payload);
+  bool RecvFrame(std::vector<uint8_t>* payload, int max_idle_rounds = 0);
 
  private:
   int fd_;
 };
+
+// Full-duplex transfer: send `sn` bytes on `snd` while receiving `rn` bytes
+// from `rcv`, multiplexed with poll(2) on nonblocking fds.  This replaces
+// the thread-per-send pattern on the ring hot path (2(N-1) thread spawns
+// per collective) with zero extra threads.  `timeout_ms` bounds the time
+// with NO forward progress on either direction (<=0 = wait forever).  On
+// failure fills *err with a message prefixed "send to peer:" or
+// "recv from peer:" so the caller can name the guilty neighbor rank.
+bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
+                 Socket& rcv, void* recv_buf, size_t rn,
+                 int timeout_ms, std::string* err);
 
 // Listen on host:port (port 0 = ephemeral). Returns listening socket and
 // fills *bound_port.

@@ -143,22 +143,32 @@ def broadcast(tensor, root_rank=0, *, axis_name="data", name=None):
 
 def reducescatter(tensor, *, axis_name="data", op=Sum, scatter_axis=0,
                   tiled=True, name=None):
+    """Reduce-scatter.  Traced: one XLA psum_scatter over ``axis_name``.
+    Eager: cross-process ring reduce-scatter through the runtime engine
+    (dim-0 rows split as evenly as possible across ranks)."""
     if _is_traced(tensor):
         return _cops.reducescatter(tensor, axis_name=axis_name, op=op,
                                    scatter_axis=scatter_axis, tiled=tiled)
     if size() == 1:
-        # World of one: reduce is identity; the scatter keeps the full shard.
+        # World of one: reduce is identity, the scatter keeps the full
+        # shard — for any op/axis (matches the reference under -np 1).
         import jax.numpy as jnp
 
         return jnp.asarray(tensor)
-    raise NotImplementedError(
-        "eager reducescatter across processes is not supported yet; use it "
-        "inside shard_map/make_train_step, or allreduce + slice"
-    )
+    if scatter_axis != 0:
+        raise NotImplementedError(
+            "eager reducescatter scatters along dim 0; transpose first or "
+            "use the traced path for other axes"
+        )
+    from horovod_tpu.runtime import eager
+
+    return eager.reducescatter(tensor, op=op, name=name)
 
 
 def alltoall(tensor, *, axis_name="seq", split_axis=0, concat_axis=0,
              name=None):
+    """All-to-all.  Traced: one XLA all_to_all over ``axis_name``.  Eager:
+    cross-process ring exchange of equal dim-0 blocks."""
     if _is_traced(tensor):
         return _cops.alltoall(tensor, axis_name=axis_name,
                               split_axis=split_axis, concat_axis=concat_axis)
@@ -166,10 +176,14 @@ def alltoall(tensor, *, axis_name="seq", split_axis=0, concat_axis=0,
         import jax.numpy as jnp
 
         return jnp.asarray(tensor)
-    raise NotImplementedError(
-        "eager alltoall across processes is not supported yet; use it "
-        "inside shard_map"
-    )
+    if split_axis != 0 or concat_axis != 0:
+        raise NotImplementedError(
+            "eager alltoall splits/concats along dim 0; transpose first or "
+            "use the traced path for other axes"
+        )
+    from horovod_tpu.runtime import eager
+
+    return eager.alltoall(tensor, name=name)
 
 
 # ---------------------------------------------------------------------------

@@ -30,7 +30,8 @@ from horovod_tpu.common.basics import basics
 from horovod_tpu.ops.collective_ops import Average, ReduceOp, Sum
 from horovod_tpu.ops.compression import Compression
 
-__all__ = ["allreduce", "grouped_allreduce", "allgather", "broadcast"]
+__all__ = ["allreduce", "grouped_allreduce", "allgather", "broadcast",
+           "reducescatter", "alltoall"]
 
 
 def _resolve_op(op, average):
@@ -93,16 +94,10 @@ def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
         for i, h in enumerate(hosts)
     ]
     outs = [eng.synchronize(h) for h in handles]
-    n = basics.size()
     results = []
     for out, ctx in zip(outs, ctxs):
         if op is Average:
-            # Same semantics as NativeEngine.allreduce(average=True):
-            # floor-divide integers, true-divide floats.
-            if np.issubdtype(out.dtype, np.integer):
-                out = out // n
-            else:
-                out = (out / np.asarray(n, dtype=out.dtype)).astype(out.dtype)
+            out = eng._apply_average(out)
         results.append(compression.decompress(jnp.asarray(out), ctx))
     return results
 
@@ -124,3 +119,33 @@ def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None):
         return jnp.asarray(tensor)
     return jnp.asarray(eng.broadcast(np.asarray(tensor), root_rank,
                                      name=name))
+
+
+def reducescatter(tensor, *, op=Sum, average=None,
+                  name: Optional[str] = None):
+    """Sum across processes, keep this rank's dim-0 slice (rows split as
+    evenly as possible, earlier ranks take the remainder — the negotiated
+    partitioning comes back via the handle's result shape)."""
+    op = _resolve_op(op, average)
+    eng = _engine()
+    if eng is None:
+        # World of one: reduce is identity (any op); keep the full shard.
+        return jnp.asarray(tensor)
+    if op not in (Average, Sum):
+        raise NotImplementedError(
+            f"eager cross-process reducescatter supports SUM/AVERAGE only, "
+            f"got {op}"
+        )
+    host = np.ascontiguousarray(np.asarray(tensor))
+    return jnp.asarray(
+        eng.reducescatter(host, average=(op is Average), name=name))
+
+
+def alltoall(tensor, *, name: Optional[str] = None):
+    """Exchange equal dim-0 blocks between processes: output block i holds
+    the block rank i addressed to this rank.  Requires dim 0 divisible by
+    ``size()`` (mismatches surface as a negotiated typed error)."""
+    eng = _engine()
+    if eng is None:
+        return jnp.asarray(tensor)
+    return jnp.asarray(eng.alltoall(np.asarray(tensor), name=name))

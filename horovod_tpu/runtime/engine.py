@@ -47,6 +47,7 @@ _DTYPE_CODES = {
 }
 
 _OP_ALLREDUCE, _OP_ALLGATHER, _OP_BROADCAST = 0, 1, 2
+_OP_REDUCESCATTER, _OP_ALLTOALL = 3, 4
 
 
 def _dtype_code(dtype) -> int:
@@ -151,6 +152,19 @@ class NativeEngine:
             _OP_BROADCAST, arr, self._auto_name("broadcast", name),
             root_rank=root_rank)
 
+    def enqueue_reducescatter(self, arr: np.ndarray,
+                              name: Optional[str] = None) -> int:
+        """Sum-reduce across ranks, keep this rank's dim-0 slice (rows split
+        as evenly as possible, earlier ranks take the remainder)."""
+        return self._enqueue(
+            _OP_REDUCESCATTER, arr, self._auto_name("reducescatter", name))
+
+    def enqueue_alltoall(self, arr: np.ndarray,
+                         name: Optional[str] = None) -> int:
+        """Exchange equal dim-0 blocks: output block i came from rank i."""
+        return self._enqueue(
+            _OP_ALLTOALL, arr, self._auto_name("alltoall", name))
+
     # -- handle API --
 
     def poll(self, handle: int) -> bool:
@@ -161,7 +175,8 @@ class NativeEngine:
         """Wait; raise on error; return the result buffer.
 
         For allreduce/broadcast this is the (in-place updated) input array;
-        for allgather it is a fresh array with the negotiated shape.
+        for allgather/reducescatter/alltoall it is a fresh array with the
+        negotiated (possibly empty) shape.
         """
         status = self._lib.horovod_wait(handle)
         with self._inflight_lock:
@@ -172,9 +187,8 @@ class NativeEngine:
                 self._lib.horovod_error_message(handle, buf, len(buf))
                 raise HorovodInternalError(
                     buf.value.decode(errors="replace") or "collective failed")
-            nbytes = self._lib.horovod_result_bytes(handle)
-            if nbytes > 0:  # allgather result
-                ndim = self._lib.horovod_result_ndim(handle)
+            ndim = self._lib.horovod_result_ndim(handle)
+            if ndim > 0:  # a fresh out-of-place result was negotiated
                 shape = tuple(self._lib.horovod_result_dim(handle, i)
                               for i in range(ndim))
                 out = np.empty(shape, dtype=arr.dtype)
@@ -189,17 +203,18 @@ class NativeEngine:
 
     # -- sync convenience wrappers --
 
+    def _apply_average(self, out: np.ndarray) -> np.ndarray:
+        """sum → average: floor-divide integers, true-divide floats."""
+        n = self._lib.horovod_size()
+        if np.issubdtype(out.dtype, np.integer):
+            return out // n
+        return (out / np.asarray(n, dtype=out.dtype)).astype(out.dtype)
+
     def allreduce(self, tensor, *, average: bool = False,
                   name: Optional[str] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor).copy()
         out = self.synchronize(self.enqueue_allreduce(arr, name))
-        if average:
-            n = self._lib.horovod_size()
-            if np.issubdtype(out.dtype, np.integer):
-                out = out // n
-            else:
-                out = (out / np.asarray(n, dtype=out.dtype)).astype(out.dtype)
-        return out
+        return self._apply_average(out) if average else out
 
     def allgather(self, tensor, *, name: Optional[str] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)
@@ -211,6 +226,16 @@ class NativeEngine:
                   *, name: Optional[str] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor).copy()
         return self.synchronize(self.enqueue_broadcast(arr, root_rank, name))
+
+    def reducescatter(self, tensor, *, average: bool = False,
+                      name: Optional[str] = None) -> np.ndarray:
+        arr = np.ascontiguousarray(tensor)
+        out = self.synchronize(self.enqueue_reducescatter(arr, name))
+        return self._apply_average(out) if average else out
+
+    def alltoall(self, tensor, *, name: Optional[str] = None) -> np.ndarray:
+        arr = np.ascontiguousarray(tensor)
+        return self.synchronize(self.enqueue_alltoall(arr, name))
 
 
 _engine: Optional[NativeEngine] = None

@@ -225,3 +225,15 @@ def test_eager_size1_identity():
     np.testing.assert_allclose(np.asarray(hvd.broadcast(x, 0)), np.asarray(x))
     with pytest.raises(ValueError):
         hvd.broadcast(x, root_rank=1)
+
+
+def test_eager_reducescatter_alltoall_single_process():
+    """The eager (concrete-array) surface of reducescatter/alltoall: at
+    size()==1 both are identities through the runtime fast path (the
+    round-1 build shipped NotImplementedError stubs here)."""
+    hvd.init()
+    x = jnp.arange(12, dtype=jnp.float32).reshape(4, 3)
+    np.testing.assert_array_equal(np.asarray(hvd.reducescatter(x)),
+                                  np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(hvd.alltoall(x)),
+                                  np.asarray(x))
