@@ -99,6 +99,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
         self._handles: dict = {}
         self._grad_accs = []
+        # id(param) -> sparse_dim for params that have produced a sparse
+        # gradient: the force-allreduce fallback must keep using the sparse
+        # gather path for them (a dense zero allreduce would never
+        # rendezvous with peers' '<name>.idx'/'.vals' allgathers and the
+        # job would stall).
+        self._sparse_params: dict = {}
         self._passes_left = collections.defaultdict(
             lambda: self._bpps)
         # Hooks are registered at any size so behavior (incl. the
@@ -127,11 +133,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         name = self._param_names.get(id(p))
         if p.grad.is_sparse:
             if not self._sparse_as_dense:
-                raise ValueError(
-                    "sparse gradients need DistributedOptimizer("
-                    "sparse_as_dense=True) — the collective data plane is "
-                    "dense (reference sparse_as_dense option, "
-                    "tensorflow/__init__.py:189-199)")
+                self._sparse_params[id(p)] = p.grad.sparse_dim()
+                return self._sparse_allgather_async(p, name)
             p.grad = p.grad.to_dense()
         tensor_compressed, ctx = self._compression.compress(p.grad.data)
         if tensor_compressed.data_ptr() == p.grad.data.data_ptr():
@@ -143,22 +146,62 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 tensor_compressed.contiguous(), average=True, name=name)
         return handle, tensor_compressed, ctx
 
+    def _sparse_allgather_async(self, p, name):
+        """Gather-based sparse aggregation: allgather(indices) +
+        allgather(values), summed by index on apply — memory-sane for large
+        embeddings, where densifying would materialize the full table.
+        Reference: ``tf.IndexedSlices`` handled as allgather of values and
+        indices (tensorflow/__init__.py:67-78); the ragged per-rank nnz
+        rides the engine's negotiated-dim-0 allgather."""
+        g = p.grad.coalesce()
+        idx = g.indices().t().contiguous()   # nnz x sparse_ndim, int64
+        vals = g.values().contiguous()       # nnz x dense_dims
+        h_idx = allgather_async(idx, name=f"{name}.idx" if name else None)
+        h_val = allgather_async(vals, name=f"{name}.vals" if name else None)
+        return ("sparse", h_idx, h_val)
+
     def synchronize(self):
         """Finish all gradient allreduces and write results into ``.grad``
         (reference torch/__init__.py:98-108).  Parameters whose hook never
         fired (no grad this step) are still allreduced so ranks cannot
         deadlock (the force-allreduce contract, reference test_torch.py
-        test_force_allreduce)."""
+        test_force_allreduce).  A param that ever produced a sparse grad
+        takes the sparse gather path here too (with zero entries), so the
+        collective names stay consistent with ranks whose hook did fire —
+        NOTE: on the very first step, a sparse param that fires on some
+        ranks and not others cannot be auto-detected and will stall (the
+        stall warning names the tensor); run one warmup step touching all
+        embeddings, or use sparse_as_dense=True, for data-dependent
+        architectures."""
         for group in self.param_groups:
             for p in group["params"]:
                 if p.requires_grad and p not in self._handles:
                     if p.grad is None:
-                        p.grad = p.data.new_zeros(p.shape)
+                        sd = self._sparse_params.get(id(p))
+                        if sd is not None:
+                            p.grad = torch.sparse_coo_tensor(
+                                torch.zeros((sd, 0), dtype=torch.int64),
+                                p.data.new_zeros((0,) + p.shape[sd:]),
+                                size=p.shape)
+                        else:
+                            p.grad = p.data.new_zeros(p.shape)
                     self._handles[p] = self._allreduce_grad_async(p)
-        for p, (handle, tensor_compressed, ctx) in self._handles.items():
-            output = synchronize(handle)
-            p.grad.data.set_(
-                self._compression.decompress(output, ctx).data)
+        n = size()
+        for p, entry in self._handles.items():
+            if entry[0] == "sparse":
+                _, h_idx, h_val = entry
+                idx_all = synchronize(h_idx)
+                val_all = synchronize(h_val)
+                # coalesce() sums duplicate indices across ranks; divide for
+                # the same average semantics as the dense path.
+                p.grad = torch.sparse_coo_tensor(
+                    idx_all.t(), val_all / n, size=p.grad.shape,
+                    dtype=p.grad.dtype).coalesce()
+            else:
+                handle, tensor_compressed, ctx = entry
+                output = synchronize(handle)
+                p.grad.data.set_(
+                    self._compression.decompress(output, ctx).data)
         self._handles.clear()
 
     def step(self, closure=None):
@@ -172,8 +215,13 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          sparse_as_dense=False):
     """Wrap a torch optimizer so gradients are averaged across ranks during
     ``backward()`` (reference factory, torch/__init__.py:115-150).
-    ``sparse_as_dense`` densifies sparse gradients (e.g. from
-    ``nn.Embedding(sparse=True)``) before reduction."""
+
+    Sparse gradients (e.g. from ``nn.Embedding(sparse=True)``) are
+    aggregated by default via allgather(indices)+allgather(values) — the
+    memory-sane path for large embedding tables (reference
+    tensorflow/__init__.py:67-78) — and stay sparse in ``.grad``;
+    ``sparse_as_dense=True`` densifies them before an ordinary allreduce
+    instead (reference option, tensorflow/__init__.py:189-199)."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,

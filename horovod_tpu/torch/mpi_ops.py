@@ -208,8 +208,9 @@ def _from_np(out_np: np.ndarray, dtype: torch.dtype) -> torch.Tensor:
 
 
 class _HorovodAllgather(torch.autograd.Function):
-    """Backward: sum-allreduce the full grad, keep own slice
-    (reference mpi_ops.py:236-254)."""
+    """Backward: sum-allreduce the full grad, keep own slice at the TRUE
+    offset — per-rank dim-0 sizes are themselves allgathered, so ragged
+    gathers differentiate correctly (reference mpi_ops.py:236-254)."""
 
     @staticmethod
     def forward(ctx, tensor, name):
@@ -218,16 +219,19 @@ class _HorovodAllgather(torch.autograd.Function):
 
     @staticmethod
     def backward(ctx, grad_output):
+        # Enqueue the tiny sizes-gather FIRST so it shares a negotiation
+        # cycle with the grad allreduce instead of serializing after it.
+        h_sizes = allgather_async(torch.tensor([ctx.dim0], dtype=torch.int64))
         grad = allreduce_(grad_output.contiguous().clone(), average=False)
-        r = basics.rank()
-        offset = r * ctx.dim0  # equal dim0 per rank in the autograd path
+        sizes = synchronize(h_sizes)
+        offset = int(sizes[:basics.rank()].sum().item())
         return grad.narrow(0, offset, ctx.dim0), None
 
 
 def allgather(tensor: torch.Tensor,
               name: Optional[str] = None) -> torch.Tensor:
     """Concatenate each rank's tensor along dim 0; per-rank dim 0 may differ
-    (negotiated at runtime).  Differentiable when dim 0 is uniform."""
+    (negotiated at runtime).  Differentiable, including ragged dim 0."""
     return _HorovodAllgather.apply(tensor, name)
 
 

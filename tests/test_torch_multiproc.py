@@ -51,3 +51,22 @@ def test_torch_distributed_optimizer_convergence():
 
 def test_torch_state_broadcast_equalizes():
     run_torch_workers(2, "state_bcast")
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_torch_sparse_gather_matches_dense(n):
+    """Gather-based sparse gradient aggregation == densify-then-allreduce
+    (reference tensorflow/__init__.py:67-78 role)."""
+    run_torch_workers(n, "sparse")
+
+
+def test_torch_sparse_force_allreduce_no_deadlock():
+    """A sparse param whose hook fired on only some ranks must still
+    rendezvous in step() (zero-entry sparse gather fallback)."""
+    run_torch_workers(2, "sparse_force")
+
+
+def test_torch_ragged_allgather_backward():
+    """Ragged dim-0 allgather slices its backward at the true negotiated
+    offset (reference mpi_ops.py:236-254)."""
+    run_torch_workers(3, "ragged_allgather_grad")

@@ -78,10 +78,105 @@ def scenario_state_bcast(rank, size):
         assert torch.allclose(gathered[r], flat)
 
 
+def scenario_sparse(rank, size):
+    # Gather-based sparse aggregation must match the densify path
+    # (reference tf.IndexedSlices handling, tensorflow/__init__.py:67-78):
+    # same averaged gradient values, same weights after the step.
+    def run(sparse_as_dense, tag):
+        torch.manual_seed(5)  # identical init across ranks and paths
+        emb = torch.nn.Embedding(12, 4, sparse=True)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(emb.parameters(), lr=0.1),
+            named_parameters=[(f"emb.{tag}", emb.weight)],
+            sparse_as_dense=sparse_as_dense,
+        )
+        # Overlapping per-rank rows exercise the coalesce-sum on apply.
+        idx = torch.tensor([rank % 12, (rank + 5) % 12, 3])
+        opt.zero_grad()
+        emb(idx).pow(2).sum().backward()
+        opt.step()
+        grad = emb.weight.grad
+        # Gather path keeps the gradient sparse end to end; the densify
+        # path converted it in the backward hook.
+        assert grad.is_sparse == (not sparse_as_dense), grad.layout
+        dense_grad = grad.to_dense() if grad.is_sparse else grad.clone()
+        return dense_grad, emb.weight.detach().clone()
+
+    grad_gather, w_gather = run(sparse_as_dense=False, tag="gather")
+    grad_dense, w_dense = run(sparse_as_dense=True, tag="dense")
+    assert torch.allclose(grad_gather, grad_dense, atol=1e-6), (
+        grad_gather, grad_dense)
+    assert torch.allclose(w_gather, w_dense, atol=1e-6)
+    # And the result really is cross-rank consistent.
+    gathered = hvd.allgather(w_gather.reshape(1, -1))
+    for r in range(size):
+        assert torch.allclose(gathered[r], w_gather.reshape(-1), atol=0)
+
+
+def scenario_sparse_force(rank, size):
+    # Force-allreduce contract for SPARSE params: after a step in which a
+    # sparse param got no gradient on SOME ranks (hook never fired there),
+    # step() must still rendezvous — the fallback enqueues a zero-entry
+    # sparse gather, not a dense allreduce that would never match peers'
+    # '<name>.idx'/'.vals' collectives.
+    torch.manual_seed(5)
+    emb = torch.nn.Embedding(8, 3, sparse=True)
+    lin = torch.nn.Linear(3, 1)
+    named = [("emb.weight", emb.weight)] + [
+        (f"lin.{k}", v) for k, v in lin.named_parameters()]
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(list(emb.parameters()) + list(lin.parameters()),
+                        lr=0.1),
+        named_parameters=named)
+    hvd.broadcast_parameters(dict(named), root_rank=0)
+
+    # Step 1: every rank uses the embedding (sparsity gets recorded).
+    opt.zero_grad()
+    (emb(torch.tensor([rank % 8])).sum()
+     + lin(torch.ones(2, 3)).sum()).backward()
+    opt.step()
+    # Step 2: rank 0's loss skips the embedding entirely.
+    opt.zero_grad()
+    if rank == 0:
+        lin(torch.ones(2, 3)).sum().backward()
+    else:
+        (emb(torch.tensor([(rank + 1) % 8])).sum()
+         + lin(torch.ones(2, 3)).sum()).backward()
+    opt.step()  # must not deadlock
+
+    flat = torch.cat([p.detach().reshape(-1)
+                      for p in list(emb.parameters()) + list(lin.parameters())])
+    gathered = hvd.allgather(flat.reshape(1, -1))
+    for r in range(size):
+        assert torch.allclose(gathered[r], flat, atol=1e-6), (
+            f"rank {rank}: diverged from rank {r}")
+
+
+def scenario_ragged_allgather_grad(rank, size):
+    # Ragged dim-0 allgather must differentiate with the TRUE per-rank
+    # offset (reference mpi_ops.py:236-254); round 1 sliced at rank*dim0.
+    x = torch.full((rank + 1, 2), 1.0, requires_grad=True)
+    gathered = hvd.allgather(x)
+    total_rows = size * (size + 1) // 2
+    assert gathered.shape == (total_rows, 2)
+    # Row-dependent weights make a wrong slice offset visible in the grad.
+    w = torch.arange(total_rows, dtype=torch.float32).reshape(-1, 1)
+    (gathered * w).sum().backward()
+    offset = rank * (rank + 1) // 2  # sum of dim0 of ranks < rank
+    # Backward sum-allreduces grad_output across ranks (every rank applied
+    # the same w), then slices at the true offset — so grad = size * w_slice
+    # (reference mpi_ops.py:236-254 semantics).
+    expect = size * w[offset:offset + rank + 1].expand(rank + 1, 2)
+    assert torch.allclose(x.grad, expect), (x.grad, expect)
+
+
 SCENARIOS = {
     "ops": scenario_ops,
     "optimizer": scenario_optimizer,
     "state_bcast": scenario_state_bcast,
+    "sparse": scenario_sparse,
+    "sparse_force": scenario_sparse_force,
+    "ragged_allgather_grad": scenario_ragged_allgather_grad,
 }
 
 
