@@ -68,6 +68,32 @@ def _peak_flops(device) -> float | None:
     return None
 
 
+def _measured_matmul_peak(iters: int = 10) -> float:
+    """The chip's PRACTICAL bf16 matmul throughput (8192^3, chained so each
+    step depends on the last; host fetch to sync — see _time_step).  The
+    paper-spec peak is not attainable on every deployment (shared/tunneled
+    chips), so MFU is reported against both."""
+    n = 8192
+    k = jax.random.key(0)
+    a = jax.random.normal(k, (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def f(x):
+        return (a @ x) / jnp.float32(n).astype(jnp.bfloat16)
+
+    x = jax.random.normal(k, (n, n), jnp.bfloat16)
+    x = f(x)
+    float(x[0, 0].astype(jnp.float32))
+    best = 0.0
+    for _ in range(3):  # best-of-3: tunnel throughput jitters downward
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            x = f(x)
+        float(x[0, 0].astype(jnp.float32))
+        best = max(best, 2.0 * n ** 3 * iters / (time.perf_counter() - t0))
+    return best
+
+
 def _make_step_and_state(model, mesh, batch_per_chip, image_size, n_chips,
                          devices=None):
     import optax
@@ -151,9 +177,9 @@ def _scaling_efficiency(model_cls, image_size, batch_per_dev, iters, warmup):
         try:
             devices, note = jax.devices("cpu")[:8], "virtual CPU mesh (structural)"
         except RuntimeError:
-            return None, "no 8-device platform available"
+            return None, "no 8-device platform available", None
         if len(devices) < 8:
-            return None, "no 8-device platform available"
+            return None, "no 8-device platform available", None
 
     model = model_cls(dtype=jnp.bfloat16)
     rates = {}
@@ -164,7 +190,10 @@ def _scaling_efficiency(model_cls, image_size, batch_per_dev, iters, warmup):
         dt = _time_step(step, state, data, iters, warmup)
         rates[n] = batch_per_dev * n * iters / dt
     ideal = 8 * rates[1] if real else rates[1]
-    return rates[8] / ideal, note
+    # Raw rates ride along for transparency: on the shared-core virtual
+    # mesh the ratio can exceed 1 (XLA's single CPU device does not use
+    # every host core), which only the raw numbers make interpretable.
+    return rates[8] / ideal, note, rates
 
 
 def main() -> None:
@@ -216,17 +245,27 @@ def main() -> None:
         peak = _peak_flops(jax.devices()[0]) if on_tpu else None
         if peak:
             result["mfu"] = round(sustained / peak, 4)
+        if on_tpu:
+            try:
+                measured = _measured_matmul_peak()
+                result["measured_matmul_tflops"] = round(measured / 1e12, 1)
+                result["mfu_vs_measured_matmul_peak"] = round(
+                    sustained / measured, 4)
+            except Exception:
+                pass
 
     # Degrade gracefully (like the cost-analysis block): never lose the
     # primary throughput line to a scaling-probe failure.
     try:
-        eff, note = _scaling_efficiency(
+        eff, note, rates = _scaling_efficiency(
             ResNet50, scale_size, scale_batch, scale_iters, scale_warmup)
     except Exception as e:
-        eff, note = None, f"scaling probe failed: {e}"
+        eff, note, rates = None, f"scaling probe failed: {e}", None
     if eff is not None:
         result["scaling_efficiency_8dev"] = round(eff, 4)
         result["scaling_mode"] = note
+        result["scaling_img_per_sec_1dev"] = round(rates[1], 2)
+        result["scaling_img_per_sec_8dev"] = round(rates[8], 2)
 
     print(json.dumps(result))
 

@@ -11,6 +11,11 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+echo "== editable install (console script + package metadata) =="
+# --no-build-isolation: zero-egress CI images cannot fetch setuptools;
+# the system one is used instead (plain `pip install -e .` works online).
+pip install -e . -q --no-build-isolation 2>/dev/null || pip install -e . -q
+
 echo "== build native engine =="
 make -C horovod_tpu/cpp
 

@@ -1,0 +1,22 @@
+"""Drop-in alias for the Keras-role frontend.
+
+Reference parity: users of the reference import ``horovod.keras`` (and
+``horovod.tensorflow.keras``, a byte-level near-copy of it — SURVEY.md
+§2.2 P8/P10).  In this framework the Keras role is played by the flax
+frontend (``horovod_tpu.flax``): ``fit`` is the ``model.fit`` analogue,
+``checkpoint.restore_and_broadcast`` the ``load_model`` analogue, and the
+four callbacks keep their reference names.  This module re-exports that
+frontend under the familiar name so reference-era imports read naturally::
+
+    import horovod_tpu.keras as hvd_keras
+
+    hvd_keras.init()
+    state = hvd_keras.fit(state, data_fn, epochs=..., callbacks=[
+        hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd_keras.callbacks.MetricAverageCallback(),
+    ])
+"""
+
+from horovod_tpu.flax import *          # noqa: F401,F403
+from horovod_tpu.flax import callbacks, checkpoint, estimator  # noqa: F401
+from horovod_tpu.flax import __all__    # noqa: F401

@@ -66,8 +66,11 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
-                block_k):
-    # q_ref: [block_q, D]; k_ref/v_ref: [S, D]; o_ref: [block_q, D]
+                block_k, bias_ref=None):
+    # q_ref: [block_q, D]; k_ref/v_ref: [S, D]; o_ref: [block_q, D];
+    # bias_ref (optional): [8, S] additive key bias (0 valid / -1e30
+    # masked), sublane-replicated like lse — key-padding masks for
+    # bidirectional (BERT-style) attention.
     qi = pl.program_id(1)
     block_q, d = q_ref.shape
     s = k_ref.shape[0]
@@ -104,6 +107,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
             # Large-negative (not -inf) keeps exp() finite with no NaN
             # guards on the hot path.
             scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+        if bias_ref is not None:
+            scores = scores + bias_ref[0, pl.dslice(ki * block_k,
+                                                    block_k)][None, :]
         new_m = jnp.maximum(m, jnp.max(scores, axis=1))
         alpha = jnp.exp(m - new_m)
         p = jnp.exp(scores - new_m[:, None])
@@ -125,21 +131,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
         lse_row[None, :], (8, block_q))
 
 
-def _fwd(q, k, v, causal, sm_scale):
-    # q, k, v: [BH, S, D]
+def _bias_spec(bias, bh, s):
+    """BlockSpec for the [B, 8, S] per-BATCH key bias: the grid runs over
+    B*H, so the index map folds heads away instead of replicating the bias
+    per head in HBM."""
+    heads = bh // bias.shape[0]
+    return pl.BlockSpec((None, 8, s), lambda b, i: (b // heads, 0, 0))
+
+
+def _fwd(q, k, v, causal, sm_scale, bias=None):
+    # q, k, v: [BH, S, D]; bias (optional): [B, 8, S] additive key bias.
     bh, s, d = q.shape
     bq = _pick_block(s, BLOCK_Q)
     bk = _pick_block(s, BLOCK_K)
     grid = (bh, s // bq)
+    if bias is None:
+        kernel = functools.partial(_fwd_kernel, causal=causal,
+                                   sm_scale=sm_scale, block_k=bk)
+        inputs, bias_specs = (q, k, v), []
+    else:
+        def kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref):
+            _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, causal=causal,
+                        sm_scale=sm_scale, block_k=bk, bias_ref=bias_ref)
+        inputs = (q, k, v, bias)
+        bias_specs = [_bias_spec(bias, bh, s)]
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, causal=causal, sm_scale=sm_scale,
-                          block_k=bk),
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
-        ],
+        ] + bias_specs,
         out_specs=[
             pl.BlockSpec((None, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, 8, s), lambda b, i: (b, 0, 0)),
@@ -149,7 +172,7 @@ def _fwd(q, k, v, causal, sm_scale):
             jax.ShapeDtypeStruct((bh, 8, s), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     return out, lse
 
 
@@ -158,7 +181,7 @@ def _fwd(q, k, v, causal, sm_scale):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, causal, sm_scale, block_k):
+                   *, causal, sm_scale, block_k, bias_ref=None):
     qi = pl.program_id(1)
     block_q, d = q_ref.shape
     s = k_ref.shape[0]
@@ -186,6 +209,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+        if bias_ref is not None:
+            scores = scores + bias_ref[0, pl.dslice(ki * block_k,
+                                                    block_k)][None, :]
         p = jnp.exp(scores - lse[:, None])
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -201,7 +227,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, causal, sm_scale, block_q):
+                    dk_ref, dv_ref, *, causal, sm_scale, block_q,
+                    bias_ref=None):
     ki = pl.program_id(1)
     block_k, d = k_ref.shape
     s = q_ref.shape[0]
@@ -230,6 +257,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+        if bias_ref is not None:
+            # The KV grid owns a fixed key block: bias slice at this
+            # kernel's own block index.
+            scores = scores + bias_ref[0, pl.dslice(ki * block_k,
+                                                    block_k)][None, :]
         p = jnp.exp(scores - lse_blk[:, None])
         pc = p.astype(do_blk.dtype)
         dv = dv + jax.lax.dot_general(
@@ -252,7 +284,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal, sm_scale, res, do):
+def _bwd_impl(causal, sm_scale, res, do, bias=None):
     q, k, v, out, lse = res
     bh, s, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -262,9 +294,20 @@ def _bwd(causal, sm_scale, res, do):
                              + delta.shape[1:])
     bq = _pick_block(s, BLOCK_Q)
     bk = _pick_block(s, BLOCK_K)
+    bias_specs = [] if bias is None else [_bias_spec(bias, bh, s)]
+    bias_inputs = () if bias is None else (bias,)
+
+    if bias is None:
+        dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal,
+                                      sm_scale=sm_scale, block_k=bk)
+    else:
+        def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      bias_ref, dq_ref):
+            _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dq_ref, causal=causal, sm_scale=sm_scale,
+                           block_k=bk, bias_ref=bias_ref)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal=causal, sm_scale=sm_scale,
-                          block_k=bk),
+        dq_kernel,
         grid=(bh, s // bq),
         in_specs=[
             pl.BlockSpec((None, bq, d), lambda b, i: (b, i, 0)),
@@ -273,14 +316,24 @@ def _bwd(causal, sm_scale, res, do):
             pl.BlockSpec((None, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, 8, s), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, 8, s), lambda b, i: (b, 0, 0)),
-        ],
+        ] + bias_specs,
         out_specs=pl.BlockSpec((None, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *bias_inputs)
+
+    if bias is None:
+        dkv_kernel = functools.partial(_bwd_dkv_kernel, causal=causal,
+                                       sm_scale=sm_scale, block_q=bq)
+    else:
+        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       bias_ref, dk_ref, dv_ref):
+            _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                            dk_ref, dv_ref, causal=causal,
+                            sm_scale=sm_scale, block_q=bq,
+                            bias_ref=bias_ref)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal=causal,
-                          sm_scale=sm_scale, block_q=bq),
+        dkv_kernel,
         grid=(bh, s // bk),
         in_specs=[
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
@@ -289,7 +342,7 @@ def _bwd(causal, sm_scale, res, do):
             pl.BlockSpec((None, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, 8, s), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((None, 8, s), lambda b, i: (b, 0, 0)),
-        ],
+        ] + bias_specs,
         out_specs=[
             pl.BlockSpec((None, bk, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((None, bk, d), lambda b, i: (b, i, 0)),
@@ -299,8 +352,12 @@ def _bwd(causal, sm_scale, res, do):
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, *bias_inputs)
     return dq, dk, dv
+
+
+def _bwd(causal, sm_scale, res, do):
+    return _bwd_impl(causal, sm_scale, res, do)
 
 
 # ---------------------------------------------------------------------------
@@ -321,13 +378,39 @@ def _flash_fwd(q, k, v, causal, sm_scale):
 _flash.defvjp(_flash_fwd, _bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_biased(q, k, v, bias, causal, sm_scale):
+    out, _ = _fwd(q, k, v, causal, sm_scale, bias)
+    return out
+
+
+def _flash_biased_fwd(q, k, v, bias, causal, sm_scale):
+    out, lse = _fwd(q, k, v, causal, sm_scale, bias)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_biased_bwd(causal, sm_scale, res, do):
+    q, k, v, bias, out, lse = res
+    dq, dk, dv = _bwd_impl(causal, sm_scale, (q, k, v, out, lse), do,
+                           bias=bias)
+    # The bias is a constant mask encoding (0 / -1e30); no useful gradient.
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_flash_biased.defvjp(_flash_biased_fwd, _flash_biased_bwd)
+
+
 def _supported(S: int, D: int) -> bool:
     return S % 128 == 0 and D % 128 == 0
 
 
-def flash_attention(q, k, v, *, causal: bool = True):
+def flash_attention(q, k, v, *, causal: bool = True,
+                    key_padding_mask=None):
     """Flash attention on [B, S, H, D] tensors (the model zoo seam).
 
+    ``key_padding_mask``: optional [B, S] boolean (True = attend to that
+    key) — BERT-style padding masks; carried through the kernel as an
+    additive key bias in the same sublane-replicated layout as the LSE.
     GQA (fewer KV heads) is handled by repeating KV heads; falls back to
     the XLA dense path when S or D don't fit the kernel tiling.
     """
@@ -337,11 +420,19 @@ def flash_attention(q, k, v, *, causal: bool = True):
         from horovod_tpu.models.llama import causal_attention
         from horovod_tpu.models.bert import dot_product_attention
 
+        kr = k.repeat(Hq // Hkv, axis=2) if Hkv != Hq else k
+        vr = v.repeat(Hq // Hkv, axis=2) if Hkv != Hq else v
+        if key_padding_mask is not None:
+            mask = key_padding_mask[:, None, None, :]
+            if causal:
+                # Both masks, like the kernel path (bias on top of the
+                # causal triangle).
+                tri = jnp.tril(jnp.ones((S, S), bool))
+                mask = mask & tri[None, None, :, :]
+            return dot_product_attention(q, kr, vr, mask=mask)
         if causal:
             return causal_attention(q, k, v)
-        return dot_product_attention(
-            q, k.repeat(Hq // Hkv, axis=2) if Hkv != Hq else k,
-            v.repeat(Hq // Hkv, axis=2) if Hkv != Hq else v)
+        return dot_product_attention(q, kr, vr)
     if Hkv != Hq:
         k = jnp.repeat(k, Hq // Hkv, axis=2)
         v = jnp.repeat(v, Hq // Hkv, axis=2)
@@ -350,19 +441,38 @@ def flash_attention(q, k, v, *, causal: bool = True):
     qt = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
     kt = k.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
     vt = v.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
-    out = _flash(qt, kt, vt, causal, sm_scale)
+    if key_padding_mask is None:
+        out = _flash(qt, kt, vt, causal, sm_scale)
+    else:
+        bias = jnp.where(key_padding_mask, 0.0, -1e30).astype(jnp.float32)
+        # [B, S] -> [B, 8, S]: sublane-replicated (TPU tiling); heads are
+        # folded away in the kernels' bias BlockSpec, not materialized.
+        bias = jnp.broadcast_to(bias[:, None, :], (B, 8, S))
+        out = _flash_biased(qt, kt, vt, bias, causal, sm_scale)
     return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)
 
 
 def flash_attention_fn(q, k, v, mask=None, **kwargs):
     """Adapter matching the model zoo's pluggable ``attention_fn``.
 
-    The kernel only implements causal masking; an explicit padding mask
-    (e.g. BERT's attention seam) must not be silently dropped."""
-    if mask is not None:
+    ``mask`` follows the zoo's convention (broadcastable [B, 1, 1, S]
+    key-padding mask, True = attend; what BertEncoder passes).  With a
+    mask the attention is bidirectional-masked (BERT semantics); without
+    one it is causal (decoder semantics).  Richer mask structures
+    (arbitrary [B, H, S, S]) are not supported by the kernel — use the
+    dense path for those."""
+    if mask is None:
+        return flash_attention(q, k, v, causal=True)
+    mask = jnp.asarray(mask)
+    if mask.ndim == 4 and mask.shape[1] == 1 and mask.shape[2] == 1:
+        key_mask = mask[:, 0, 0, :]
+    elif mask.ndim == 2:
+        key_mask = mask
+    else:
         raise NotImplementedError(
-            "flash_attention_fn only supports causal masking; got an "
-            "explicit mask — use the dense attention path for masked "
-            "(e.g. padded bidirectional) attention"
+            "flash_attention_fn supports key-padding masks ([B, S] or "
+            "[B, 1, 1, S]); got shape " + str(mask.shape) + " — use the "
+            "dense attention path for richer mask structures"
         )
-    return flash_attention(q, k, v, causal=True)
+    return flash_attention(q, k, v, causal=False,
+                           key_padding_mask=key_mask.astype(bool))

@@ -30,6 +30,8 @@ from horovod_tpu.torch.mpi_ops import (
     allreduce_,
     allreduce_async,
     allreduce_async_,
+    alltoall,
+    alltoall_async,
     broadcast,
     broadcast_,
     broadcast_async,
@@ -39,6 +41,8 @@ from horovod_tpu.torch.mpi_ops import (
     local_size,
     poll,
     rank,
+    reducescatter,
+    reducescatter_async,
     shutdown,
     size,
     synchronize,
@@ -53,6 +57,7 @@ __all__ = [
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
     "allgather", "allgather_async",
     "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
+    "reducescatter", "reducescatter_async", "alltoall", "alltoall_async",
     "poll", "synchronize", "Compression",
     "DistributedOptimizer", "broadcast_parameters",
     "broadcast_optimizer_state",

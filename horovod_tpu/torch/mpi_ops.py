@@ -28,6 +28,8 @@ __all__ = [
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
     "allgather", "allgather_async",
     "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
+    "reducescatter", "reducescatter_async",
+    "alltoall", "alltoall_async",
     "poll", "synchronize", "rank", "size", "local_rank", "local_size",
     "init", "shutdown",
 ]
@@ -283,3 +285,70 @@ class _HorovodBroadcast(torch.autograd.Function):
 def broadcast(tensor: torch.Tensor, root_rank: int,
               name: Optional[str] = None) -> torch.Tensor:
     return _HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+# ---------------------------------------------------------------------------
+# reducescatter / alltoall (engine extensions beyond the reference surface)
+# ---------------------------------------------------------------------------
+
+def reducescatter_async(tensor: torch.Tensor,
+                        name: Optional[str] = None) -> int:
+    """Sum across ranks, keep this rank's dim-0 slice (rows split as evenly
+    as possible; earlier ranks take the remainder)."""
+    eng = _engine()
+    src = tensor.detach().contiguous()
+    if eng is None:
+        return _local_handle(src.clone())
+    view = _np_view(src)
+    handle = eng.enqueue_reducescatter(view, name)
+    return _register(handle, src,
+                     lambda _t, out_np: _from_np(out_np, tensor.dtype))
+
+
+class _HorovodReducescatter(torch.autograd.Function):
+    """Backward of sum-reducescatter is allgather of the slice grads."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        return synchronize(reducescatter_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return synchronize(allgather_async(grad_output.contiguous())), None
+
+
+def reducescatter(tensor: torch.Tensor,
+                  name: Optional[str] = None) -> torch.Tensor:
+    return _HorovodReducescatter.apply(tensor, name)
+
+
+def alltoall_async(tensor: torch.Tensor,
+                   name: Optional[str] = None) -> int:
+    """Exchange equal dim-0 blocks: output block i came from rank i
+    (dim 0 must be divisible by ``size()``)."""
+    eng = _engine()
+    src = tensor.detach().contiguous()
+    if eng is None:
+        return _local_handle(src.clone())
+    view = _np_view(src)
+    handle = eng.enqueue_alltoall(view, name)
+    return _register(handle, src,
+                     lambda _t, out_np: _from_np(out_np, tensor.dtype))
+
+
+class _HorovodAlltoall(torch.autograd.Function):
+    """Alltoall is a permutation of blocks across ranks; its adjoint is the
+    inverse permutation — another alltoall."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        return synchronize(alltoall_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return synchronize(alltoall_async(grad_output.contiguous())), None
+
+
+def alltoall(tensor: torch.Tensor,
+             name: Optional[str] = None) -> torch.Tensor:
+    return _HorovodAlltoall.apply(tensor, name)

@@ -49,7 +49,19 @@ def test_double_init_is_noop():
     assert hvd.is_initialized()
 
 
-def test_subcommunicator_unsupported():
+def test_subcommunicator_identity():
+    """hvd.init(comm=...) rank subsets (reference common/__init__.py:58-84):
+    members get a compacted rank/size; excluded processes become a world of
+    one; invalid inputs are rejected up front.  (Cross-process subset
+    collectives are covered by test_native_engine.py's subset scenario.)"""
     b = HorovodBasics()
-    with pytest.raises(NotImplementedError):
-        b.init(comm=[0, 1])
+    b.init(comm=[2], rank=2, size=3)       # 1-member subset: compacted
+    assert (b.rank(), b.size()) == (0, 1)
+    b2 = HorovodBasics()
+    b2.init(comm=[1, 2], rank=0, size=3)   # excluded -> world of one
+    assert (b2.rank(), b2.size()) == (0, 1)
+    b3 = HorovodBasics()
+    with pytest.raises(ValueError, match="outside the world"):
+        b3.init(comm=[0, 5], rank=0, size=2)
+    with pytest.raises(TypeError):
+        b3.init(comm=object())

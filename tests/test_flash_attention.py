@@ -86,3 +86,76 @@ def test_llama_with_flash_attention():
     got = jax.jit(flash_model.apply)(params, ids)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                atol=5e-4, rtol=5e-4)
+
+
+def test_flash_key_padding_mask_matches_dense():
+    """Masked bidirectional (BERT-style) attention: the kernel's additive
+    key bias must match the dense path's where-masked softmax, in the
+    values AND at padded-query rows' gradients."""
+    from horovod_tpu.models.bert import dot_product_attention
+    from horovod_tpu.ops.flash_attention import flash_attention_fn
+
+    q, k, v = _qkv(B=2, S=256, H=2, Hkv=2)
+    lengths = jnp.array([256, 100])
+    mask = (jnp.arange(256)[None, :] < lengths[:, None])  # [B, S] bool
+
+    expected = dot_product_attention(q, k, v, mask=mask[:, None, None, :])
+    got = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=False, key_padding_mask=mask))(q, k, v)
+    valid = np.asarray(mask)  # compare only rows that attend to real keys
+    np.testing.assert_allclose(np.asarray(got)[valid],
+                               np.asarray(expected)[valid],
+                               atol=2e-5, rtol=2e-5)
+
+    # The attention_fn seam accepts the encoder's [B, 1, 1, S] convention.
+    got2 = jax.jit(flash_attention_fn)(q, k, v, mask[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(got2)[valid],
+                               np.asarray(got)[valid], atol=1e-6)
+
+
+def test_flash_key_padding_mask_gradients():
+    from horovod_tpu.models.bert import dot_product_attention
+
+    q, k, v = _qkv(B=1, S=256, H=2, Hkv=2)
+    mask = (jnp.arange(256)[None, :] < 192)
+    w = mask[:, :, None, None].astype(jnp.float32)  # zero padded-row loss
+
+    def dense_loss(q, k, v):
+        out = dot_product_attention(q, k, v, mask=mask[:, None, None, :])
+        return jnp.sum((out * w) ** 2)
+
+    def flash_loss(q, k, v):
+        out = flash_attention(q, k, v, causal=False, key_padding_mask=mask)
+        return jnp.sum((out * w) ** 2)
+
+    dg = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    fg = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(dg, fg, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch")
+
+
+def test_bert_encoder_with_flash_attention_seam():
+    """BertModel(attention_fn=flash_attention_fn) with a padding mask must
+    match the dense default — the seam the reference-era advisory flagged
+    as silently dropping masks now honors them."""
+    from horovod_tpu.models.bert import BertConfig, BertEncoder
+    from horovod_tpu.ops.flash_attention import flash_attention_fn
+
+    cfg = BertConfig(vocab_size=512, hidden_size=256, num_layers=2,
+                     num_heads=2, intermediate_size=512, max_position=128,
+                     dropout_rate=0.0, dtype=jnp.float32)
+    ids = jax.random.randint(jax.random.key(0), (2, 128), 0, 512)
+    attn_mask = (jnp.arange(128)[None, :]
+                 < jnp.array([128, 80])[:, None]).astype(jnp.int32)
+
+    dense = BertEncoder(cfg)
+    flash = BertEncoder(cfg, attention_fn=flash_attention_fn)
+    params = dense.init(jax.random.key(1), ids)
+    out_d = dense.apply(params, ids, attention_mask=attn_mask)
+    out_f = flash.apply(params, ids, attention_mask=attn_mask)
+    valid = np.asarray(attn_mask, bool)
+    np.testing.assert_allclose(np.asarray(out_f)[valid],
+                               np.asarray(out_d)[valid],
+                               atol=2e-4, rtol=2e-4)

@@ -251,3 +251,16 @@ def test_momentum_correction_warns_for_adaptive(recwarn):
     assert any("no SGD momentum trace" in m for m in msgs)
     # warned once, not per epoch
     assert sum("no SGD momentum trace" in m for m in msgs) == 1
+
+
+def test_keras_alias_reexports_flax_frontend():
+    """horovod_tpu.keras is the reference-familiar name for the Keras-role
+    frontend (reference horovod/keras + horovod/tensorflow/keras, SURVEY.md
+    P8/P10)."""
+    import horovod_tpu.flax as hf
+    import horovod_tpu.keras as hk
+
+    assert hk.fit is hf.fit
+    assert hk.callbacks is hf.callbacks
+    assert hk.checkpoint is hf.checkpoint
+    assert set(hk.__all__) == set(hf.__all__)

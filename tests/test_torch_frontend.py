@@ -158,19 +158,18 @@ def test_broadcast_optimizer_state_lbfgs_rejected():
         hvd.broadcast_optimizer_state(opt)
 
 
-def test_sparse_as_dense():
-    """Sparse embedding grads are densified when requested, rejected with
-    a clear error otherwise (reference sparse_as_dense)."""
+def test_sparse_grad_paths():
+    """Sparse embedding grads: the default gather path keeps them sparse
+    end to end (reference tf.IndexedSlices role); sparse_as_dense=True
+    densifies before reduction (reference option)."""
     emb = torch.nn.EmbeddingBag(10, 4, sparse=True, mode="sum")
-    opt_bad = hvd.DistributedOptimizer(
+    opt_gather = hvd.DistributedOptimizer(
         torch.optim.SGD(emb.parameters(), lr=0.1),
         named_parameters=emb.named_parameters(),
     )
-    with pytest.raises(ValueError, match="sparse_as_dense"):
-        # The hook fires the allreduce during backward, so the rejection
-        # surfaces there (communication/compute overlap by design).
-        emb(torch.tensor([[1, 2], [3, 4]])).sum().backward()
-        opt_bad.step()
+    emb(torch.tensor([[1, 2], [3, 4]])).sum().backward()
+    opt_gather.step()
+    assert emb.weight.grad.is_sparse
 
     emb2 = torch.nn.EmbeddingBag(10, 4, sparse=True, mode="sum")
     opt = hvd.DistributedOptimizer(
@@ -181,3 +180,6 @@ def test_sparse_as_dense():
     emb2(torch.tensor([[1, 2], [3, 4]])).sum().backward()
     opt.step()
     assert not emb2.weight.grad.is_sparse
+    # Same resulting weights either way (size()==1 identity reduction).
+    assert torch.allclose(
+        emb.weight.grad.to_dense(), emb2.weight.grad, atol=1e-6)

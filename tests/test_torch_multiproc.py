@@ -54,6 +54,13 @@ def test_torch_state_broadcast_equalizes():
 
 
 @pytest.mark.parametrize("n", [2, 3])
+def test_torch_reducescatter_alltoall(n):
+    """Torch surface for the engine's reducescatter/alltoall, including
+    autograd (allgather / inverse-permutation adjoints)."""
+    run_torch_workers(n, "rs_alltoall")
+
+
+@pytest.mark.parametrize("n", [2, 3])
 def test_torch_sparse_gather_matches_dense(n):
     """Gather-based sparse gradient aggregation == densify-then-allreduce
     (reference tensorflow/__init__.py:67-78 role)."""

@@ -78,6 +78,36 @@ def scenario_state_bcast(rank, size):
         assert torch.allclose(gathered[r], flat)
 
 
+def scenario_rs_alltoall(rank, size):
+    # reducescatter: sum across ranks, keep own dim-0 slice (uneven rows).
+    rows = size + 1
+    base = torch.arange(rows * 2, dtype=torch.float32).reshape(rows, 2)
+    out = hvd.reducescatter(base * (rank + 1))
+    factor = size * (size + 1) / 2.0
+    my_rows = rows // size + (1 if rank < rows % size else 0)
+    offset = sum(rows // size + (1 if r < rows % size else 0)
+                 for r in range(rank))
+    assert torch.allclose(out, base[offset:offset + my_rows] * factor), out
+    # autograd: d(sum(rs(x)))/dx = 1 everywhere (each input row lands on
+    # exactly one rank; allgather-adjoint restores the full grad).
+    x = torch.full((rows, 2), float(rank), requires_grad=True)
+    hvd.reducescatter(x).sum().backward()
+    assert torch.allclose(x.grad, torch.ones(rows, 2)), x.grad
+
+    # alltoall: block b of rank r carries r*10+b; block s of the output
+    # must carry s*10+rank.
+    blocks = torch.cat([torch.full((2,), float(rank * 10 + b))
+                        for b in range(size)])
+    out = hvd.alltoall(blocks)
+    for s in range(size):
+        assert torch.all(out[2 * s:2 * s + 2] == s * 10 + rank), out
+    # autograd: alltoall adjoint is the inverse block permutation, so
+    # grad-of-identity-loss is all ones.
+    y = blocks.clone().requires_grad_(True)
+    hvd.alltoall(y).sum().backward()
+    assert torch.allclose(y.grad, torch.ones_like(y)), y.grad
+
+
 def scenario_sparse(rank, size):
     # Gather-based sparse aggregation must match the densify path
     # (reference tf.IndexedSlices handling, tensorflow/__init__.py:67-78):
@@ -174,6 +204,7 @@ SCENARIOS = {
     "ops": scenario_ops,
     "optimizer": scenario_optimizer,
     "state_bcast": scenario_state_bcast,
+    "rs_alltoall": scenario_rs_alltoall,
     "sparse": scenario_sparse,
     "sparse_force": scenario_sparse_force,
     "ragged_allgather_grad": scenario_ragged_allgather_grad,
