@@ -196,6 +196,78 @@ def _scaling_efficiency(model_cls, image_size, batch_per_dev, iters, warmup):
     return rates[8] / ideal, note, rates
 
 
+def _llama_bench() -> None:
+    """Opt-in second workload (``python bench.py --model llama``): causal-LM
+    training tokens/s/chip on a ~400M-param Llama with the Pallas flash
+    attention — the BASELINE extras' transformer-family data point.  The
+    driver's default invocation (no args) still runs the ResNet-50 line."""
+    import optax
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.models import LlamaConfig, LlamaModel
+    from horovod_tpu.ops.flash_attention import flash_attention_fn
+
+    hvd.init()
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # head_dim = hidden/heads = 128: the flash kernel's tile (dense
+        # fallback at 64 would materialize [B,H,S,S] scores and OOM).
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024, num_layers=16,
+                          num_heads=8, num_kv_heads=8,
+                          intermediate_size=4096, max_seq_len=2048)
+        batch, seq, iters, warmup = 8, 2048, 10, 3
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, iters, warmup = 1, 128, 2, 1
+    # `batch` above is PER CHIP, like main(): the global batch scales with
+    # the topology so the data mesh always divides it evenly.
+    batch = batch * jax.device_count()
+
+    mesh = hvd.data_parallel_mesh()
+    model = LlamaModel(cfg, attention_fn=flash_attention_fn)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq + 1),
+                                      dtype=np.int32))
+    params = jax.jit(lambda: model.init(jax.random.key(0),
+                                        tokens[:, :-1]))()
+    opt = hvd.DistributedOptimizer(optax.adamw(3e-4))
+
+    def loss_fn(params, batch_tokens):
+        logits = model.apply(params, batch_tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        tgt = batch_tokens[:, 1:]
+        return -jnp.mean(jnp.take_along_axis(logp, tgt[:, :, None], -1))
+
+    step = hvd.make_train_step(loss_fn, opt, mesh)
+    opt_state = jax.jit(opt.inner.init)(params)
+
+    flops = None
+    try:
+        cost = step.lower(params, opt_state, tokens).compile().cost_analysis()
+        if not isinstance(cost, dict):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    state = (params, opt_state)
+    dt = _time_step(step, state, tokens, iters, warmup)
+    tok_per_sec = batch * seq * iters / dt
+    result = {
+        "metric": "llama_train_tokens_per_sec_per_chip"
+                  if on_tpu else "llama_train_tokens_per_sec_cpu_smoke",
+        "value": round(tok_per_sec / jax.device_count(), 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,  # the reference has no transformer workload
+    }
+    if flops is not None:
+        sustained = flops * iters / dt / jax.device_count()
+        result["sustained_tflops"] = round(sustained / 1e12, 2)
+        peak = _peak_flops(jax.devices()[0]) if on_tpu else None
+        if peak:
+            result["mfu"] = round(sustained / peak, 4)
+    print(json.dumps(result))
+
+
 def main() -> None:
     import horovod_tpu.jax as hvd
     from horovod_tpu.models import ResNet50
@@ -271,4 +343,16 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="horovod_tpu benchmark harness")
+    parser.add_argument(
+        "--model", choices=["resnet50", "llama"], default="resnet50",
+        help="workload: resnet50 (the driver's headline metric, default) "
+             "or llama (opt-in causal-LM tokens/s with flash attention)")
+    args = parser.parse_args()
+    if args.model == "llama":
+        _llama_bench()
+    else:
+        main()

@@ -90,6 +90,7 @@ class HorovodBasics:
         local_rank: Optional[int] = None,
         local_size: Optional[int] = None,
         coordinator: Optional[str] = None,
+        jax_distributed: Optional[bool] = None,
     ) -> None:
         """Initialize the runtime.
 
@@ -111,6 +112,17 @@ class HorovodBasics:
         single-process defaults.  Unlike the reference there is no MPI_Init:
         process rendezvous is the JAX coordination service's job (SURVEY.md
         §3.1 "TPU equivalent").
+
+        ``jax_distributed=True`` (or ``HOROVOD_JAX_DISTRIBUTED=1``)
+        additionally bootstraps JAX's own multi-process runtime
+        (``jax.distributed.initialize``) from the same identity, so the
+        launcher-provided rank/size/coordinator stands in for the pod's
+        usual metadata discovery: after init, ``jax.devices()`` spans every
+        process's chips and the jit/GSPMD path runs true multi-host.  The JAX
+        coordination service listens on the engine coordinator's port + 64
+        (override with ``HOROVOD_JAX_COORDINATOR=host:port``).  Must be
+        called before the first JAX backend use, and is not compatible with
+        ``comm=`` subsets (JAX has one global process group).
         """
         with self._lock:
             if self._initialized:
@@ -184,6 +196,15 @@ class HorovodBasics:
                                 f"{host}:{int(port) + 1 + members[0]}"
                             )
 
+            if jax_distributed is None:
+                jax_distributed = os.environ.get(
+                    "HOROVOD_JAX_DISTRIBUTED", "") not in ("", "0")
+            if jax_distributed and comm:
+                raise ValueError(
+                    "jax_distributed cannot be combined with comm= subsets "
+                    "(JAX has one global process group)"
+                )
+
             if not (0 < size and 0 <= rank < size):
                 raise ValueError(
                     f"invalid identity: rank={rank}, size={size}"
@@ -193,6 +214,36 @@ class HorovodBasics:
                     f"invalid local identity: local_rank={local_rank}, "
                     f"local_size={local_size} (size={size})"
                 )
+
+            # After identity validation, so a bad rank/size raises the
+            # clear error above instead of hanging inside JAX's
+            # coordination service.
+            if jax_distributed and size > 1:
+                jaddr = os.environ.get("HOROVOD_JAX_COORDINATOR")
+                if not jaddr:
+                    base = coordinator or os.environ.get(
+                        "HOROVOD_COORDINATOR", "")
+                    if not base or ":" not in base:
+                        raise ValueError(
+                            "jax_distributed needs a coordinator address "
+                            "(HOROVOD_COORDINATOR / coordinator= / "
+                            "HOROVOD_JAX_COORDINATOR)"
+                        )
+                    host, _, port = base.rpartition(":")
+                    jaddr = f"{host}:{int(port) + 64}"
+                import jax
+
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=jaddr,
+                        num_processes=size,
+                        process_id=rank,
+                    )
+                except RuntimeError as e:
+                    # A retried init() after a failure elsewhere finds the
+                    # JAX runtime already up — that is fine.
+                    if "already" not in str(e).lower():
+                        raise
             self._rank = rank
             self._size = size
             self._local_rank = local_rank

@@ -7,9 +7,11 @@ discovered on rank 0 and broadcast (``keras_imagenet_resnet50.py:64-73``),
 and state re-syncs via broadcast / ``hvd.load_model``
 (``keras/impl.py:93-109``).  Here the pattern is a library feature:
 flax.serialization msgpack files with atomic rank-0 writes and
-broadcast-on-resume.  (Orbax sharded/async checkpointing is not used; for
-multi-host sharded checkpoints bring orbax directly — these helpers cover
-the reference's replicated-state pattern.)
+broadcast-on-resume.  For the GSPMD path (sharded params over a device
+mesh) ``save_sharded``/``restore_sharded`` use orbax: every shard is
+written/read with its sharding preserved, so FSDP/TP states checkpoint
+without gathering to one host — the TPU-native upgrade the replicated
+msgpack pattern cannot provide.
 """
 
 from __future__ import annotations
@@ -28,6 +30,9 @@ __all__ = [
     "latest_checkpoint",
     "resume_epoch",
     "restore_and_broadcast",
+    "save_sharded",
+    "restore_sharded",
+    "latest_sharded",
 ]
 
 
@@ -108,3 +113,66 @@ def restore_and_broadcast(directory: str, target: Any,
         state = load_checkpoint(found[0], target)
     state = hvd.broadcast_parameters(state, root_rank=root_rank)
     return state, start_epoch
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints (GSPMD path) via orbax
+# ---------------------------------------------------------------------------
+
+def _sharded_path(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"sharded-{step}")
+
+
+def save_sharded(directory: str, state: Any, step: int) -> str:
+    """Checkpoint a SHARDED pytree (params/opt_state laid out over a mesh
+    with ``NamedSharding``) without gathering: orbax writes each process's
+    owned shards and records the shardings.  Use for FSDP/TP states where
+    the replicated ``save_checkpoint`` would materialize the full model on
+    one host.  Within one JAX process group only (the jit/GSPMD world) —
+    the engine's independent multi-process ranks each see their own JAX
+    runtime and should use the rank-0 msgpack pattern instead.
+
+    Requires orbax-checkpoint (``pip install horovod-tpu[sharded-checkpoint]``).
+    """
+    import orbax.checkpoint as ocp
+
+    path = _sharded_path(directory, step)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, state, force=True)
+    return path
+
+
+def restore_sharded(directory: str, target: Any, step: Optional[int] = None):
+    """Restore a sharded checkpoint directly INTO the shardings of
+    ``target`` (a pytree of sharded arrays or ShapeDtypeStructs with
+    ``.sharding`` set): each device reads only its own shards.  ``step``
+    defaults to the newest.  Returns ``(state, step)`` or ``(target, None)``
+    when no sharded checkpoint exists."""
+    import orbax.checkpoint as ocp
+
+    if step is None:
+        found = latest_sharded(directory)
+        if found is None:
+            return target, None
+        step = found[1]
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=getattr(x, "sharding", None)),
+        target)
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(_sharded_path(directory, step), abstract)
+    return state, step
+
+
+def latest_sharded(directory: str) -> Optional[tuple[str, int]]:
+    """(path, step) of the newest sharded checkpoint, or None."""
+    if not os.path.isdir(directory):
+        return None
+    best = None
+    for fname in os.listdir(directory):
+        m = re.fullmatch(r"sharded-(\d+)", fname)
+        if m:
+            step = int(m.group(1))
+            if best is None or step > best[1]:
+                best = (os.path.join(directory, fname), step)
+    return best

@@ -19,6 +19,7 @@ round-trip.  The plan is shape-static, so it traces once per pytree structure.
 from __future__ import annotations
 
 import os
+import sys
 from dataclasses import dataclass
 from typing import Any, Callable, Sequence
 
@@ -103,6 +104,38 @@ def _mk_bucket(dtype, idxs: list[int], leaves) -> _Bucket:
     return _Bucket(dtype=dtype, indices=tuple(idxs), sizes=sizes, shapes=shapes)
 
 
+#: Plans already reported this process (HOROVOD_FUSION_REPORT dedup).
+_reported_plans: set = set()
+
+
+def _maybe_report(plan: FusionPlan) -> None:
+    """HOROVOD_FUSION_REPORT=1: print each distinct fusion plan once.
+
+    The jit-path counterpart of the timeline's negotiation visibility
+    (SURVEY.md §5.1): fusion happens at TRACE time here, so a one-shot
+    bucket report is the observable record of what got batched into each
+    ICI collective — the information the eager engine's timeline shows as
+    fused response lists."""
+    if not os.environ.get("HOROVOD_FUSION_REPORT"):
+        return
+    key = tuple((str(b.dtype), b.sizes) for b in plan.buckets)
+    if key in _reported_plans:
+        return
+    _reported_plans.add(key)
+    print(
+        f"horovod_tpu fusion: {plan.n_leaves} tensors -> "
+        f"{len(plan.buckets)} fused collective(s)",
+        file=sys.stderr,
+    )
+    for n, b in enumerate(plan.buckets):
+        nbytes = sum(b.sizes) * np.dtype(b.dtype).itemsize
+        print(
+            f"  bucket {n}: {len(b.indices)} x {np.dtype(b.dtype).name}, "
+            f"{sum(b.sizes)} elements ({nbytes / 2**20:.2f} MiB)",
+            file=sys.stderr,
+        )
+
+
 def fuse_apply(
     tree: Any,
     fn: Callable[[jax.Array], jax.Array],
@@ -118,6 +151,7 @@ def fuse_apply(
     if not leaves:
         return tree
     plan = plan_fusion(leaves, threshold_bytes)
+    _maybe_report(plan)
     out: list[Any] = [None] * plan.n_leaves
     for bucket in plan.buckets:
         if len(bucket.indices) == 1:

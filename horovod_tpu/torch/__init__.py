@@ -22,6 +22,7 @@ from typing import Optional
 import torch
 
 from horovod_tpu.common.basics import basics
+from horovod_tpu.torch import bridge
 from horovod_tpu.torch.compression import Compression
 from horovod_tpu.torch.mpi_ops import (
     allgather,
@@ -36,6 +37,8 @@ from horovod_tpu.torch.mpi_ops import (
     broadcast_,
     broadcast_async,
     broadcast_async_,
+    grouped_allreduce,
+    grouped_allreduce_async,
     init,
     local_rank,
     local_size,
@@ -55,10 +58,11 @@ __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
     "local_size", "mpi_threads_supported",
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_async",
     "allgather", "allgather_async",
     "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
     "reducescatter", "reducescatter_async", "alltoall", "alltoall_async",
-    "poll", "synchronize", "Compression",
+    "poll", "synchronize", "Compression", "bridge",
     "DistributedOptimizer", "broadcast_parameters",
     "broadcast_optimizer_state",
 ]

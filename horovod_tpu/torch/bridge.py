@@ -1,0 +1,51 @@
+"""torch ↔ JAX tensor bridge.
+
+SURVEY.md §7 "hard parts" names PyTorch-on-TPU: with no CUDA in a TPU pod,
+the torch frontend must hand tensors between torch (host CPU) and JAX (the
+accelerator path).  The reference's precedent is the ``CudaOnCPU`` staging
+pattern (reference torch/mpi_ops_v2.cc:78-110: GPU tensors staged through
+CPU copies); here the handoff is dlpack — zero-copy on CPU, one
+host↔device transfer to/from the TPU:
+
+    x_jax = bridge.to_jax(torch_tensor)        # CPU: zero-copy
+    y = jax.jit(model)(x_jax)                  # TPU compute
+    torch_out = bridge.from_jax(y)             # device->host + zero-copy
+
+Falls back to a numpy copy for dtypes/layouts dlpack refuses (bool,
+non-contiguous), so the bridge never fails where a copy would work.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+
+__all__ = ["to_jax", "from_jax"]
+
+
+def to_jax(tensor: torch.Tensor, device=None):
+    """A JAX array viewing (CPU, zero-copy when possible) or holding a copy
+    of ``tensor``.  ``device`` optionally places the result (e.g.
+    ``jax.devices()[0]`` for the TPU)."""
+    import jax
+
+    t = tensor.detach()
+    try:
+        arr = jax.dlpack.from_dlpack(t.contiguous())
+    except Exception:
+        arr = jax.numpy.asarray(t.cpu().numpy())
+    if device is not None:
+        arr = jax.device_put(arr, device)
+    return arr
+
+
+def from_jax(array) -> torch.Tensor:
+    """A torch CPU tensor viewing (zero-copy when possible) or holding a
+    copy of ``array``; device arrays are fetched to host first."""
+    import jax
+
+    arr = jax.device_get(array)
+    try:
+        return torch.from_dlpack(arr)
+    except Exception:
+        return torch.from_numpy(np.asarray(arr).copy())

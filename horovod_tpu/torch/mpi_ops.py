@@ -26,6 +26,7 @@ from horovod_tpu.common.basics import basics
 
 __all__ = [
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_async",
     "allgather", "allgather_async",
     "broadcast", "broadcast_async", "broadcast_", "broadcast_async_",
     "reducescatter", "reducescatter_async",
@@ -178,6 +179,25 @@ def allreduce(tensor: torch.Tensor, average: bool = True,
     wire, cctx = compression.compress(tensor)
     reduced = _HorovodAllreduce.apply(wire, average, name)
     return compression.decompress(reduced, cctx)
+
+
+def grouped_allreduce_async(tensors, average: bool = True,
+                            name: Optional[str] = None) -> list:
+    """Allreduce many tensors in one burst: enqueued together, the
+    coordinator negotiates them in the same cycle and fuses same-dtype
+    batches into single ring collectives (the engine-side analogue of the
+    reference's fusion buffer).  Returns one handle per tensor."""
+    return [
+        allreduce_async(t, average,
+                        None if name is None else f"{name}.{i}")
+        for i, t in enumerate(tensors)
+    ]
+
+
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None) -> list:
+    return [synchronize(h)
+            for h in grouped_allreduce_async(tensors, average, name)]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,47 @@
+"""Worker for the jax_distributed bootstrap test: two CPU processes with 2
+forced devices each join one JAX process group through
+``hvd.init(jax_distributed=True)`` and run a real cross-process collective.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # keep sitecustomize off the TPU
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    import jax
+
+    # Multi-process CPU needs the gloo collectives client (TPU pods don't).
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    basics.init(jax_distributed=True)
+
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    assert jax.process_count() == size, jax.process_count()
+    assert jax.process_index() == rank
+    assert jax.device_count() == 2 * size, jax.device_count()
+    assert len(jax.local_devices()) == 2
+
+    # A real cross-process data movement: rank 0's value reaches everyone.
+    got = multihost_utils.broadcast_one_to_all(
+        np.full((4,), float(rank + 7), np.float32))
+    assert np.allclose(np.asarray(got), 7.0), got
+    print(f"jaxdist worker rank={rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

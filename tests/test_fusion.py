@@ -105,3 +105,20 @@ def test_env_threshold(monkeypatch):
     assert fusion.fusion_threshold_bytes() == 12345
     monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD")
     assert fusion.fusion_threshold_bytes() == fusion.DEFAULT_FUSION_THRESHOLD
+
+
+def test_fusion_report(monkeypatch, capsys):
+    """HOROVOD_FUSION_REPORT=1 prints each distinct plan once (the jit-path
+    analogue of the timeline's fused-response visibility)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import fusion
+
+    monkeypatch.setenv("HOROVOD_FUSION_REPORT", "1")
+    fusion._reported_plans.clear()
+    tree = {"a": jnp.ones(10), "b": jnp.ones(20), "c": jnp.ones(5, jnp.int32)}
+    fusion.fuse_apply(tree, lambda x: x)
+    fusion.fuse_apply(tree, lambda x: x)  # same plan: reported once
+    err = capsys.readouterr().err
+    assert err.count("fused collective(s)") == 1
+    assert "2 x float32" in err and "1 x int32" in err

@@ -1,0 +1,41 @@
+"""hvd.init(jax_distributed=True): the launcher identity bootstraps JAX's
+own multi-process runtime so the jit/GSPMD path spans processes (the
+pod-metadata role of ``jax.distributed.initialize``, driven from
+HOROVOD_RANK/SIZE/COORDINATOR instead)."""
+
+import os
+import subprocess
+import sys
+
+from tests.test_native_engine import _free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "jaxdist_worker.py")
+
+
+def test_jax_distributed_bootstrap_two_processes():
+    port = _free_port()
+    jax_port = _free_port()  # explicit: the derived port+64 may be taken
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # worker sets its own 2-device flag
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": "2",
+            "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
+            "HOROVOD_JAX_COORDINATOR": f"127.0.0.1:{jax_port}",
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        ))
+    results = [p.communicate(timeout=180) for p in procs]
+    for rank, (p, (out, err)) in enumerate(zip(procs, results)):
+        assert p.returncode == 0, (
+            f"rank {rank} failed (rc={p.returncode}):\n"
+            f"stdout: {out.decode()}\nstderr: {err.decode()}"
+        )
+        assert b"OK" in out

@@ -183,3 +183,23 @@ def test_sparse_grad_paths():
     # Same resulting weights either way (size()==1 identity reduction).
     assert torch.allclose(
         emb.weight.grad.to_dense(), emb2.weight.grad, atol=1e-6)
+
+
+def test_torch_jax_bridge_roundtrip():
+    """dlpack handoff between the torch frontend and the JAX compute path
+    (SURVEY.md §7 'PyTorch-on-TPU' hard part)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.torch import bridge
+
+    t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+    a = bridge.to_jax(t)
+    assert a.shape == (3, 4)
+    np.testing.assert_array_equal(np.asarray(a), t.numpy())
+    # jax compute then back
+    back = bridge.from_jax(jnp.asarray(a) * 2)
+    assert torch.allclose(back, t * 2)
+    # dtypes dlpack may refuse still work via the copy fallback
+    b = torch.tensor([True, False, True])
+    assert bool(bridge.from_jax(bridge.to_jax(b))[0]) is True

@@ -53,6 +53,12 @@ def test_torch_state_broadcast_equalizes():
     run_torch_workers(2, "state_bcast")
 
 
+def test_torch_grouped_allreduce():
+    """grouped_allreduce: one negotiation burst, per-tensor value identity
+    (engine fusion parity with the reference's fused batches)."""
+    run_torch_workers(3, "grouped")
+
+
 @pytest.mark.parametrize("n", [2, 3])
 def test_torch_reducescatter_alltoall(n):
     """Torch surface for the engine's reducescatter/alltoall, including

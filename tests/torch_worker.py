@@ -78,6 +78,17 @@ def scenario_state_bcast(rank, size):
         assert torch.allclose(gathered[r], flat)
 
 
+def scenario_grouped(rank, size):
+    # One burst of many tensors: the coordinator negotiates them in a
+    # single cycle and fuses same-dtype runs into few ring collectives;
+    # values must match per-tensor allreduce exactly.
+    tensors = [torch.full((n + 1, 2), float(rank + n)) for n in range(12)]
+    outs = hvd.grouped_allreduce(tensors, average=False, name="grp")
+    for n, out in enumerate(outs):
+        expected = float(sum(r + n for r in range(size)))
+        assert torch.all(out == expected), (n, out[0, 0], expected)
+
+
 def scenario_rs_alltoall(rank, size):
     # reducescatter: sum across ranks, keep own dim-0 slice (uneven rows).
     rows = size + 1
@@ -204,6 +215,7 @@ SCENARIOS = {
     "ops": scenario_ops,
     "optimizer": scenario_optimizer,
     "state_bcast": scenario_state_bcast,
+    "grouped": scenario_grouped,
     "rs_alltoall": scenario_rs_alltoall,
     "sparse": scenario_sparse,
     "sparse_force": scenario_sparse_force,
