@@ -94,6 +94,17 @@ def _measured_matmul_peak(iters: int = 10) -> float:
     return best
 
 
+def _step_flops(step, *args):
+    """XLA cost-analysis FLOPs of the compiled step, or None."""
+    try:
+        cost = step.lower(*args).compile().cost_analysis()
+        if not isinstance(cost, dict):  # older jax returns a list
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
 def _make_step_and_state(model, mesh, batch_per_chip, image_size, n_chips,
                          devices=None):
     import optax
@@ -241,14 +252,7 @@ def _llama_bench() -> None:
     step = hvd.make_train_step(loss_fn, opt, mesh)
     opt_state = jax.jit(opt.inner.init)(params)
 
-    flops = None
-    try:
-        cost = step.lower(params, opt_state, tokens).compile().cost_analysis()
-        if not isinstance(cost, dict):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0)) or None
-    except Exception:
-        pass
+    flops = _step_flops(step, params, opt_state, tokens)
     state = (params, opt_state)
     dt = _time_step(step, state, tokens, iters, warmup)
     tok_per_sec = batch * seq * iters / dt
@@ -289,14 +293,7 @@ def main() -> None:
     train_step, state, data = _make_step_and_state(
         model, mesh, batch_per_chip, image_size, n_chips)
 
-    flops_per_step = None
-    try:
-        cost = train_step.lower(*state, data).compile().cost_analysis()
-        if not isinstance(cost, dict):  # older jax returns a list
-            cost = cost[0]
-        flops_per_step = float(cost.get("flops", 0.0)) or None
-    except Exception:
-        pass
+    flops_per_step = _step_flops(train_step, *state, data)
 
     dt = _time_step(train_step, state, data, iters, warmup)
     total_img_per_sec = batch_per_chip * n_chips * iters / dt

@@ -218,6 +218,14 @@ class HorovodBasics:
             # After identity validation, so a bad rank/size raises the
             # clear error above instead of hanging inside JAX's
             # coordination service.
+            if jax_distributed and from_jax:
+                raise ValueError(
+                    "jax_distributed=True needs an explicit identity "
+                    "(rank/size kwargs or HOROVOD_RANK/HOROVOD_SIZE env): "
+                    "discovering it from JAX already initialized the "
+                    "backend, which is too late for "
+                    "jax.distributed.initialize"
+                )
             if jax_distributed and size > 1:
                 jaddr = os.environ.get("HOROVOD_JAX_COORDINATOR")
                 if not jaddr:

@@ -42,13 +42,16 @@ const char* horovod_last_error() {
 
 // op: 0 = allreduce, 1 = allgather, 2 = broadcast, 3 = reducescatter,
 // 4 = alltoall (RequestType values).
+// red_op: 0 = sum, 1 = min, 2 = max, 3 = prod (ReduceOp values;
+// allreduce/reducescatter only).
 // Returns handle >= 0, -1 on duplicate in-flight name, -2 if not running.
 int64_t horovod_enqueue(int op, const char* name, int dtype, int ndim,
-                        const int64_t* shape, void* data, int root_rank) {
+                        const int64_t* shape, void* data, int root_rank,
+                        int red_op) {
   std::vector<int64_t> dims(shape, shape + ndim);
   return Engine::Get().Enqueue(static_cast<RequestType>(op), name,
                                static_cast<DataType>(dtype), dims, data,
-                               root_rank);
+                               root_rank, static_cast<hvd::ReduceOp>(red_op));
 }
 
 int horovod_poll(int64_t handle) { return Engine::Get().Poll(handle); }

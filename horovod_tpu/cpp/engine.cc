@@ -78,43 +78,75 @@ static inline uint16_t FloatToBF16(float v) {
   return static_cast<uint16_t>((f + rounding) >> 16);
 }
 
-template <typename T>
-static void SumLoop(void* dst, const void* src, int64_t n) {
+template <typename T, typename F>
+static void CombineLoop(void* dst, const void* src, int64_t n, F f) {
   T* d = static_cast<T*>(dst);
   const T* s = static_cast<const T*>(src);
-  for (int64_t i = 0; i < n; ++i) d[i] += s[i];
+  for (int64_t i = 0; i < n; ++i) d[i] = f(d[i], s[i]);
 }
 
-void ReduceSumInto(void* dst, const void* src, int64_t count, DataType dtype) {
+template <typename T>
+static void TypedReduce(void* dst, const void* src, int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+      CombineLoop<T>(dst, src, n, [](T a, T b) { return static_cast<T>(a + b); });
+      return;
+    case ReduceOp::MIN:
+      CombineLoop<T>(dst, src, n, [](T a, T b) { return b < a ? b : a; });
+      return;
+    case ReduceOp::MAX:
+      CombineLoop<T>(dst, src, n, [](T a, T b) { return a < b ? b : a; });
+      return;
+    case ReduceOp::PROD:
+      CombineLoop<T>(dst, src, n, [](T a, T b) { return static_cast<T>(a * b); });
+      return;
+  }
+}
+
+// 16-bit floats combine through fp32 (conversion round trip per element —
+// a host control-plane data path, not the accelerator hot path).
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+static void HalfReduce(void* dst, const void* src, int64_t n, ReduceOp op) {
+  uint16_t* d = static_cast<uint16_t*>(dst);
+  const uint16_t* s = static_cast<const uint16_t*>(src);
+  for (int64_t i = 0; i < n; ++i) {
+    float a = ToF(d[i]), b = ToF(s[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::SUM: r = a + b; break;
+      case ReduceOp::MIN: r = b < a ? b : a; break;
+      case ReduceOp::MAX: r = a < b ? b : a; break;
+      default: r = a * b; break;
+    }
+    d[i] = FromF(r);
+  }
+}
+
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
+                ReduceOp op) {
   switch (dtype) {
-    case DataType::FLOAT32: SumLoop<float>(dst, src, count); return;
-    case DataType::FLOAT64: SumLoop<double>(dst, src, count); return;
-    case DataType::INT32: SumLoop<int32_t>(dst, src, count); return;
-    case DataType::INT64: SumLoop<int64_t>(dst, src, count); return;
-    case DataType::UINT8: SumLoop<uint8_t>(dst, src, count); return;
-    case DataType::INT8: SumLoop<int8_t>(dst, src, count); return;
-    case DataType::UINT16: SumLoop<uint16_t>(dst, src, count); return;
-    case DataType::INT16: SumLoop<int16_t>(dst, src, count); return;
-    case DataType::FLOAT16: {
-      uint16_t* d = static_cast<uint16_t*>(dst);
-      const uint16_t* s = static_cast<const uint16_t*>(src);
-      for (int64_t i = 0; i < count; ++i) {
-        d[i] = FloatToHalf(HalfToFloat(d[i]) + HalfToFloat(s[i]));
-      }
+    case DataType::FLOAT32: TypedReduce<float>(dst, src, count, op); return;
+    case DataType::FLOAT64: TypedReduce<double>(dst, src, count, op); return;
+    case DataType::INT32: TypedReduce<int32_t>(dst, src, count, op); return;
+    case DataType::INT64: TypedReduce<int64_t>(dst, src, count, op); return;
+    case DataType::UINT8: TypedReduce<uint8_t>(dst, src, count, op); return;
+    case DataType::INT8: TypedReduce<int8_t>(dst, src, count, op); return;
+    case DataType::UINT16: TypedReduce<uint16_t>(dst, src, count, op); return;
+    case DataType::INT16: TypedReduce<int16_t>(dst, src, count, op); return;
+    case DataType::FLOAT16:
+      HalfReduce<HalfToFloat, FloatToHalf>(dst, src, count, op);
       return;
-    }
-    case DataType::BFLOAT16: {
-      uint16_t* d = static_cast<uint16_t*>(dst);
-      const uint16_t* s = static_cast<const uint16_t*>(src);
-      for (int64_t i = 0; i < count; ++i) {
-        d[i] = FloatToBF16(BF16ToFloat(d[i]) + BF16ToFloat(s[i]));
-      }
+    case DataType::BFLOAT16:
+      HalfReduce<BF16ToFloat, FloatToBF16>(dst, src, count, op);
       return;
-    }
     case DataType::BOOL: {
       uint8_t* d = static_cast<uint8_t*>(dst);
       const uint8_t* s = static_cast<const uint8_t*>(src);
-      for (int64_t i = 0; i < count; ++i) d[i] = d[i] || s[i];
+      // sum/max = logical or; min/prod = logical and.
+      bool lor = op == ReduceOp::SUM || op == ReduceOp::MAX;
+      for (int64_t i = 0; i < count; ++i) {
+        d[i] = lor ? (d[i] || s[i]) : (d[i] && s[i]);
+      }
       return;
     }
   }
@@ -623,6 +655,17 @@ Response Engine::BuildResponse(const std::string& name) {
       resp.error_message = err.str();
       return resp;
     }
+    if ((first.type == RequestType::ALLREDUCE ||
+         first.type == RequestType::REDUCESCATTER) &&
+        q.red_op != first.red_op) {
+      err << "Mismatched reduction operators: rank 0 requested "
+          << ReduceOpName(first.red_op) << " but rank " << r
+          << " requested " << ReduceOpName(q.red_op) << " for tensor "
+          << name << ".";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
     if (q.dtype != first.dtype) {
       err << "Mismatched data types: rank 0 has " << DataTypeName(first.dtype)
           << " but rank " << r << " has " << DataTypeName(q.dtype)
@@ -669,6 +712,7 @@ Response Engine::BuildResponse(const std::string& name) {
     // Reducescatter: rows split as evenly as possible, earlier ranks get
     // the remainder (same convention as the ring segments).
     resp.type = ResponseType::REDUCESCATTER;
+    resp.red_op = first.red_op;
     int64_t rows = first.shape[0];
     for (int r = 0; r < size_; ++r) {
       resp.tensor_sizes.push_back(rows / size_ +
@@ -733,6 +777,7 @@ Response Engine::BuildResponse(const std::string& name) {
     return resp;
   }
   resp.type = ResponseType::ALLREDUCE;
+  resp.red_op = first.red_op;
   return resp;
 }
 
@@ -757,6 +802,7 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
   for (auto& resp : responses) {
     if (resp.type == ResponseType::ALLREDUCE && !fused.empty() &&
         fused.back().type == ResponseType::ALLREDUCE &&
+        fused.back().red_op == resp.red_op &&
         entry_dtype(fused.back().tensor_names[0]) ==
             entry_dtype(resp.tensor_names[0])) {
       int64_t total = 0;
@@ -834,9 +880,9 @@ void Engine::PerformResponse(const Response& response) {
 static bool RingReduceScatterPhase(uint8_t* base,
                                    const std::vector<int64_t>& seg_count,
                                    const std::vector<int64_t>& seg_off,
-                                   DataType dtype, int vrank, int size,
-                                   Socket& next, Socket& prev, int timeout_ms,
-                                   std::string* err) {
+                                   DataType dtype, ReduceOp op, int vrank,
+                                   int size, Socket& next, Socket& prev,
+                                   int timeout_ms, std::string* err) {
   const size_t esize = DataTypeSize(dtype);
   int64_t max_seg = 0;
   for (auto c : seg_count) max_seg = std::max(max_seg, c);
@@ -851,8 +897,8 @@ static bool RingReduceScatterPhase(uint8_t* base,
                      timeout_ms, err)) {
       return false;
     }
-    ReduceSumInto(base + seg_off[recv_seg] * esize, tmp.data(),
-                  seg_count[recv_seg], dtype);
+    ReduceInto(base + seg_off[recv_seg] * esize, tmp.data(),
+               seg_count[recv_seg], dtype, op);
   }
   return true;
 }
@@ -871,15 +917,15 @@ static void EvenSegments(int64_t count, int size,
 }
 
 static bool RingAllreduce(void* data, int64_t count, DataType dtype,
-                          int rank, int size, Socket& next, Socket& prev,
-                          int timeout_ms, std::string* err) {
+                          ReduceOp op, int rank, int size, Socket& next,
+                          Socket& prev, int timeout_ms, std::string* err) {
   const size_t esize = DataTypeSize(dtype);
   uint8_t* base = static_cast<uint8_t*>(data);
   std::vector<int64_t> seg_count, seg_off;
   EvenSegments(count, size, &seg_count, &seg_off);
 
-  if (!RingReduceScatterPhase(base, seg_count, seg_off, dtype, rank, size,
-                              next, prev, timeout_ms, err)) {
+  if (!RingReduceScatterPhase(base, seg_count, seg_off, dtype, op, rank,
+                              size, next, prev, timeout_ms, err)) {
     return false;
   }
   // Allgather: circulate the fully-reduced segments.
@@ -906,7 +952,7 @@ static bool RingAllreduce(void* data, int64_t count, DataType dtype,
 // simpler chain keeps the cross-node traffic identical (one buffer per
 // leader-ring hop) without per-local-rank cross rings.
 bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
-                                   const std::string& name,
+                                   ReduceOp op, const std::string& name,
                                    std::string* status_msg) {
   const size_t esize = DataTypeSize(dtype);
   const size_t nbytes = static_cast<size_t>(count) * esize;
@@ -942,7 +988,7 @@ bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
                                      base + lr + 1, base + lr + 1);
         return false;
       }
-      ReduceSumInto(p + eoff * esize, tmp.data(), n_elems, dtype);
+      ReduceInto(p + eoff * esize, tmp.data(), n_elems, dtype, op);
       if (lr > 0 && !local_prev_.SendAll(p + eoff * esize, n)) {
         *status_msg = TransportError("hierarchical allreduce (local reduce)",
                                      name,
@@ -955,8 +1001,9 @@ bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
 
   // 2. Leaders ring-allreduce the node sums across nodes.
   if (lr == 0 && nnodes_ > 1) {
-    if (!RingAllreduce(data, count, dtype, node_id_, nnodes_, cross_next_,
-                       cross_prev_, socket_timeout_sec_ * 1000, &err)) {
+    if (!RingAllreduce(data, count, dtype, op, node_id_, nnodes_,
+                       cross_next_, cross_prev_,
+                       socket_timeout_sec_ * 1000, &err)) {
       int next_leader = ((node_id_ + 1) % nnodes_) * L;
       int prev_leader = ((node_id_ - 1 + nnodes_) % nnodes_) * L;
       *status_msg = TransportError("hierarchical allreduce (cross ring)",
@@ -1029,12 +1076,14 @@ void Engine::ExecAllreduce(const Response& response,
     std::string msg;
     if (hierarchical_) {
       timeline_.ActivityStart(tname, "HIERARCHICAL_ALLREDUCE");
-      ok = HierarchicalAllreduce(buf, total, dtype, tname, &msg);
+      ok = HierarchicalAllreduce(buf, total, dtype, response.red_op, tname,
+                                 &msg);
     } else {
       timeline_.ActivityStart(tname, "RING_ALLREDUCE");
       std::string err;
-      ok = RingAllreduce(buf, total, dtype, rank_, size_, ring_next_,
-                         ring_prev_, socket_timeout_sec_ * 1000, &err);
+      ok = RingAllreduce(buf, total, dtype, response.red_op, rank_, size_,
+                         ring_next_, ring_prev_, socket_timeout_sec_ * 1000,
+                         &err);
       if (!ok) {
         msg = TransportError("allreduce", tname, err, (rank_ + 1) % size_,
                              (rank_ - 1 + size_) % size_);
@@ -1213,7 +1262,7 @@ void Engine::ExecReducescatter(const Response& response,
   // (see RingReduceScatterPhase).
   std::string err;
   bool ok = RingReduceScatterPhase(
-      scratch.data(), seg_count, seg_off, e.dtype,
+      scratch.data(), seg_count, seg_off, e.dtype, response.red_op,
       (rank_ - 1 + size_) % size_, size_, ring_next_, ring_prev_,
       socket_timeout_sec_ * 1000, &err);
   timeline_.ActivityEnd(e.name);
@@ -1339,7 +1388,7 @@ void Engine::CheckForStalledTensors() {
 
 int64_t Engine::Enqueue(RequestType type, const std::string& name,
                         DataType dtype, const std::vector<int64_t>& shape,
-                        void* data, int root_rank) {
+                        void* data, int root_rank, ReduceOp red_op) {
   if (!initialized_.load() || shutdown_requested_.load() ||
       shut_down_.load()) {
     return -2;
@@ -1357,6 +1406,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   for (auto d : shape) e.shape.AddDim(d);
   e.data = data;
   e.root_rank = root_rank;
+  e.red_op = red_op;
   e.handle = handle;
 
   Request q;
@@ -1365,6 +1415,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   q.dtype = dtype;
   q.tensor_name = name;
   q.root_rank = root_rank;
+  q.red_op = red_op;
   q.shape = shape;
 
   {

@@ -52,6 +52,7 @@ struct TensorTableEntry {
   TensorShape shape;
   void* data = nullptr;   // caller-owned; in/out for allreduce & broadcast
   int root_rank = -1;
+  ReduceOp red_op = ReduceOp::SUM;
   int64_t handle = -1;
 };
 
@@ -85,7 +86,7 @@ class Engine {
   // operations.cc:2058-2061) or -2 (not initialized / shut down).
   int64_t Enqueue(RequestType type, const std::string& name, DataType dtype,
                   const std::vector<int64_t>& shape, void* data,
-                  int root_rank);
+                  int root_rank, ReduceOp red_op = ReduceOp::SUM);
 
   int Poll(int64_t handle);                  // 0 pending, 1 ok, -1 error
   int Wait(int64_t handle);                  // blocks; returns Poll result
@@ -187,7 +188,7 @@ class Engine {
   Socket local_next_, local_prev_;         // intra-node ring (duplex chain)
   Socket cross_next_, cross_prev_;         // leader ring across nodes
   bool HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
-                             const std::string& name,
+                             ReduceOp op, const std::string& name,
                              std::string* status_msg);
 
   // -- fusion scratch --
@@ -197,9 +198,10 @@ class Engine {
   Timeline timeline_;
 };
 
-// Element-wise sum of src into dst (the data-plane reduction kernel).
-// f16/bf16 accumulate via float, like the reference custom MPI op
-// (horovod/common/half.cc) but TPU-era: bf16 is first-class.
-void ReduceSumInto(void* dst, const void* src, int64_t count, DataType dtype);
+// Element-wise combine of src into dst (the data-plane reduction kernel):
+// sum/min/max/prod.  f16/bf16 combine via float, like the reference custom
+// MPI op (horovod/common/half.cc) but TPU-era: bf16 is first-class.
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
+                ReduceOp op);
 
 }  // namespace hvd

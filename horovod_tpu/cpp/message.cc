@@ -8,6 +8,7 @@ static void SerializeRequest(const Request& q, Writer* w) {
   w->u8(static_cast<uint8_t>(q.dtype));
   w->str(q.tensor_name);
   w->i32(q.root_rank);
+  w->u8(static_cast<uint8_t>(q.red_op));
   w->u32(static_cast<uint32_t>(q.shape.size()));
   for (auto d : q.shape) w->i64(d);
 }
@@ -18,6 +19,7 @@ static bool ParseRequest(Reader* r, Request* q) {
   q->dtype = static_cast<DataType>(r->u8());
   q->tensor_name = r->str();
   q->root_rank = r->i32();
+  q->red_op = static_cast<ReduceOp>(r->u8());
   uint32_t nd = r->u32();
   q->shape.clear();
   for (uint32_t i = 0; i < nd && r->ok(); ++i) q->shape.push_back(r->i64());
@@ -48,6 +50,7 @@ static void SerializeResponse(const Response& s, Writer* w) {
   w->u32(static_cast<uint32_t>(s.tensor_sizes.size()));
   for (auto v : s.tensor_sizes) w->i64(v);
   w->i32(s.root_rank);
+  w->u8(static_cast<uint8_t>(s.red_op));
 }
 
 static bool ParseResponse(Reader* r, Response* s) {
@@ -60,6 +63,7 @@ static bool ParseResponse(Reader* r, Response* s) {
   s->tensor_sizes.clear();
   for (uint32_t i = 0; i < m && r->ok(); ++i) s->tensor_sizes.push_back(r->i64());
   s->root_rank = r->i32();
+  s->red_op = static_cast<ReduceOp>(r->u8());
   return r->ok();
 }
 

@@ -47,12 +47,33 @@ inline const char* RequestTypeName(RequestType t) {
   return "?";
 }
 
+// Reduction operator for allreduce/reducescatter.  The reference wire
+// protocol is SUM-only (mpi_message.h); MIN/MAX/PROD close the asymmetry
+// with the jit path's psum/pmin/pmax/product collectives.
+enum class ReduceOp : uint8_t {
+  SUM = 0,
+  MIN = 1,
+  MAX = 2,
+  PROD = 3,
+};
+
+inline const char* ReduceOpName(ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM: return "sum";
+    case ReduceOp::MIN: return "min";
+    case ReduceOp::MAX: return "max";
+    case ReduceOp::PROD: return "prod";
+  }
+  return "?";
+}
+
 struct Request {
   int32_t request_rank = 0;
   RequestType type = RequestType::ALLREDUCE;
   DataType dtype = DataType::FLOAT32;
   std::string tensor_name;
   int32_t root_rank = -1;   // broadcast only
+  ReduceOp red_op = ReduceOp::SUM;  // allreduce/reducescatter only
   std::vector<int64_t> shape;
 };
 
@@ -69,6 +90,7 @@ struct Response {
   // Allgather: per-rank dim-0 sizes (negotiated dynamic shape).
   std::vector<int64_t> tensor_sizes;
   int32_t root_rank = -1;
+  ReduceOp red_op = ReduceOp::SUM;
 };
 
 struct ResponseList {

@@ -116,7 +116,7 @@ def _maybe_report(plan: FusionPlan) -> None:
     bucket report is the observable record of what got batched into each
     ICI collective — the information the eager engine's timeline shows as
     fused response lists."""
-    if not os.environ.get("HOROVOD_FUSION_REPORT"):
+    if os.environ.get("HOROVOD_FUSION_REPORT", "0") in ("", "0"):
         return
     key = tuple((str(b.dtype), b.sizes) for b in plan.buckets)
     if key in _reported_plans:

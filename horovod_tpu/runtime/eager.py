@@ -13,10 +13,10 @@ coordinator establishes a globally agreed, identically ordered, fused batch
 of collectives per cycle — the reference's central correctness idea — and
 executes them as ring collectives between the host processes.
 
-The wire reduction is SUM only (reference wire-protocol parity,
-``horovod/common/mpi_message.h``); averaging happens here, and MIN/MAX/
-PRODUCT eager reductions are not supported cross-process (they never were
-in the reference either).
+Averaging happens here (SUM on the wire, divide on return); MIN/MAX/
+PRODUCT ride the wire natively — an extension past the reference's
+SUM-only protocol (``horovod/common/mpi_message.h``), matching the jit
+path's psum/pmin/pmax/product surface.
 """
 
 from __future__ import annotations
@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from horovod_tpu.common.basics import basics
-from horovod_tpu.ops.collective_ops import Average, ReduceOp, Sum
+from horovod_tpu.ops.collective_ops import (Average, Max, Min,
+                                             Product, ReduceOp, Sum)
 from horovod_tpu.ops.compression import Compression
 
 __all__ = ["allreduce", "grouped_allreduce", "allgather", "broadcast",
@@ -38,6 +39,11 @@ def _resolve_op(op, average):
     if average is not None:
         return Average if average else Sum
     return op
+
+
+#: collective_ops ReduceOp -> engine wire op name.
+_WIRE_OPS = {Sum: "sum", Average: "sum", Min: "min", Max: "max",
+             Product: "prod"}
 
 
 def _engine():
@@ -57,13 +63,14 @@ def allreduce(tensor, *, op=Average, average=None,
     wire, ctx = compression.compress(arr)
     if eng is None:
         return compression.decompress(wire, ctx)
-    if op not in (Average, Sum):
+    if op not in _WIRE_OPS:
         raise NotImplementedError(
-            f"eager cross-process allreduce supports SUM/AVERAGE only, "
-            f"got {op}"
+            f"eager cross-process allreduce supports "
+            f"SUM/AVERAGE/MIN/MAX/PRODUCT, got {op}"
         )
     host = np.ascontiguousarray(np.asarray(wire))
-    reduced = eng.allreduce(host, average=(op is Average), name=name)
+    reduced = eng.allreduce(host, average=(op is Average), name=name,
+                            red_op=_WIRE_OPS[op])
     return compression.decompress(jnp.asarray(reduced), ctx)
 
 
@@ -79,9 +86,10 @@ def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
         return [
             allreduce(t, op=op, compression=compression) for t in tensors
         ]
-    if op not in (Average, Sum):
+    if op not in _WIRE_OPS:
         raise NotImplementedError(
-            "eager cross-process allreduce supports SUM/AVERAGE only"
+            "eager cross-process allreduce supports "
+            f"SUM/AVERAGE/MIN/MAX/PRODUCT, got {op}"
         )
     ctxs, hosts = [], []
     for t in tensors:
@@ -90,7 +98,8 @@ def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
         hosts.append(np.ascontiguousarray(np.asarray(wire)).copy())
     handles = [
         eng.enqueue_allreduce(
-            h, None if name is None else f"{name}.{i}")
+            h, None if name is None else f"{name}.{i}",
+            red_op=_WIRE_OPS[op])
         for i, h in enumerate(hosts)
     ]
     outs = [eng.synchronize(h) for h in handles]
@@ -131,14 +140,15 @@ def reducescatter(tensor, *, op=Sum, average=None,
     if eng is None:
         # World of one: reduce is identity (any op); keep the full shard.
         return jnp.asarray(tensor)
-    if op not in (Average, Sum):
+    if op not in _WIRE_OPS:
         raise NotImplementedError(
-            f"eager cross-process reducescatter supports SUM/AVERAGE only, "
-            f"got {op}"
+            f"eager cross-process reducescatter supports "
+            f"SUM/AVERAGE/MIN/MAX/PRODUCT, got {op}"
         )
     host = np.ascontiguousarray(np.asarray(tensor))
     return jnp.asarray(
-        eng.reducescatter(host, average=(op is Average), name=name))
+        eng.reducescatter(host, average=(op is Average), name=name,
+                          red_op=_WIRE_OPS[op]))
 
 
 def alltoall(tensor, *, name: Optional[str] = None):

@@ -49,6 +49,9 @@ _DTYPE_CODES = {
 _OP_ALLREDUCE, _OP_ALLGATHER, _OP_BROADCAST = 0, 1, 2
 _OP_REDUCESCATTER, _OP_ALLTOALL = 3, 4
 
+#: ReduceOp codes, keep in sync with cpp/message.h.
+_RED_OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
+
 
 def _dtype_code(dtype) -> int:
     name = np.dtype(dtype).name if np.dtype(dtype).name in _DTYPE_CODES \
@@ -77,6 +80,7 @@ class NativeEngine:
         lib.horovod_enqueue.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int,
         ]
         lib.horovod_enqueue.restype = ctypes.c_int64
         lib.horovod_poll.argtypes = [ctypes.c_int64]
@@ -116,11 +120,12 @@ class NativeEngine:
     # -- async enqueue API --
 
     def _enqueue(self, op: int, arr: np.ndarray, name: str,
-                 root_rank: int = -1) -> int:
+                 root_rank: int = -1, red_op: str = "sum") -> int:
         shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
         handle = self._lib.horovod_enqueue(
             op, name.encode(), _dtype_code(arr.dtype), arr.ndim, shape,
             arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+            _RED_OPS[red_op],
         )
         if handle == -1:
             raise HorovodInternalError(
@@ -136,10 +141,13 @@ class NativeEngine:
         return handle
 
     def enqueue_allreduce(self, arr: np.ndarray,
-                          name: Optional[str] = None) -> int:
-        """In-place sum-allreduce of a contiguous array. Returns handle."""
+                          name: Optional[str] = None,
+                          red_op: str = "sum") -> int:
+        """In-place allreduce of a contiguous array (``red_op``:
+        sum/min/max/prod). Returns handle."""
         return self._enqueue(
-            _OP_ALLREDUCE, arr, self._auto_name("allreduce", name))
+            _OP_ALLREDUCE, arr, self._auto_name("allreduce", name),
+            red_op=red_op)
 
     def enqueue_allgather(self, arr: np.ndarray,
                           name: Optional[str] = None) -> int:
@@ -153,11 +161,14 @@ class NativeEngine:
             root_rank=root_rank)
 
     def enqueue_reducescatter(self, arr: np.ndarray,
-                              name: Optional[str] = None) -> int:
-        """Sum-reduce across ranks, keep this rank's dim-0 slice (rows split
-        as evenly as possible, earlier ranks take the remainder)."""
+                              name: Optional[str] = None,
+                              red_op: str = "sum") -> int:
+        """Reduce across ranks (``red_op``: sum/min/max/prod), keep this
+        rank's dim-0 slice (rows split as evenly as possible, earlier ranks
+        take the remainder)."""
         return self._enqueue(
-            _OP_REDUCESCATTER, arr, self._auto_name("reducescatter", name))
+            _OP_REDUCESCATTER, arr, self._auto_name("reducescatter", name),
+            red_op=red_op)
 
     def enqueue_alltoall(self, arr: np.ndarray,
                          name: Optional[str] = None) -> int:
@@ -211,9 +222,10 @@ class NativeEngine:
         return (out / np.asarray(n, dtype=out.dtype)).astype(out.dtype)
 
     def allreduce(self, tensor, *, average: bool = False,
-                  name: Optional[str] = None) -> np.ndarray:
+                  name: Optional[str] = None,
+                  red_op: str = "sum") -> np.ndarray:
         arr = np.ascontiguousarray(tensor).copy()
-        out = self.synchronize(self.enqueue_allreduce(arr, name))
+        out = self.synchronize(self.enqueue_allreduce(arr, name, red_op))
         return self._apply_average(out) if average else out
 
     def allgather(self, tensor, *, name: Optional[str] = None) -> np.ndarray:
@@ -228,9 +240,10 @@ class NativeEngine:
         return self.synchronize(self.enqueue_broadcast(arr, root_rank, name))
 
     def reducescatter(self, tensor, *, average: bool = False,
-                      name: Optional[str] = None) -> np.ndarray:
+                      name: Optional[str] = None,
+                      red_op: str = "sum") -> np.ndarray:
         arr = np.ascontiguousarray(tensor)
-        out = self.synchronize(self.enqueue_reducescatter(arr, name))
+        out = self.synchronize(self.enqueue_reducescatter(arr, name, red_op))
         return self._apply_average(out) if average else out
 
     def alltoall(self, tensor, *, name: Optional[str] = None) -> np.ndarray:

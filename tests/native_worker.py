@@ -80,6 +80,42 @@ def scenario_allgather(rank, size, eng):
         off += r + 1
 
 
+def scenario_reduce_ops(rank, size, eng):
+    # MIN/MAX/PROD on the wire — an extension past the reference's SUM-only
+    # protocol, matching the jit path's pmin/pmax/product surface.
+    x = np.arange(6, dtype=np.float32) + 10 * rank
+    assert np.allclose(eng.allreduce(x.copy(), red_op="min"),
+                       np.arange(6, dtype=np.float32))
+    assert np.allclose(eng.allreduce(x.copy(), red_op="max"),
+                       np.arange(6) + 10.0 * (size - 1))
+    y = np.full((4,), float(rank + 1), dtype=np.float32)
+    import math
+    assert np.allclose(eng.allreduce(y.copy(), red_op="prod"),
+                       float(math.factorial(size)))
+    # int64 min and bf16 max
+    z = (np.arange(5) + rank).astype(np.int64)
+    assert np.array_equal(eng.allreduce(z.copy(), red_op="min"),
+                          np.arange(5, dtype=np.int64))
+    # reducescatter with max
+    rows = size * 2
+    base = np.arange(rows * 2, dtype=np.float32).reshape(rows, 2)
+    out = eng.reducescatter(base + rank, red_op="max")
+    assert np.allclose(out, base[rank * 2:(rank + 1) * 2] + (size - 1)), out
+
+
+def scenario_red_op_mismatch(rank, size, eng):
+    # Ranks disagreeing on the reduction operator must get a typed error.
+    try:
+        eng.allreduce(np.zeros(4, np.float32), name="bad_op",
+                      red_op="min" if rank == 0 else "max")
+        if size == 1:
+            return
+    except HorovodInternalError as e:
+        assert "Mismatched reduction operators" in str(e), str(e)
+        return
+    raise AssertionError("expected HorovodInternalError")
+
+
 def scenario_reducescatter(rank, size, eng):
     # dim0 = size + 1 exercises the uneven split (rank 0 gets 2 rows).
     rows = size + 1
@@ -196,6 +232,8 @@ SCENARIOS = {
     "fused": scenario_fused,
     "allgather": scenario_allgather,
     "broadcast": scenario_broadcast,
+    "reduce_ops": scenario_reduce_ops,
+    "red_op_mismatch": scenario_red_op_mismatch,
     "reducescatter": scenario_reducescatter,
     "alltoall": scenario_alltoall,
     "alltoall_indivisible": scenario_alltoall_indivisible,

@@ -76,6 +76,17 @@ def test_broadcast_all_roots():
     run_workers(3, "broadcast")
 
 
+@pytest.mark.parametrize("n", [2, 3])
+def test_min_max_prod_reductions(n):
+    """MIN/MAX/PROD ride the wire natively (extension past the reference's
+    SUM-only protocol, matching the jit path's pmin/pmax/product)."""
+    run_workers(n, "reduce_ops")
+
+
+def test_reduce_op_mismatch_raises():
+    run_workers(2, "red_op_mismatch")
+
+
 @pytest.mark.parametrize("n", [2, 4])
 def test_reducescatter_uneven_rows(n):
     run_workers(n, "reducescatter")
