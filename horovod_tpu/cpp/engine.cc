@@ -421,7 +421,11 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
 }
 
 void Engine::Shutdown() {
-  if (!initialized_.load() || shut_down_.load()) return;
+  if (!initialized_.load()) return;
+  // The background loop may have ALREADY exited (a peer's shutdown
+  // broadcast, or a transport abort) with shut_down_ set while
+  // initialized_ is still true — join and clear state regardless, or a
+  // subsequent Init() would see initialized_ and no-op on a dead engine.
   shutdown_requested_.store(true);
   if (background_.joinable()) background_.join();
   initialized_.store(false);

@@ -17,6 +17,12 @@ from horovod_tpu.ops.collective_ops import (
     reducescatter,
 )
 from horovod_tpu.ops.compression import Compression, Compressor
+from horovod_tpu.ops.ragged import (
+    bucket_rows,
+    compact,
+    pad_rows,
+    ragged_allgather,
+)
 from horovod_tpu.ops.fusion import (
     DEFAULT_FUSION_THRESHOLD,
     FusionPlan,
@@ -47,4 +53,8 @@ __all__ = [
     "fuse_apply",
     "fusion_threshold_bytes",
     "plan_fusion",
+    "bucket_rows",
+    "compact",
+    "pad_rows",
+    "ragged_allgather",
 ]

@@ -1,0 +1,78 @@
+"""Ragged (per-device variable dim-0) allgather for the jit path.
+
+SURVEY.md §3.5 names the design constraint: the reference's allgather
+negotiates per-rank dim-0 sizes at runtime (reference
+``operations.cc:796-856``), but XLA programs are compiled with static
+shapes — under SPMD every device runs the SAME program, so a traced
+collective cannot have per-device shapes at all.
+
+The TPU-native answer (the "pad-to-max + size sideband, with
+recompilation bucketing" recipe):
+
+* every device carries a buffer padded to a STATIC row capacity plus a
+  scalar count of valid rows;
+* :func:`ragged_allgather` gathers both (one ``all_gather`` each) and
+  masks invalid rows, returning ``(gathered [N, cap, ...], sizes [N])``;
+* :func:`bucket_rows` rounds a row count up to a power-of-two bucket so
+  varying raggedness hits a handful of compiled programs instead of one
+  per distinct size;
+* :func:`compact` (host-side) drops the padding using the gathered sizes.
+
+The eager path needs none of this — the engine negotiates true sizes at
+runtime (``horovod_tpu/cpp/engine.cc`` ExecAllgather).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["bucket_rows", "pad_rows", "ragged_allgather", "compact"]
+
+
+def bucket_rows(n: int, *, min_bucket: int = 8) -> int:
+    """Smallest power-of-two >= n (and >= min_bucket): the static row
+    capacity to pad to.  Bounded recompilation: k distinct bucket sizes
+    cover any raggedness with at most k compiled programs."""
+    if n <= min_bucket:
+        return min_bucket
+    return 1 << (int(n) - 1).bit_length()
+
+
+def pad_rows(x, capacity: int):
+    """Zero-pad dim 0 of host array ``x`` to ``capacity`` rows; returns
+    ``(padded, n_valid)``.  Call before device_put / shard_map."""
+    x = np.asarray(x)
+    n = x.shape[0]
+    if n > capacity:
+        raise ValueError(f"{n} rows exceed the bucket capacity {capacity}")
+    pad = np.zeros((capacity - n,) + x.shape[1:], dtype=x.dtype)
+    return np.concatenate([x, pad], axis=0), n
+
+
+def ragged_allgather(x_padded, n_valid, *, axis_name="data"):
+    """Inside shard_map: gather per-device padded buffers AND their valid
+    row counts.
+
+    ``x_padded``: [cap, ...] — this device's rows, zero-padded to the
+    static capacity.  ``n_valid``: scalar int32 of real rows.  Returns
+    ``(gathered [N, cap, ...], sizes [N])`` with invalid rows zeroed, so
+    sums/means over the gathered buffer are already correct and
+    :func:`compact` can drop padding on the host.
+    """
+    cap = x_padded.shape[0]
+    mask = (jnp.arange(cap) < n_valid).astype(x_padded.dtype)
+    mask = mask.reshape((cap,) + (1,) * (x_padded.ndim - 1))
+    gathered = jax.lax.all_gather(x_padded * mask, axis_name)
+    sizes = jax.lax.all_gather(jnp.asarray(n_valid, jnp.int32), axis_name)
+    return gathered, sizes
+
+
+def compact(gathered, sizes):
+    """Host-side: concatenate only the valid rows of each device's block
+    (the shape-dynamic step XLA cannot express)."""
+    gathered = np.asarray(gathered)
+    sizes = np.asarray(sizes)
+    return np.concatenate(
+        [gathered[i, : sizes[i]] for i in range(gathered.shape[0])], axis=0)

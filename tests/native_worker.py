@@ -206,6 +206,23 @@ def scenario_timeline(rank, size, eng):
     scenario_broadcast(rank, size, eng)
 
 
+def scenario_restart(rank, size, eng):
+    # Full lifecycle twice: shutdown tears down the coordinator, rings, and
+    # background thread; a second init() must rebuild them on the same
+    # coordinator address and produce correct collectives again (the
+    # checkpoint-restart pattern without exec-ing a new process).
+    x = np.full((8,), float(rank + 1), dtype=np.float32)
+    assert np.allclose(eng.allreduce(x), size * (size + 1) / 2.0)
+    basics.shutdown()
+    basics.init()
+    # Same cached ctypes wrapper; what restarts is the NATIVE core behind
+    # it (coordinator, rings, background thread).
+    y = np.full((8,), float(rank + 2), dtype=np.float32)
+    out = eng.allreduce(y)
+    expected = sum(r + 2 for r in range(size))
+    assert np.allclose(out, expected), (out[0], expected)
+
+
 def scenario_worker_death(rank, size, eng):
     # Fault containment: the highest rank dies abruptly mid-run; every
     # surviving rank must get a DESCRIPTIVE HorovodInternalError (naming a
@@ -241,6 +258,7 @@ SCENARIOS = {
     "dtype_mismatch": scenario_dtype_mismatch,
     "root_mismatch": scenario_root_mismatch,
     "timeline": scenario_timeline,
+    "restart": scenario_restart,
     "worker_death": scenario_worker_death,
     "all": None,
 }

@@ -237,3 +237,35 @@ def test_eager_reducescatter_alltoall_single_process():
                                   np.asarray(x))
     np.testing.assert_array_equal(np.asarray(hvd.alltoall(x)),
                                   np.asarray(x))
+
+
+def test_ragged_allgather_pad_bucket_compact(n_devices):
+    """Ragged allgather on the jit path: pad to a static bucket, gather
+    data + size sideband in-jit, compact on host (SURVEY.md §3.5's
+    static-shape answer to the reference's negotiated allgather)."""
+    from horovod_tpu.ops import ragged
+
+    assert ragged.bucket_rows(3) == 8
+    assert ragged.bucket_rows(9) == 16
+    assert ragged.bucket_rows(16) == 16
+
+    cap = 8
+    # Device d holds d+1 rows of value d.
+    per_dev = [np.full((d + 1, 2), float(d), np.float32)
+               for d in range(n_devices)]
+    padded = np.stack([ragged.pad_rows(x, cap)[0] for x in per_dev])
+    sizes = np.asarray([x.shape[0] for x in per_dev], np.int32)
+
+    def fn(x, n):
+        g, s = ragged.ragged_allgather(x[0], n[0], axis_name="data")
+        return g[None], s[None]
+
+    mesh = _mesh()
+    gathered, got_sizes = jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=(P("data"), P("data")), check_vma=False,
+    ))(jnp.asarray(padded), jnp.asarray(sizes))
+    # Every device sees the same full (N, cap, 2) buffer + size vector.
+    out = ragged.compact(np.asarray(gathered)[0], np.asarray(got_sizes)[0])
+    expected = np.concatenate(per_dev, axis=0)
+    np.testing.assert_array_equal(out, expected)

@@ -150,6 +150,13 @@ def test_hierarchical_falls_back_on_bad_topology():
     run_workers(3, "allreduce", extra_env=HIER_ENV)
 
 
+def test_engine_restart_same_process():
+    """shutdown() then init() in the same processes rebuilds the
+    coordinator/rings and collectives work again (checkpoint-restart
+    without process replacement)."""
+    run_workers(3, "restart")
+
+
 def test_worker_death_surfaces_descriptive_error():
     """Killing one worker mid-run must fail the survivors' collectives with
     an error naming the disconnect — not hang (round-1 VERDICT: transport
