@@ -61,20 +61,37 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _seg_mask(scores, seg_start, ki, block_k):
+    """Mask keys below each query's segment start (packed causal
+    attention); shared by the forward and both backward kernels."""
+    block_q = scores.shape[0]
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(k_pos >= seg_start[:, None], scores, -1e30)
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
-                block_k, bias_ref=None):
+                block_k, bias_ref=None, seg_ref=None):
     # q_ref: [block_q, D]; k_ref/v_ref: [S, D]; o_ref: [block_q, D];
     # bias_ref (optional): [8, S] additive key bias (0 valid / -1e30
     # masked), sublane-replicated like lse — key-padding masks for
     # bidirectional (BERT-style) attention.
+    # seg_ref (optional, causal only): [8, S] int32 — per-position START of
+    # the position's segment; queries only attend keys at positions
+    # >= their segment start.  With the causal upper bound this yields
+    # block-diagonal attention for PACKED sequences (row i attends
+    # [seg_start[i], i]) without a [S, S] mask.
     qi = pl.program_id(1)
     block_q, d = q_ref.shape
     s = k_ref.shape[0]
     q = q_ref[:]
+    seg_start = None
+    if seg_ref is not None:
+        seg_start = seg_ref[0, pl.dslice(qi * block_q, block_q)]
 
     m = jnp.full((block_q,), -1e30, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
@@ -110,6 +127,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
         if bias_ref is not None:
             scores = scores + bias_ref[0, pl.dslice(ki * block_k,
                                                     block_k)][None, :]
+        if seg_start is not None:
+            scores = _seg_mask(scores, seg_start, ki, block_k)
         new_m = jnp.maximum(m, jnp.max(scores, axis=1))
         alpha = jnp.exp(m - new_m)
         p = jnp.exp(scores - new_m[:, None])
@@ -139,22 +158,48 @@ def _bias_spec(bias, bh, s):
     return pl.BlockSpec((None, 8, s), lambda b, i: (b // heads, 0, 0))
 
 
-def _fwd(q, k, v, causal, sm_scale, bias=None):
-    # q, k, v: [BH, S, D]; bias (optional): [B, 8, S] additive key bias.
+def _extras(bh, s, bias, seg):
+    """(kwarg names, arrays, BlockSpecs) for the optional per-batch [B,8,S]
+    sidebands — additive key bias and/or per-query segment starts."""
+    names, arrays, specs = [], [], []
+    if bias is not None:
+        names.append("bias_ref")
+        arrays.append(bias)
+        specs.append(_bias_spec(bias, bh, s))
+    if seg is not None:
+        names.append("seg_ref")
+        arrays.append(seg)
+        specs.append(_bias_spec(seg, bh, s))
+    return names, arrays, specs
+
+
+def _with_extras(base_kernel, n_outs, names, **fixed):
+    """Wrap a kernel so trailing sideband inputs arrive as keyword refs."""
+    if not names:
+        return functools.partial(base_kernel, **fixed)
+
+    def kernel(*refs):
+        # ref layout: positional inputs, sideband inputs, then outputs.
+        n_extra = len(names)
+        n_main = len(refs) - n_outs - n_extra
+        main_in = refs[:n_main]
+        extra = dict(zip(names, refs[n_main:n_main + n_extra]))
+        outs = refs[n_main + n_extra:]
+        base_kernel(*main_in, *outs, **fixed, **extra)
+
+    return kernel
+
+
+def _fwd(q, k, v, causal, sm_scale, bias=None, seg=None):
+    # q, k, v: [BH, S, D]; bias/seg (optional): [B, 8, S] sidebands.
     bh, s, d = q.shape
     bq = _pick_block(s, BLOCK_Q)
     bk = _pick_block(s, BLOCK_K)
     grid = (bh, s // bq)
-    if bias is None:
-        kernel = functools.partial(_fwd_kernel, causal=causal,
-                                   sm_scale=sm_scale, block_k=bk)
-        inputs, bias_specs = (q, k, v), []
-    else:
-        def kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref):
-            _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, causal=causal,
-                        sm_scale=sm_scale, block_k=bk, bias_ref=bias_ref)
-        inputs = (q, k, v, bias)
-        bias_specs = [_bias_spec(bias, bh, s)]
+    names, arrays, bias_specs = _extras(bh, s, bias, seg)
+    kernel = _with_extras(_fwd_kernel, 2, names, causal=causal,
+                          sm_scale=sm_scale, block_k=bk)
+    inputs = (q, k, v, *arrays)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -181,7 +226,8 @@ def _fwd(q, k, v, causal, sm_scale, bias=None):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, causal, sm_scale, block_k, bias_ref=None):
+                   *, causal, sm_scale, block_k, bias_ref=None,
+                   seg_ref=None):
     qi = pl.program_id(1)
     block_q, d = q_ref.shape
     s = k_ref.shape[0]
@@ -189,6 +235,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     do = do_ref[:]
     lse = lse_ref[0, pl.dslice(qi * block_q, block_q)]
     delta = delta_ref[0, pl.dslice(qi * block_q, block_q)]
+    seg_start = None
+    if seg_ref is not None:
+        seg_start = seg_ref[0, pl.dslice(qi * block_q, block_q)]
 
     n_kv = s // block_k
     if causal:
@@ -212,6 +261,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if bias_ref is not None:
             scores = scores + bias_ref[0, pl.dslice(ki * block_k,
                                                     block_k)][None, :]
+        if seg_start is not None:
+            scores = _seg_mask(scores, seg_start, ki, block_k)
         p = jnp.exp(scores - lse[:, None])
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
@@ -228,7 +279,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, causal, sm_scale, block_q,
-                    bias_ref=None):
+                    bias_ref=None, seg_ref=None):
     ki = pl.program_id(1)
     block_k, d = k_ref.shape
     s = q_ref.shape[0]
@@ -248,6 +299,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do_blk = do_ref[pl.dslice(qi * block_q, block_q), :]
         lse_blk = lse_ref[0, pl.dslice(qi * block_q, block_q)]
         delta_blk = delta_ref[0, pl.dslice(qi * block_q, block_q)]
+        seg_blk = None
+        if seg_ref is not None:
+            seg_blk = seg_ref[0, pl.dslice(qi * block_q, block_q)]
         scores = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
@@ -262,6 +316,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # kernel's own block index.
             scores = scores + bias_ref[0, pl.dslice(ki * block_k,
                                                     block_k)][None, :]
+        if seg_blk is not None:
+            scores = _seg_mask(scores, seg_blk, ki, block_k)
         p = jnp.exp(scores - lse_blk[:, None])
         pc = p.astype(do_blk.dtype)
         dv = dv + jax.lax.dot_general(
@@ -284,7 +340,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_impl(causal, sm_scale, res, do, bias=None):
+def _bwd_impl(causal, sm_scale, res, do, bias=None, seg=None):
     q, k, v, out, lse = res
     bh, s, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -294,18 +350,10 @@ def _bwd_impl(causal, sm_scale, res, do, bias=None):
                              + delta.shape[1:])
     bq = _pick_block(s, BLOCK_Q)
     bk = _pick_block(s, BLOCK_K)
-    bias_specs = [] if bias is None else [_bias_spec(bias, bh, s)]
-    bias_inputs = () if bias is None else (bias,)
+    names, bias_inputs, bias_specs = _extras(bh, s, bias, seg)
 
-    if bias is None:
-        dq_kernel = functools.partial(_bwd_dq_kernel, causal=causal,
-                                      sm_scale=sm_scale, block_k=bk)
-    else:
-        def dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      bias_ref, dq_ref):
-            _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dq_ref, causal=causal, sm_scale=sm_scale,
-                           block_k=bk, bias_ref=bias_ref)
+    dq_kernel = _with_extras(_bwd_dq_kernel, 1, names, causal=causal,
+                             sm_scale=sm_scale, block_k=bk)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, s // bq),
@@ -322,16 +370,8 @@ def _bwd_impl(causal, sm_scale, res, do, bias=None):
         interpret=_interpret(),
     )(q, k, v, do, lse, delta, *bias_inputs)
 
-    if bias is None:
-        dkv_kernel = functools.partial(_bwd_dkv_kernel, causal=causal,
-                                       sm_scale=sm_scale, block_q=bq)
-    else:
-        def dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       bias_ref, dk_ref, dv_ref):
-            _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                            dk_ref, dv_ref, causal=causal,
-                            sm_scale=sm_scale, block_q=bq,
-                            bias_ref=bias_ref)
+    dkv_kernel = _with_extras(_bwd_dkv_kernel, 2, names, causal=causal,
+                              sm_scale=sm_scale, block_q=bq)
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh, s // bk),
@@ -400,28 +440,82 @@ def _flash_biased_bwd(causal, sm_scale, res, do):
 _flash_biased.defvjp(_flash_biased_fwd, _flash_biased_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_seg(q, k, v, seg, causal, sm_scale):
+    out, _ = _fwd(q, k, v, causal, sm_scale, seg=seg)
+    return out
+
+
+def _flash_seg_fwd(q, k, v, seg, causal, sm_scale):
+    out, lse = _fwd(q, k, v, causal, sm_scale, seg=seg)
+    return out, (q, k, v, seg, out, lse)
+
+
+def _flash_seg_bwd(causal, sm_scale, res, do):
+    import numpy as np
+
+    q, k, v, seg, out, lse = res
+    dq, dk, dv = _bwd_impl(causal, sm_scale, (q, k, v, out, lse), do,
+                           seg=seg)
+    # Integer input: JAX requires a float0 cotangent.
+    return dq, dk, dv, np.zeros(seg.shape, dtype=jax.dtypes.float0)
+
+
+_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
+
+
+def _segment_starts(segment_ids):
+    """[B, S] segment ids (contiguous runs) -> [B, S] int32 index of each
+    position's segment start, via a cummax over run boundaries."""
+    B, S = segment_ids.shape
+    pos = jnp.arange(S, dtype=jnp.int32)
+    change = jnp.concatenate(
+        [jnp.ones((B, 1), bool),
+         segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1)
+    return jax.lax.cummax(
+        jnp.where(change, pos[None, :], 0).astype(jnp.int32), axis=1)
+
+
 def _supported(S: int, D: int) -> bool:
     return S % 128 == 0 and D % 128 == 0
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
-                    key_padding_mask=None):
+                    key_padding_mask=None, segment_ids=None):
     """Flash attention on [B, S, H, D] tensors (the model zoo seam).
 
     ``key_padding_mask``: optional [B, S] boolean (True = attend to that
     key) — BERT-style padding masks; carried through the kernel as an
     additive key bias in the same sublane-replicated layout as the LSE.
-    GQA (fewer KV heads) is handled by repeating KV heads; falls back to
-    the XLA dense path when S or D don't fit the kernel tiling.
+    ``segment_ids``: optional [B, S] integer ids of contiguous packed
+    sequences (causal only, exclusive with the padding mask): each query
+    attends only within its own segment — block-diagonal causal attention
+    for packed pretraining, at O(S) sideband cost instead of an [S, S]
+    mask.  GQA (fewer KV heads) is handled by repeating KV heads; falls
+    back to the XLA dense path when S or D don't fit the kernel tiling.
     """
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
+    if segment_ids is not None:
+        if not causal:
+            raise NotImplementedError(
+                "segment_ids implies packed causal attention; bidirectional"
+                " segment masking is not supported")
+        if key_padding_mask is not None:
+            raise NotImplementedError(
+                "segment_ids and key_padding_mask are mutually exclusive "
+                "(mark padding as its own trailing segment instead)")
     if not _supported(S, D):
         from horovod_tpu.models.llama import causal_attention
         from horovod_tpu.models.bert import dot_product_attention
 
         kr = k.repeat(Hq // Hkv, axis=2) if Hkv != Hq else k
         vr = v.repeat(Hq // Hkv, axis=2) if Hkv != Hq else v
+        if segment_ids is not None:
+            tri = jnp.tril(jnp.ones((S, S), bool))
+            same = segment_ids[:, :, None] == segment_ids[:, None, :]
+            mask = same[:, None, :, :] & tri[None, None, :, :]
+            return dot_product_attention(q, kr, vr, mask=mask)
         if key_padding_mask is not None:
             mask = key_padding_mask[:, None, None, :]
             if causal:
@@ -441,12 +535,16 @@ def flash_attention(q, k, v, *, causal: bool = True,
     qt = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
     kt = k.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
     vt = v.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
-    if key_padding_mask is None:
+    if segment_ids is not None:
+        starts = _segment_starts(jnp.asarray(segment_ids))
+        # [B, S] -> [B, 8, S]: sublane-replicated (TPU tiling); heads are
+        # folded away in the kernels' sideband BlockSpec.
+        seg = jnp.broadcast_to(starts[:, None, :], (B, 8, S))
+        out = _flash_seg(qt, kt, vt, seg, causal, sm_scale)
+    elif key_padding_mask is None:
         out = _flash(qt, kt, vt, causal, sm_scale)
     else:
         bias = jnp.where(key_padding_mask, 0.0, -1e30).astype(jnp.float32)
-        # [B, S] -> [B, 8, S]: sublane-replicated (TPU tiling); heads are
-        # folded away in the kernels' bias BlockSpec, not materialized.
         bias = jnp.broadcast_to(bias[:, None, :], (B, 8, S))
         out = _flash_biased(qt, kt, vt, bias, causal, sm_scale)
     return out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3)

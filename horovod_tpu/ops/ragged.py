@@ -58,8 +58,9 @@ def ragged_allgather(x_padded, n_valid, *, axis_name="data"):
     ``x_padded``: [cap, ...] — this device's rows, zero-padded to the
     static capacity.  ``n_valid``: scalar int32 of real rows.  Returns
     ``(gathered [N, cap, ...], sizes [N])`` with invalid rows zeroed, so
-    sums/means over the gathered buffer are already correct and
-    :func:`compact` can drop padding on the host.
+    SUMS over the gathered buffer are already correct (for means divide by
+    ``sizes.sum()``, not the padded element count) and :func:`compact` can
+    drop padding on the host.
     """
     cap = x_padded.shape[0]
     mask = (jnp.arange(cap) < n_valid).astype(x_padded.dtype)
