@@ -106,6 +106,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
             (qi * block_q) // block_k + block_q // block_k, n_kv)
     else:
         n_kv_live = n_kv
+    kv_first = 0
+    if seg_start is not None:
+        # Packed rows: KV blocks wholly before this query block's earliest
+        # segment start are 100% masked — skip them (the lower-bound twin
+        # of the causal upper bound), preserving packing's FLOP savings.
+        kv_first = jnp.min(seg_start) // block_k
 
     def body(ki, carry):
         m, l, acc = carry
@@ -138,7 +144,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
             preferred_element_type=jnp.float32)
         return new_m, new_l, new_acc
 
-    m, l, acc = jax.lax.fori_loop(0, n_kv_live, body, (m, l, acc))
+    m, l, acc = jax.lax.fori_loop(kv_first, n_kv_live, body, (m, l, acc))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
     # log-sum-exp per row, consumed by the backward kernels.  lse_ref holds
     # the full row (TPU blocks must tile (8, 128)); write this q-block's
@@ -245,6 +251,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             (qi * block_q) // block_k + block_q // block_k, n_kv)
     else:
         n_kv_live = n_kv
+    kv_first = 0
+    if seg_start is not None:
+        kv_first = jnp.min(seg_start) // block_k
 
     def body(ki, dq):
         k_blk = k_ref[pl.dslice(ki * block_k, block_k), :]
@@ -272,8 +281,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, n_kv_live, body,
+    dq = jax.lax.fori_loop(kv_first, n_kv_live, body,
                            jnp.zeros((block_q, d), jnp.float32))
+    # (_bwd_dkv_kernel keeps the causal-only bounds: its per-KV-block skip
+    # would need each q block's seg minimum before loading it; the masked
+    # blocks there are correct, just not skipped.)
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 

@@ -19,6 +19,7 @@ EXAMPLES = [
     ("torch_mnist.py", []),
     ("torch_synthetic_benchmark.py", []),
     ("bert_pretraining_fsdp.py", []),
+    ("llama_packed_pretraining.py", []),
     ("llama_training_5d.py", ["--strategy", "gspmd"]),
     ("llama_training_5d.py", ["--strategy", "seq"]),
     ("llama_training_5d.py", ["--strategy", "pipeline"]),
