@@ -235,16 +235,36 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       peer_ls[0] = local_size_;
       worker_conns_.clear();
       worker_conns_.resize(size_);
-      for (int i = 1; i < size_; ++i) {
-        Socket conn = Accept(control_listener_, &err);
-        if (!conn.valid()) {
-          last_error_ = "accept: " + err;
+      // Tolerant accept loop: a restart can race a dying previous
+      // engine's listener — workers whose connect landed there retry
+      // against this one, so dead/garbled/duplicate connections are
+      // dropped (latest per rank wins — safe because a rank's old-world
+      // and new-world workers are the SAME process acting sequentially,
+      // so a stale registrant cannot follow a live one) rather than
+      // failing the init.  Both the accept and each frame read are
+      // bounded so a silent remnant cannot park the loop, and the whole
+      // wait has a deadline so a crashed worker yields a diagnosable
+      // error instead of a hang.
+      control_listener_.SetTimeouts(5);  // accept honors SO_RCVTIMEO
+      auto rdv_deadline = std::chrono::steady_clock::now() +
+                          std::chrono::milliseconds(120000);
+      int got = 0;
+      while (got < size_ - 1) {
+        if (std::chrono::steady_clock::now() > rdv_deadline) {
+          last_error_ = "rendezvous timed out: heard from " +
+                        std::to_string(got) + " of " +
+                        std::to_string(size_ - 1) +
+                        " workers — check the other ranks' logs";
           return 1;
         }
+        Socket conn = Accept(control_listener_, &err);
+        if (!conn.valid()) {
+          continue;  // accept timeout tick; re-check the deadline
+        }
+        conn.SetTimeouts(10);
         std::vector<uint8_t> frame;
         if (!conn.RecvFrame(&frame)) {
-          last_error_ = "rendezvous recv failed";
-          return 1;
+          continue;  // peer gave up (retrying) or stale/silent remnant
         }
         Reader r(frame.data(), frame.size());
         int32_t peer_rank = r.i32();
@@ -252,9 +272,9 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         int32_t peer_port = r.i32();
         int32_t lr = r.i32(), ls = r.i32();
         if (!r.ok() || peer_rank < 1 || peer_rank >= size_) {
-          last_error_ = "bad rendezvous frame";
-          return 1;
+          continue;  // not a rendezvous frame from this world
         }
+        if (!worker_conns_[peer_rank].valid()) got++;
         peer_hosts[peer_rank] = peer_host;
         peer_ports[peer_rank] = peer_port;
         peer_lr[peer_rank] = lr;
@@ -294,34 +314,58 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         }
       }
     } else {
-      coordinator_conn_ = ConnectRetry(host, port, 60000, &err);
-      if (!coordinator_conn_.valid()) {
-        last_error_ = err;
-        return 1;
+      // Retry the whole connect+exchange: after a restart, the first
+      // connect can land on the PREVIOUS engine's closing listener and
+      // die with EOF before the table arrives — the new listener is up
+      // moments later.
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(60000);
+      bool joined = false;
+      std::string lasterr = "rendezvous timed out";
+      while (!joined && std::chrono::steady_clock::now() < deadline) {
+        auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                        deadline - std::chrono::steady_clock::now())
+                        .count();
+        coordinator_conn_ = ConnectRetry(host, port,
+                                         static_cast<int>(left), &err);
+        if (!coordinator_conn_.valid()) {
+          lasterr = err;
+          break;
+        }
+        // Bound the exchange: a connect that landed on a wedged previous
+        // listener must time out and retry, not block forever.
+        coordinator_conn_.SetTimeouts(10);
+        Writer w;
+        w.i32(rank_);
+        w.str(my_host);
+        w.i32(data_port);
+        w.i32(local_rank_);
+        w.i32(local_size_);
+        std::vector<uint8_t> frame;
+        // The table legitimately takes as long as the slowest worker's
+        // arrival: tolerate idle 10s rounds up to ~2 min (a dying
+        // previous listener still fails fast via EOF and retries).
+        if (!coordinator_conn_.SendFrame(w.bytes()) ||
+            !coordinator_conn_.RecvFrame(&frame, 11)) {
+          lasterr = "rendezvous exchange failed";
+          coordinator_conn_.Close();
+          std::this_thread::sleep_for(std::chrono::milliseconds(50));
+          continue;
+        }
+        Reader r(frame.data(), frame.size());
+        hierarchical_ = r.u8() != 0;
+        for (int i = 0; i < size_; ++i) {
+          peer_hosts[i] = r.str();
+          peer_ports[i] = r.i32();
+        }
+        if (!r.ok()) {
+          lasterr = "bad rendezvous table";
+          break;
+        }
+        joined = true;
       }
-      Writer w;
-      w.i32(rank_);
-      w.str(my_host);
-      w.i32(data_port);
-      w.i32(local_rank_);
-      w.i32(local_size_);
-      if (!coordinator_conn_.SendFrame(w.bytes())) {
-        last_error_ = "rendezvous send failed";
-        return 1;
-      }
-      std::vector<uint8_t> frame;
-      if (!coordinator_conn_.RecvFrame(&frame)) {
-        last_error_ = "rendezvous table recv failed";
-        return 1;
-      }
-      Reader r(frame.data(), frame.size());
-      hierarchical_ = r.u8() != 0;
-      for (int i = 0; i < size_; ++i) {
-        peer_hosts[i] = r.str();
-        peer_ports[i] = r.i32();
-      }
-      if (!r.ok()) {
-        last_error_ = "bad rendezvous table";
+      if (!joined) {
+        last_error_ = lasterr;
         return 1;
       }
     }

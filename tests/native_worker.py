@@ -206,15 +206,57 @@ def scenario_timeline(rank, size, eng):
     scenario_broadcast(rank, size, eng)
 
 
+def scenario_mixed_stress(rank, size, eng):
+    # Randomized burst of MIXED collective types enqueued in one go —
+    # identical order on every rank (same seed), so the coordinator must
+    # interleave fusion-eligible allreduces with gathers/broadcasts and
+    # deliver every result correctly.  Exercises the negotiation pipeline
+    # the way a real framework does: many ops of different kinds in
+    # flight at once.
+    rng = np.random.default_rng(1234)  # SAME on all ranks
+    ops = rng.choice(["allreduce", "broadcast", "allgather"], size=40)
+    handles, checks = [], []
+    for i, kind in enumerate(ops):
+        n = int(rng.integers(1, 600))
+        if kind == "allreduce":
+            arr = np.full((n,), float(rank + i), np.float32)
+            handles.append(eng.enqueue_allreduce(arr, name=f"mix.{i}"))
+            checks.append(("ar", float(sum(r + i for r in range(size)))))
+        elif kind == "broadcast":
+            root = int(rng.integers(0, size))
+            arr = np.full((n,), float(rank * 100 + i), np.float32)
+            handles.append(eng.enqueue_broadcast(arr, root, name=f"mix.{i}"))
+            checks.append(("bc", float(root * 100 + i)))
+        else:
+            arr = np.full((2, 3), float(rank + i), np.float32)
+            handles.append(eng.enqueue_allgather(arr, name=f"mix.{i}"))
+            checks.append(("ag", i))
+    for h, (kind, expect) in zip(handles, checks):
+        out = eng.synchronize(h)
+        if kind == "ag":
+            assert out.shape == (2 * size, 3)
+            for r in range(size):
+                assert np.all(out[2 * r:2 * r + 2] == r + expect), (r, out)
+        else:
+            assert np.allclose(out, expect), (kind, out.ravel()[0], expect)
+
+
 def scenario_restart(rank, size, eng):
     # Full lifecycle twice: shutdown tears down the coordinator, rings, and
     # background thread; a second init() must rebuild them on the same
     # coordinator address and produce correct collectives again (the
     # checkpoint-restart pattern without exec-ing a new process).
+    def dbg(msg):
+        if os.environ.get("HOROVOD_TEST_DEBUG"):
+            print(f"[r{rank}] {msg}", file=sys.stderr, flush=True)
+
     x = np.full((8,), float(rank + 1), dtype=np.float32)
     assert np.allclose(eng.allreduce(x), size * (size + 1) / 2.0)
+    dbg("allreduce1 done")
     basics.shutdown()
+    dbg("shutdown done")
     basics.init()
+    dbg("reinit done")
     # Same cached ctypes wrapper; what restarts is the NATIVE core behind
     # it (coordinator, rings, background thread).
     y = np.full((8,), float(rank + 2), dtype=np.float32)
@@ -258,6 +300,7 @@ SCENARIOS = {
     "dtype_mismatch": scenario_dtype_mismatch,
     "root_mismatch": scenario_root_mismatch,
     "timeline": scenario_timeline,
+    "mixed_stress": scenario_mixed_stress,
     "restart": scenario_restart,
     "worker_death": scenario_worker_death,
     "all": None,

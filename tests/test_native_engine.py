@@ -143,11 +143,25 @@ def test_hierarchical_timeline_records_two_level_path(tmp_path):
     assert "RING_ALLREDUCE" not in text
 
 
+def test_hierarchical_mixed_stress():
+    """The mixed burst under the two-level topology: hierarchical
+    allreduces interleaved with ring gathers/broadcasts."""
+    run_workers(4, "mixed_stress", extra_env=HIER_ENV)
+
+
 def test_hierarchical_falls_back_on_bad_topology():
     """size=3 with local_size=2 cannot split into equal nodes: the
     coordinator must agree a GLOBAL fallback to the flat ring (never a mix
     of hierarchical and flat wiring) and results stay correct."""
     run_workers(3, "allreduce", extra_env=HIER_ENV)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_mixed_collective_stress(n):
+    """40 mixed-type collectives enqueued in one burst: the coordinator
+    interleaves fusion-eligible allreduces with gathers/broadcasts and
+    every result is correct."""
+    run_workers(n, "mixed_stress")
 
 
 def test_engine_restart_same_process():
