@@ -27,7 +27,8 @@ from horovod_tpu.parallel.api import shard_params
 
 def main():
     args = example_args("BERT pretraining (FSDP, synthetic)", batch_size=8,
-                        lr=1e-4, steps=40, seq_len=128, fsdp=-1)
+                        lr=1e-4, steps=40, seq_len=128, fsdp=-1,
+                        flash=False)
     hvd.init()
     n = hvd.num_chips()
     fsdp = n if args.fsdp == -1 else args.fsdp
@@ -36,7 +37,14 @@ def main():
     cfg = BertConfig.tiny() if args.smoke else BertConfig.base()
     seq = 32 if args.smoke else args.seq_len
     steps = 4 if args.smoke else args.steps
-    model = BertForPretraining(cfg)
+    if args.flash:
+        # --flash: the Pallas kernel behind the encoder's attention seam
+        # (key-padding masks honored; dense fallback off-tile shapes).
+        from horovod_tpu.ops.flash_attention import flash_attention_fn
+
+        model = BertForPretraining(cfg, attention_fn=flash_attention_fn)
+    else:
+        model = BertForPretraining(cfg)
 
     ids = jnp.zeros((args.batch_size, seq), jnp.int32)
     params = jax.jit(lambda: model.init(jax.random.key(0), ids))()
@@ -47,7 +55,13 @@ def main():
 
     def loss_fn(params, batch):
         input_ids, mlm_labels, mask_positions, nsp_labels = batch
+        # Explicit all-valid attention mask: BERT is BIDIRECTIONAL, and
+        # the flash adapter treats a missing mask as decoder (causal)
+        # semantics — passing the mask keeps both attention backends on
+        # the same bidirectional math.
+        attn_mask = jnp.ones_like(input_ids)
         mlm_logits, nsp_logits = model.apply(params, input_ids,
+                                             attention_mask=attn_mask,
                                              train=False)
         logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), -1)
         mlm_nll = -jnp.take_along_axis(logp, mlm_labels[..., None], -1)
