@@ -283,9 +283,6 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     dq = jax.lax.fori_loop(kv_first, n_kv_live, body,
                            jnp.zeros((block_q, d), jnp.float32))
-    # (_bwd_dkv_kernel keeps the causal-only bounds: its per-KV-block skip
-    # would need each q block's seg minimum before loading it; the masked
-    # blocks there are correct, just not skipped.)
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
@@ -304,6 +301,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         first_q = (ki * block_k) // block_q
     else:
         first_q = 0
+    n_q_live = n_q
+    if seg_ref is not None:
+        # Packed rows: segment starts are NONDECREASING, so queries that
+        # can see this KV block (seg_start <= kv block end) are a prefix
+        # of rows — bound the loop instead of iterating fully-masked
+        # blocks (the dkv twin of the fwd/dq kv_first skip).
+        kv_end = (ki + 1) * block_k - 1
+        valid_rows = jnp.sum(
+            (seg_ref[0, :] <= kv_end).astype(jnp.int32))
+        n_q_live = jnp.minimum(n_q, (valid_rows + block_q - 1) // block_q)
 
     def body(qi, carry):
         dk, dv = carry
@@ -345,7 +352,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dk, dv
 
     dk, dv = jax.lax.fori_loop(
-        first_q, n_q, body,
+        first_q, n_q_live, body,
         (jnp.zeros((block_k, d), jnp.float32),
          jnp.zeros((block_k, d), jnp.float32)))
     dk_ref[:] = dk.astype(dk_ref.dtype)
