@@ -241,17 +241,16 @@ class HorovodBasics:
                     jaddr = f"{host}:{int(port) + 64}"
                 import jax
 
-                try:
+                # A retried init() after a failure elsewhere finds the JAX
+                # runtime already up — that is fine.  Ask the runtime's own
+                # public API rather than parsing exception text (which is
+                # brittle across JAX versions).
+                if not jax.distributed.is_initialized():
                     jax.distributed.initialize(
                         coordinator_address=jaddr,
                         num_processes=size,
                         process_id=rank,
                     )
-                except RuntimeError as e:
-                    # A retried init() after a failure elsewhere finds the
-                    # JAX runtime already up — that is fine.
-                    if "already" not in str(e).lower():
-                        raise
             self._rank = rank
             self._size = size
             self._local_rank = local_rank

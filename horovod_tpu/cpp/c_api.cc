@@ -54,6 +54,20 @@ int64_t horovod_enqueue(int op, const char* name, int dtype, int ndim,
                                root_rank, static_cast<hvd::ReduceOp>(red_op));
 }
 
+// Layout-probe allreduce (sum) for a tensor whose gradient never
+// materialized locally: completes as a normal dense allreduce unless peers
+// are gathering the tensor sparsely, in which case the handle fails with
+// "__sparse_retry__:<sparse_dim>" and the caller re-enqueues zero-entry
+// sparse gathers (see Request::probe in message.h).
+int64_t horovod_enqueue_probe(const char* name, int dtype, int ndim,
+                              const int64_t* shape, void* data) {
+  std::vector<int64_t> dims(shape, shape + ndim);
+  return Engine::Get().Enqueue(RequestType::ALLREDUCE, name,
+                               static_cast<DataType>(dtype), dims, data,
+                               /*root_rank=*/-1, hvd::ReduceOp::SUM,
+                               /*probe=*/true);
+}
+
 int horovod_poll(int64_t handle) { return Engine::Get().Poll(handle); }
 int horovod_wait(int64_t handle) { return Engine::Get().Wait(handle); }
 

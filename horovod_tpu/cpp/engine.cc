@@ -6,6 +6,10 @@
 #include <cstdlib>
 #include <sstream>
 
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
 namespace hvd {
 
 // ---------------------------------------------------------------------------
@@ -103,22 +107,72 @@ static void TypedReduce(void* dst, const void* src, int64_t n, ReduceOp op) {
   }
 }
 
-// 16-bit floats combine through fp32 (conversion round trip per element —
-// a host control-plane data path, not the accelerator hot path).
+// 16-bit floats combine through fp32.  The op switch is hoisted out of the
+// loop so each body is straight-line: the bf16 conversions are branch-free
+// shifts and the fused convert-combine-convert loop auto-vectorizes.  This
+// is the eager/DCN hot loop for fused 64 MB gradient buffers (the TPU jit
+// path never touches it).
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float), typename F>
+static void HalfCombineLoop(uint16_t* d, const uint16_t* s, int64_t n, F f) {
+  for (int64_t i = 0; i < n; ++i) d[i] = FromF(f(ToF(d[i]), ToF(s[i])));
+}
+
+#if defined(__x86_64__)
+// IEEE-half summation via the F16C hardware converters, 8 lanes at a time
+// (the scalar HalfToFloat/FloatToHalf branch on subnormals and cannot
+// vectorize).  Role parity with the reference's AVX fp16 MPI op
+// (common/half.cc:26-65); selected once per call via CPUID, never inside
+// the loop.
+__attribute__((target("f16c,avx")))
+static void HalfSumF16C(uint16_t* d, const uint16_t* s, int64_t n) {
+  int64_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 a = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(d + i)));
+    __m256 b = _mm256_cvtph_ps(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(s + i)));
+    _mm_storeu_si128(
+        reinterpret_cast<__m128i*>(d + i),
+        _mm256_cvtps_ph(_mm256_add_ps(a, b), _MM_FROUND_TO_NEAREST_INT));
+  }
+  for (; i < n; ++i) d[i] = FloatToHalf(HalfToFloat(d[i]) + HalfToFloat(s[i]));
+}
+
+static bool HasF16C() {
+  static const bool has = __builtin_cpu_supports("f16c") &&
+                          __builtin_cpu_supports("avx");
+  return has;
+}
+#endif
+
 template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
 static void HalfReduce(void* dst, const void* src, int64_t n, ReduceOp op) {
   uint16_t* d = static_cast<uint16_t*>(dst);
   const uint16_t* s = static_cast<const uint16_t*>(src);
-  for (int64_t i = 0; i < n; ++i) {
-    float a = ToF(d[i]), b = ToF(s[i]);
-    float r;
-    switch (op) {
-      case ReduceOp::SUM: r = a + b; break;
-      case ReduceOp::MIN: r = b < a ? b : a; break;
-      case ReduceOp::MAX: r = a < b ? b : a; break;
-      default: r = a * b; break;
-    }
-    d[i] = FromF(r);
+#if defined(__x86_64__)
+  if (op == ReduceOp::SUM && ToF == static_cast<float (*)(uint16_t)>(
+                                 HalfToFloat) && HasF16C()) {
+    HalfSumF16C(d, s, n);
+    return;
+  }
+#endif
+  switch (op) {
+    case ReduceOp::SUM:
+      HalfCombineLoop<ToF, FromF>(d, s, n,
+                                  [](float a, float b) { return a + b; });
+      return;
+    case ReduceOp::MIN:
+      HalfCombineLoop<ToF, FromF>(
+          d, s, n, [](float a, float b) { return b < a ? b : a; });
+      return;
+    case ReduceOp::MAX:
+      HalfCombineLoop<ToF, FromF>(
+          d, s, n, [](float a, float b) { return a < b ? b : a; });
+      return;
+    case ReduceOp::PROD:
+      HalfCombineLoop<ToF, FromF>(d, s, n,
+                                  [](float a, float b) { return a * b; });
+      return;
   }
 }
 
@@ -672,6 +726,45 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
     timeline_.NegotiateEnd(name);
     out.responses.push_back(BuildResponse(name));
   }
+
+  // Sparse-layout rendezvous: a pending entry whose received requests are
+  // ALL layout probes (ranks with no local gradient), coexisting with a
+  // pending sparse gather of the same tensor ("<name>.idx"), would
+  // deadlock — the probing ranks wait for peers to join the dense
+  // allreduce while the peers wait for them to join the allgathers.
+  // Resolve it by telling the probing ranks to retry sparsely; their
+  // re-enqueued zero-entry '<name>.idx'/'.vals' complete the gathers.
+  // (A NON-probe dense request conflicting with a sparse gather is a real
+  // layout inconsistency across ranks and is left to the stall warning.)
+  std::vector<std::pair<std::string, int64_t>> sparse_retries;
+  for (auto& kv : message_table_) {
+    const PendingInfo& info = kv.second;
+    bool all_probe = info.count > 0;
+    for (int r = 0; r < size_ && all_probe; ++r) {
+      if (info.seen[r] && !info.requests[r].probe) all_probe = false;
+    }
+    if (!all_probe) continue;
+    auto sp = message_table_.find(kv.first + ".idx");
+    if (sp == message_table_.end() || sp->second.count == 0) continue;
+    for (int r = 0; r < size_; ++r) {
+      if (sp->second.seen[r]) {
+        const auto& shape = sp->second.requests[r].shape;
+        sparse_retries.emplace_back(kv.first,
+                                    shape.size() > 1 ? shape[1] : 1);
+        break;
+      }
+    }
+  }
+  for (auto& [name, sparse_dim] : sparse_retries) {
+    timeline_.NegotiateEnd(name);
+    message_table_.erase(name);
+    Response resp;
+    resp.type = ResponseType::SPARSE_RETRY;
+    resp.tensor_names.push_back(name);
+    resp.tensor_sizes.push_back(sparse_dim);
+    out.responses.push_back(std::move(resp));
+  }
+
   FuseResponses(out.responses);
   return out;
 }
@@ -890,6 +983,17 @@ void Engine::PerformResponse(const Response& response) {
   if (response.type == ResponseType::ERROR) {
     for (auto& e : entries) {
       FinishEntry(e, Status::PreconditionError(response.error_message));
+    }
+    return;
+  }
+  if (response.type == ResponseType::SPARSE_RETRY) {
+    // Only ranks that enqueued the layout probe hold an entry; they fail
+    // the handle with the magic message so the frontend re-enqueues
+    // zero-entry sparse gathers.  Ranks without an entry ignore it.
+    int64_t sd = response.tensor_sizes.empty() ? 1 : response.tensor_sizes[0];
+    for (auto& e : entries) {
+      FinishEntry(e, Status::PreconditionError(
+          "__sparse_retry__:" + std::to_string(sd)));
     }
     return;
   }
@@ -1436,7 +1540,8 @@ void Engine::CheckForStalledTensors() {
 
 int64_t Engine::Enqueue(RequestType type, const std::string& name,
                         DataType dtype, const std::vector<int64_t>& shape,
-                        void* data, int root_rank, ReduceOp red_op) {
+                        void* data, int root_rank, ReduceOp red_op,
+                        bool probe) {
   if (!initialized_.load() || shutdown_requested_.load() ||
       shut_down_.load()) {
     return -2;
@@ -1464,6 +1569,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   q.tensor_name = name;
   q.root_rank = root_rank;
   q.red_op = red_op;
+  q.probe = probe;
   q.shape = shape;
 
   {

@@ -84,9 +84,14 @@ class Engine {
   // Enqueue a collective on caller-owned memory.  Returns a handle, or -1
   // (duplicate name in flight — reference DUPLICATE_NAME_ERROR,
   // operations.cc:2058-2061) or -2 (not initialized / shut down).
+  // `probe` marks a dense allreduce as a layout probe (see Request::probe):
+  // it completes normally unless peers are gathering the tensor sparsely,
+  // in which case the handle fails with the magic "__sparse_retry__:<dim>"
+  // error and the caller re-enqueues zero-entry sparse gathers.
   int64_t Enqueue(RequestType type, const std::string& name, DataType dtype,
                   const std::vector<int64_t>& shape, void* data,
-                  int root_rank, ReduceOp red_op = ReduceOp::SUM);
+                  int root_rank, ReduceOp red_op = ReduceOp::SUM,
+                  bool probe = false);
 
   int Poll(int64_t handle);                  // 0 pending, 1 ok, -1 error
   int Wait(int64_t handle);                  // blocks; returns Poll result

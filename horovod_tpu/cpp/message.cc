@@ -9,6 +9,7 @@ static void SerializeRequest(const Request& q, Writer* w) {
   w->str(q.tensor_name);
   w->i32(q.root_rank);
   w->u8(static_cast<uint8_t>(q.red_op));
+  w->u8(q.probe ? 1 : 0);
   w->u32(static_cast<uint32_t>(q.shape.size()));
   for (auto d : q.shape) w->i64(d);
 }
@@ -20,6 +21,7 @@ static bool ParseRequest(Reader* r, Request* q) {
   q->tensor_name = r->str();
   q->root_rank = r->i32();
   q->red_op = static_cast<ReduceOp>(r->u8());
+  q->probe = r->u8() != 0;
   uint32_t nd = r->u32();
   q->shape.clear();
   for (uint32_t i = 0; i < nd && r->ok(); ++i) q->shape.push_back(r->i64());

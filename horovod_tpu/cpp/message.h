@@ -34,6 +34,13 @@ enum class ResponseType : uint8_t {
   ERROR = 3,
   REDUCESCATTER = 4,
   ALLTOALL = 5,
+  // Sparse-layout rendezvous (no reference equivalent; the reference
+  // deadlocks when a torch param produces sparse grads on some ranks and
+  // none on others in the same step): tells ranks whose dense LAYOUT-PROBE
+  // allreduce conflicts with peers' pending sparse gathers to retry as a
+  // zero-entry sparse gather.  tensor_sizes[0] carries the sparse_dim
+  // gleaned from the peers' '<name>.idx' request shape.
+  SPARSE_RETRY = 6,
 };
 
 inline const char* RequestTypeName(RequestType t) {
@@ -74,6 +81,12 @@ struct Request {
   std::string tensor_name;
   int32_t root_rank = -1;   // broadcast only
   ReduceOp red_op = ReduceOp::SUM;  // allreduce/reducescatter only
+  // Layout probe: "this rank has no local gradient for this tensor and
+  // does not know its layout; these are placeholder zeros."  A probe
+  // behaves as a normal dense allreduce participant unless the coordinator
+  // sees peers gathering the tensor sparsely, in which case the probing
+  // ranks get a SPARSE_RETRY response instead of a deadlock.
+  bool probe = false;
   std::vector<int64_t> shape;
 };
 

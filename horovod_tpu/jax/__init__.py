@@ -149,6 +149,14 @@ def reducescatter(tensor, *, axis_name="data", op=Sum, scatter_axis=0,
     if _is_traced(tensor):
         return _cops.reducescatter(tensor, axis_name=axis_name, op=op,
                                    scatter_axis=scatter_axis, tiled=tiled)
+    if not tiled:
+        # Untiled (leading-dim-removed) output shapes are only implemented
+        # on the traced path; the eager engine always returns the tiled
+        # per-rank slice.  Raise rather than silently ignoring the flag.
+        raise NotImplementedError(
+            "eager reducescatter implements tiled=True semantics only; "
+            "use the traced path for tiled=False"
+        )
     if size() == 1:
         # World of one: reduce is identity, the scatter keeps the full
         # shard — for any op/axis (matches the reference under -np 1).
@@ -356,6 +364,12 @@ def make_train_step(loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
     batch_spec = PartitionSpec(axes)
     replicated = PartitionSpec()
     n_state = 3 if has_aux else 2
+    # check_vma=False because this step implements the Horovod pattern —
+    # an EXPLICIT grad psum in DistributedOptimizer.update — whereas
+    # VMA-aware AD would itself psum the cotangents of the replicated
+    # params (double-reduction).  Composing a loss_fn that uses
+    # pipeline_apply with this builder is guarded: pipeline_apply raises
+    # at trace time when VMA checking is off (parallel/pipeline.py).
     step = jax.shard_map(
         _sharded_step_aux if has_aux else _sharded_step,
         mesh=mesh,

@@ -37,12 +37,35 @@ from __future__ import annotations
 
 import functools
 import math
+import warnings
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "flash_attention_fn"]
+__all__ = ["flash_attention", "flash_attention_fn", "fallback_count"]
+
+# Dense-fallback observability: a production config one head-dim off the
+# kernel tiling should not silently lose the kernel's speedup.  Each
+# distinct reason warns once per process; the counter counts every
+# fallback TRACE (not execution — under jit the choice is made at trace
+# time).
+_fallbacks: dict = {}
+
+
+def fallback_count() -> int:
+    """Number of times flash_attention has fallen back to the XLA dense
+    path at trace time (all reasons combined)."""
+    return sum(_fallbacks.values())
+
+
+def _note_fallback(reason: str) -> None:
+    first = reason not in _fallbacks
+    _fallbacks[reason] = _fallbacks.get(reason, 0) + 1
+    if first:
+        warnings.warn(
+            "flash_attention falling back to the XLA dense path: " + reason,
+            RuntimeWarning, stacklevel=3)
 
 _NEG_INF = float("-inf")
 
@@ -499,7 +522,34 @@ def _supported(S: int, D: int) -> bool:
     # D=64 (BERT-family head dim) runs at reduced lane utilization (Mosaic
     # pads the minor dim) but still beats XLA's dense attention on-chip:
     # measured 1.25x at S=2048 and 1.6x at S=4096 (bf16, masked).
-    return S % 128 == 0 and D % 64 == 0
+    # S is NOT constrained here: off-tile sequence lengths are padded to
+    # the next multiple of 128 in flash_attention (see _pad_to_tile).
+    return D % 64 == 0
+
+
+def _pad_to_tile(q, k, v, causal, key_padding_mask, segment_ids):
+    """Zero-pad the sequence dim to the next multiple of 128 and arrange
+    masking so padded KEYS are never attended: pure-causal configs exclude
+    trailing positions via the causal triangle already; masked configs get
+    the pad marked invalid; bare bidirectional configs gain a key-padding
+    mask; packed configs put the pad in a fresh trailing segment.  Padded
+    QUERY rows produce garbage that the caller slices off, and their
+    upstream cotangents are exactly zero (the slice's transpose), so they
+    contribute nothing to dQ/dK/dV."""
+    B, S = q.shape[:2]
+    pad = -S % 128
+    zpad = lambda t: jnp.pad(t, ((0, 0), (0, pad)) + ((0, 0),) * (t.ndim - 2))
+    q, k, v = zpad(q), zpad(k), zpad(v)
+    if segment_ids is not None:
+        segment_ids = jnp.concatenate(
+            [segment_ids,
+             jnp.broadcast_to(segment_ids[:, -1:] + 1, (B, pad))], axis=1)
+    elif key_padding_mask is not None:
+        key_padding_mask = zpad(key_padding_mask)  # zero-pad == False
+    elif not causal:
+        key_padding_mask = jnp.concatenate(
+            [jnp.ones((B, S), bool), jnp.zeros((B, pad), bool)], axis=1)
+    return q, k, v, key_padding_mask, segment_ids
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
@@ -513,8 +563,20 @@ def flash_attention(q, k, v, *, causal: bool = True,
     sequences (causal only, exclusive with the padding mask): each query
     attends only within its own segment — block-diagonal causal attention
     for packed pretraining, at O(S) sideband cost instead of an [S, S]
-    mask.  GQA (fewer KV heads) is handled by repeating KV heads; falls
-    back to the XLA dense path when S or D don't fit the kernel tiling.
+    mask.  GQA (fewer KV heads) is handled by repeating KV heads.
+
+    Off-tile sequence lengths (S not a multiple of 128) are zero-padded to
+    the next tile and sliced back, so BERT/packed configs one token off
+    the block size keep the kernel.  Head dims that don't fit the MXU
+    tiling (D not a multiple of 64) fall back to the XLA dense path with a
+    once-per-reason ``RuntimeWarning`` (see :func:`fallback_count`).
+
+    Fully-masked query rows (every key excluded by ``key_padding_mask``)
+    produce UNDEFINED outputs — the -1e30 mask bias and the -1e30 running
+    max cancel, yielding uniform attention over the masked keys — and, if
+    given nonzero upstream cotangents, contribute garbage to dK/dV.  This
+    matches the dense fallback's behavior; callers must not consume such
+    rows (standard BERT practice masks them out of the loss).
     """
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
@@ -531,6 +593,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
         from horovod_tpu.models.llama import causal_attention
         from horovod_tpu.models.bert import dot_product_attention
 
+        _note_fallback(f"head dim {D} is not a multiple of 64")
         kr = k.repeat(Hq // Hkv, axis=2) if Hkv != Hq else k
         vr = v.repeat(Hq // Hkv, axis=2) if Hkv != Hq else v
         if segment_ids is not None:
@@ -549,6 +612,12 @@ def flash_attention(q, k, v, *, causal: bool = True,
         if causal:
             return causal_attention(q, k, v)
         return dot_product_attention(q, kr, vr)
+    if S % 128 != 0:
+        q, k, v, key_padding_mask, segment_ids = _pad_to_tile(
+            q, k, v, causal, key_padding_mask, segment_ids)
+        return flash_attention(
+            q, k, v, causal=causal, key_padding_mask=key_padding_mask,
+            segment_ids=segment_ids)[:, :S]
     if Hkv != Hq:
         k = jnp.repeat(k, Hq // Hkv, axis=2)
         v = jnp.repeat(v, Hq // Hkv, axis=2)

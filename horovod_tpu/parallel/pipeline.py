@@ -17,8 +17,8 @@ No reference equivalent (Horovod 0.15.1 is data-parallel only, SURVEY.md
   semantics: all microbatch activations live until backward; wrap
   ``stage_fn`` in ``jax.checkpoint`` to trade FLOPs for memory).
 
-IMPORTANT: differentiate through ``pipeline_apply`` only under
-``shard_map(..., check_vma=True)`` (the default).  The final
+IMPORTANT: ``pipeline_apply`` requires ``shard_map(..., check_vma=True)``
+(the default) and raises at trace time otherwise.  The final
 broadcast-from-last-stage is a masked psum; with ``check_vma=False`` its
 transpose conservatively sums the replicated cotangents and every stage
 gradient comes out multiplied by the stage count.  VMA-aware shard_map
@@ -66,6 +66,16 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
     """
     n_stages = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
+    # Guard against the silent-wrong-gradients mode documented above: with
+    # check_vma=False the axis_index aval does not track its varying axis,
+    # so this is a reliable trace-time probe of the enclosing shard_map.
+    if axis_name not in jax.typeof(idx).vma:
+        raise ValueError(
+            "pipeline_apply must run under shard_map(..., check_vma=True): "
+            "with VMA checking off, the transpose of the final "
+            "broadcast-from-last-stage psum sums replicated cotangents and "
+            "every stage gradient comes out multiplied by the stage count."
+        )
     M = n_microbatches
     B = x.shape[0]
     if B % M != 0:

@@ -24,11 +24,26 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["NativeEngine", "get_engine", "HorovodInternalError"]
+__all__ = ["NativeEngine", "get_engine", "HorovodInternalError",
+           "SparseGradRetry"]
 
 
 class HorovodInternalError(RuntimeError):
     """A collective failed (cross-rank mismatch, shutdown, transport)."""
+
+
+class SparseGradRetry(Exception):
+    """A layout-probe allreduce was told by the coordinator that peers are
+    gathering this tensor SPARSELY: the caller must re-enqueue zero-entry
+    sparse gathers ('<name>.idx' / '<name>.vals').  Raised only for
+    handles created by :meth:`NativeEngine.enqueue_probe`."""
+
+    def __init__(self, sparse_dim: int):
+        super().__init__(f"retry sparsely (sparse_dim={sparse_dim})")
+        self.sparse_dim = sparse_dim
+
+
+_SPARSE_RETRY_PREFIX = "__sparse_retry__:"
 
 
 # DataType codes, keep in sync with cpp/common.h.
@@ -83,6 +98,11 @@ class NativeEngine:
             ctypes.c_int,
         ]
         lib.horovod_enqueue.restype = ctypes.c_int64
+        lib.horovod_enqueue_probe.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+        ]
+        lib.horovod_enqueue_probe.restype = ctypes.c_int64
         lib.horovod_poll.argtypes = [ctypes.c_int64]
         lib.horovod_poll.restype = ctypes.c_int
         lib.horovod_wait.argtypes = [ctypes.c_int64]
@@ -154,6 +174,26 @@ class NativeEngine:
         return self._enqueue(
             _OP_ALLGATHER, arr, self._auto_name("allgather", name))
 
+    def enqueue_probe(self, arr: np.ndarray, name: str) -> int:
+        """Layout-probe allreduce (sum) of placeholder zeros for a tensor
+        with no local gradient.  Completes as a dense allreduce unless
+        peers are gathering the tensor sparsely — then ``synchronize``
+        raises :class:`SparseGradRetry` instead."""
+        shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+        handle = self._lib.horovod_enqueue_probe(
+            name.encode(), _dtype_code(arr.dtype), arr.ndim, shape,
+            arr.ctypes.data_as(ctypes.c_void_p))
+        if handle == -1:
+            raise HorovodInternalError(
+                f"a collective named {name!r} is already in flight "
+                "(duplicate name)")
+        if handle < 0:
+            raise HorovodInternalError(
+                "engine is not running (init not called or already shut down)")
+        with self._inflight_lock:
+            self._inflight[handle] = arr
+        return handle
+
     def enqueue_broadcast(self, arr: np.ndarray, root_rank: int,
                           name: Optional[str] = None) -> int:
         return self._enqueue(
@@ -196,8 +236,11 @@ class NativeEngine:
             if status < 0:
                 buf = ctypes.create_string_buffer(4096)
                 self._lib.horovod_error_message(handle, buf, len(buf))
-                raise HorovodInternalError(
-                    buf.value.decode(errors="replace") or "collective failed")
+                msg = buf.value.decode(errors="replace")
+                if msg.startswith(_SPARSE_RETRY_PREFIX):
+                    raise SparseGradRetry(
+                        int(msg[len(_SPARSE_RETRY_PREFIX):]))
+                raise HorovodInternalError(msg or "collective failed")
             ndim = self._lib.horovod_result_ndim(handle)
             if ndim > 0:  # a fresh out-of-place result was negotiated
                 shape = tuple(self._lib.horovod_result_dim(handle, i)

@@ -169,6 +169,21 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         h_val = allgather_async(vals, name=f"{name}.vals" if name else None)
         return ("sparse", h_idx, h_val)
 
+    def _zero_sparse_grad(self, p, sd):
+        return torch.sparse_coo_tensor(
+            torch.zeros((sd, 0), dtype=torch.int64),
+            p.data.new_zeros((0,) + p.shape[sd:]),
+            size=p.shape)
+
+    def _finish_sparse(self, p, h_idx, h_val):
+        idx_all = synchronize(h_idx)
+        val_all = synchronize(h_val)
+        # coalesce() sums duplicate indices across ranks; divide for the
+        # same average semantics as the dense path.
+        p.grad = torch.sparse_coo_tensor(
+            idx_all.t(), val_all / size(), size=p.shape,
+            dtype=val_all.dtype).coalesce()
+
     def synchronize(self):
         """Finish all gradient allreduces and write results into ``.grad``
         (reference torch/__init__.py:98-108).  Parameters whose hook never
@@ -176,42 +191,63 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         deadlock (the force-allreduce contract, reference test_torch.py
         test_force_allreduce).  A param that ever produced a sparse grad
         takes the sparse gather path here too (with zero entries), so the
-        collective names stay consistent with ranks whose hook did fire —
-        NOTE: on the very first step, a sparse param that fires on some
-        ranks and not others cannot be auto-detected and will stall (the
-        stall warning names the tensor); run one warmup step touching all
-        embeddings, or use sparse_as_dense=True, for data-dependent
-        architectures."""
+        collective names stay consistent with ranks whose hook did fire.
+        A param whose layout is still UNKNOWN (hook never fired on this
+        rank, e.g. the very first step of a data-dependent architecture)
+        goes out as a wire-level layout PROBE: it completes as a dense
+        zero allreduce unless peers are gathering it sparsely, in which
+        case the coordinator answers SPARSE_RETRY and this rank joins the
+        peers' '.idx'/'.vals' allgathers with zero entries — no warmup
+        step needed, no stall."""
         for group in self.param_groups:
             for p in group["params"]:
                 if p.requires_grad and p not in self._handles:
                     if p.grad is None:
                         sd = self._sparse_params.get(id(p))
                         if sd is not None:
-                            p.grad = torch.sparse_coo_tensor(
-                                torch.zeros((sd, 0), dtype=torch.int64),
-                                p.data.new_zeros((0,) + p.shape[sd:]),
-                                size=p.shape)
+                            p.grad = self._zero_sparse_grad(p, sd)
                         else:
                             p.grad = p.data.new_zeros(p.shape)
+                            if not self._sparse_as_dense:
+                                self._handles[p] = self._probe_grad_async(p)
+                                continue
                     self._handles[p] = self._allreduce_grad_async(p)
-        n = size()
+        from horovod_tpu.runtime.engine import SparseGradRetry
+
         for p, entry in self._handles.items():
             if entry[0] == "sparse":
                 _, h_idx, h_val = entry
-                idx_all = synchronize(h_idx)
-                val_all = synchronize(h_val)
-                # coalesce() sums duplicate indices across ranks; divide for
-                # the same average semantics as the dense path.
-                p.grad = torch.sparse_coo_tensor(
-                    idx_all.t(), val_all / n, size=p.grad.shape,
-                    dtype=p.grad.dtype).coalesce()
+                self._finish_sparse(p, h_idx, h_val)
+            elif entry[0] == "probe":
+                _, handle, tensor_compressed, ctx = entry
+                try:
+                    output = synchronize(handle)
+                    p.grad.data.set_(
+                        self._compression.decompress(output, ctx).data)
+                except SparseGradRetry as retry:
+                    self._sparse_params[id(p)] = retry.sparse_dim
+                    p.grad = self._zero_sparse_grad(p, retry.sparse_dim)
+                    _, h_idx, h_val = self._sparse_allgather_async(
+                        p, self._param_names.get(id(p)))
+                    self._finish_sparse(p, h_idx, h_val)
             else:
                 handle, tensor_compressed, ctx = entry
                 output = synchronize(handle)
                 p.grad.data.set_(
                     self._compression.decompress(output, ctx).data)
         self._handles.clear()
+
+    def _probe_grad_async(self, p):
+        """Layout-probe for a param with no grad and no recorded layout:
+        same name and compression as the dense hook path, flagged on the
+        wire so a sparse/dense conflict resolves instead of stalling."""
+        from horovod_tpu.torch.mpi_ops import _probe_allreduce_async_
+
+        name = self._param_names.get(id(p))
+        tensor_compressed, ctx = self._compression.compress(p.grad.data)
+        handle = _probe_allreduce_async_(tensor_compressed.contiguous(),
+                                         name)
+        return ("probe", handle, tensor_compressed, ctx)
 
     def step(self, closure=None):
         self.synchronize()
@@ -267,7 +303,25 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0):
             for p in group["params"]:
                 if p.requires_grad and p.grad is None:
                     p.grad = p.data.new_zeros(p.shape)
-        optimizer.step()
+        # The dummy step must (a) stay LOCAL — on resume only the ranks
+        # with un-restored state take this path, so the DistributedOptimizer
+        # wrapper's step() would enqueue collectives the other ranks never
+        # join — and (b) leave params untouched (weight decay moves params
+        # even at zero grad, and the state broadcast below does not undo
+        # param drift).
+        saved = [p.detach().clone()
+                 for group in optimizer.param_groups
+                 for p in group["params"]]
+        if hasattr(optimizer, "_allreduce_grad_async"):
+            # Bypass the wrapper: MRO is (DynamicWrapper, UserOptimizer, …).
+            type(optimizer).__mro__[1].step(optimizer)
+        else:
+            optimizer.step()
+        it = iter(saved)
+        with torch.no_grad():
+            for group in optimizer.param_groups:
+                for p in group["params"]:
+                    p.data.copy_(next(it))
         state_dict = optimizer.state_dict()
 
     callbacks = {}

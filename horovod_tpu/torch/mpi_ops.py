@@ -149,6 +149,29 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
     return allreduce_async_(out, average, name)
 
 
+def _probe_allreduce_async_(tensor: torch.Tensor,
+                            name: Optional[str] = None) -> int:
+    """In-place layout-probe allreduce (always averaged) of placeholder
+    zeros for a param whose gradient never materialized this step.
+    ``synchronize`` on the returned handle raises
+    :class:`horovod_tpu.runtime.engine.SparseGradRetry` if peers turn out
+    to be gathering this tensor sparsely."""
+    if name is None:
+        # A probe exists to rendezvous with PEERS' collectives for the
+        # same tensor; an invented fallback name could never match them.
+        raise ValueError("layout-probe allreduce requires the tensor name")
+    eng = _engine()
+    if eng is None:
+        return _local_handle(tensor)
+    view = _np_view(tensor)
+    handle = eng.enqueue_probe(view, name)
+
+    def post(t, _out):
+        return _div_in_place(t, basics.size())
+
+    return _register(handle, tensor, post)
+
+
 def allreduce_(tensor, average: bool = True,
                name: Optional[str] = None) -> torch.Tensor:
     return synchronize(allreduce_async_(tensor, average, name))

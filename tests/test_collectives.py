@@ -237,6 +237,10 @@ def test_eager_reducescatter_alltoall_single_process():
                                   np.asarray(x))
     np.testing.assert_array_equal(np.asarray(hvd.alltoall(x)),
                                   np.asarray(x))
+    # tiled=False semantics exist only on the traced path; the eager
+    # engine must refuse rather than silently return tiled output.
+    with pytest.raises(NotImplementedError, match="tiled"):
+        hvd.reducescatter(x, tiled=False)
 
 
 def test_ragged_allgather_pad_bucket_compact(n_devices):

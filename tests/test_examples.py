@@ -17,6 +17,7 @@ EXAMPLES = [
     ("jax_imagenet_resnet50.py", []),
     ("jax_word2vec.py", []),
     ("torch_mnist.py", []),
+    ("torch_imagenet_resnet50.py", []),
     ("torch_synthetic_benchmark.py", []),
     ("bert_pretraining_fsdp.py", []),
     ("llama_packed_pretraining.py", []),
@@ -37,7 +38,7 @@ def test_example_smoke(script, extra, tmp_path):
                         " --xla_force_host_platform_device_count=8").strip()
     cmd = [sys.executable, os.path.join(REPO, "examples", script),
            "--smoke"] + extra
-    if script in ("jax_imagenet_resnet50.py",):
+    if script in ("jax_imagenet_resnet50.py", "torch_imagenet_resnet50.py"):
         cmd += ["--checkpoint-dir", str(tmp_path / "ckpt")]
     p = subprocess.run(cmd, env=env, capture_output=True, timeout=420)
     assert p.returncode == 0, (
@@ -62,3 +63,52 @@ def test_resnet50_example_resumes(tmp_path):
     p2 = subprocess.run(cmd, env=env, capture_output=True, timeout=420)
     assert p2.returncode == 0, p2.stderr.decode()[-2000:]
     assert b"resuming from epoch" in p2.stdout
+
+
+def _run_torch_example_world(script, n, extra, timeout=420):
+    """Launch the example as an n-rank world over the engine's TCP
+    rendezvous (the mpirun role)."""
+    from tests.test_native_engine import _ensure_lib, _free_port
+
+    _ensure_lib()
+    port = _free_port()
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(n),
+            "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
+            "HOROVOD_CYCLE_TIME": "2",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "examples", script),
+             "--smoke"] + extra,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    try:
+        results = [p.communicate(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for rank, (p, (out, err)) in enumerate(zip(procs, results)):
+        assert p.returncode == 0, (
+            f"rank {rank} failed (rc={p.returncode}):\n"
+            f"stdout: {out.decode()[-2000:]}\nstderr: {err.decode()[-3000:]}")
+    return results
+
+
+def test_torch_resnet50_example_resumes_two_process(tmp_path):
+    """The torch ImageNet workload end-to-end at size 2: train + rank-0
+    checkpoint, then a second 2-rank run discovers the checkpoint on
+    rank 0, broadcasts the resume epoch, and restores state everywhere
+    (reference pytorch_imagenet_resnet50.py:62-72,140-142)."""
+    extra = ["--checkpoint-dir", str(tmp_path / "ckpt")]
+    _run_torch_example_world("torch_imagenet_resnet50.py", 2, extra)
+    results = _run_torch_example_world("torch_imagenet_resnet50.py", 2,
+                                       extra)
+    rank0_out = results[0][0]
+    assert b"resuming from epoch" in rank0_out

@@ -60,13 +60,63 @@ def test_flash_bf16():
         atol=3e-2, rtol=3e-2)
 
 
-def test_flash_fallback_odd_shapes():
-    """S not divisible by the block → silently uses the dense path."""
+def test_flash_padded_tail_causal():
+    """S not a multiple of 128 → zero-padded to the next tile and sliced
+    back (the kernel, not the dense fallback)."""
     q, k, v = _qkv(S=100, D=64)
     expected = causal_attention(q, k, v)
     got = flash_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_flash_padded_tail_gradients():
+    q, k, v = _qkv(B=1, S=200, H=2, Hkv=2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    dg = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    fg = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(dg, fg, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch")
+
+
+def test_flash_padded_tail_bidirectional_no_mask():
+    """Bare bidirectional attention with off-tile S: padded keys must be
+    excluded via the synthesized key-padding mask."""
+    from horovod_tpu.models.bert import dot_product_attention
+
+    q, k, v = _qkv(S=100, D=64)
+    expected = dot_product_attention(q, k, v)
+    got = flash_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_dense_fallback_warns_once_and_counts():
+    """D off the MXU tiling → dense fallback, one RuntimeWarning per
+    reason, every fallback counted."""
+    import warnings
+
+    from horovod_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv(S=128, D=32)
+    before = fa.fallback_count()
+    fa._fallbacks.pop("head dim 32 is not a multiple of 64", None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        flash_attention(q, k, v)
+        flash_attention(q, k, v)
+    msgs = [w for w in caught if issubclass(w.category, RuntimeWarning)
+            and "dense path" in str(w.message)]
+    assert len(msgs) == 1
+    assert fa.fallback_count() >= before + 2
 
 
 def test_llama_with_flash_attention():
@@ -248,3 +298,53 @@ def test_flash_d64_bert_head_dim():
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-4,
             err_msg=f"d{name} mismatch at D=64")
+
+
+def test_flash_padded_tail_key_padding_mask():
+    """Off-tile S with a BERT-style padding mask: the pad extends the mask
+    (never attended) and valid rows match the dense reference."""
+    from horovod_tpu.models.bert import dot_product_attention
+
+    S = 200
+    q, k, v = _qkv(B=2, S=S, H=2, Hkv=2, D=64)
+    mask = (jnp.arange(S)[None, :] < jnp.array([S, 160])[:, None])
+    expected = dot_product_attention(q, k, v, mask=mask[:, None, None, :])
+    got = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=False, key_padding_mask=mask))(q, k, v)
+    valid = np.asarray(mask)
+    np.testing.assert_allclose(np.asarray(got)[valid],
+                               np.asarray(expected)[valid],
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_padded_tail_segment_ids():
+    """Off-tile S with packed segments: the pad becomes a fresh trailing
+    segment, values and gradients match the dense block-diagonal mask."""
+    from horovod_tpu.models.bert import dot_product_attention
+
+    S = 300
+    q, k, v = _qkv(B=1, S=S, H=2, Hkv=2)
+    seg = jnp.where(jnp.arange(S) < 130, 0, 1)[None, :]
+
+    tri = jnp.tril(jnp.ones((S, S), bool))
+    same = seg[:, :, None] == seg[:, None, :]
+    dense_mask = same[:, None, :, :] & tri[None, None, :, :]
+    expected = dot_product_attention(q, k, v, mask=dense_mask)
+    got = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, segment_ids=seg))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=3e-5, rtol=3e-5)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, mask=dense_mask) ** 2)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       segment_ids=seg) ** 2)
+
+    dg = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    fg = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(dg, fg, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch")

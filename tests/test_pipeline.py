@@ -126,3 +126,25 @@ def test_pipeline_batch_divisibility_error(n_devices):
             in_specs=(jax.tree.map(lambda _: P("pipe"), staged), P()),
             out_specs=P(), check_vma=True,
         ))(staged, jnp.ones((4, 4)))
+
+
+def test_pipeline_rejects_check_vma_false(n_devices):
+    """Composing pipeline_apply with a VMA-off shard_map (e.g. the standard
+    make_train_step) must fail loudly at trace time, not silently produce
+    stage-count-multiplied gradients."""
+    mesh = hvd.build_mesh({"pipe": 2}, devices=jax.devices()[:2])
+    layers = _make_layers(2, 4)
+    staged = jax.tree.map(
+        lambda a: a.reshape((2, 1) + a.shape[1:]), stack_pytrees(layers))
+
+    def run(staged_local, x):
+        sp = jax.tree.map(lambda a: a[0], staged_local)
+        return pipeline_apply(_stage_fn, sp, x, axis_name="pipe",
+                              n_microbatches=2)
+
+    with pytest.raises(ValueError, match="check_vma=True"):
+        jax.jit(jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("pipe"), staged), P()),
+            out_specs=P(), check_vma=False,
+        ))(staged, jnp.ones((4, 4)))
