@@ -335,12 +335,14 @@ def main() -> None:
     # recorded by the thing that records numbers.  Degrade gracefully: the
     # ResNet line must survive a llama failure.
     try:
-        for k, v in _llama_result(measured).items():
-            if k in ("metric", "unit", "vs_baseline"):
+        llama = _llama_result(measured)
+        # The value keeps its own metric name (per-chip on TPU,
+        # cpu_smoke off-TPU) so artifacts never mix the two.
+        base = llama.pop("metric")
+        for k, v in llama.items():
+            if k in ("unit", "vs_baseline"):
                 continue
-            name = "llama_train_tokens_per_sec_per_chip" if k == "value" \
-                else f"llama_{k}"
-            result[name] = v
+            result[base if k == "value" else f"llama_{k}"] = v
     except Exception as e:
         result["llama_error"] = f"{type(e).__name__}: {e}"
 

@@ -240,6 +240,20 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       static_cast<int>(EnvInt64("HOROVOD_STALL_WARNING_SEC", 60));
   socket_timeout_sec_ =
       static_cast<int>(EnvInt64("HOROVOD_SOCKET_TIMEOUT_SEC", 120));
+  // Bound on control-plane patience for a live-but-wedged peer.  The old
+  // allowance scaled as (size+4) x socket timeout (~2.3 h at 64 ranks x
+  // 120 s before the descriptive abort); HOROVOD_CONTROL_PATIENCE_SEC
+  // caps it.  The default keeps a mild size-aware floor because a cycle's
+  // collective execution time genuinely grows with world size (a 64 MB
+  // ring is size-1 hops) — 30 s/rank ~= 32 min at 64 ranks, vs hours
+  // before.  Dead peers still fail fast via EOF/keepalive.
+  int control_patience_sec = static_cast<int>(EnvInt64(
+      "HOROVOD_CONTROL_PATIENCE_SEC",
+      std::max<int64_t>(600, static_cast<int64_t>(size_) * 30)));
+  control_patience_rounds_ =
+      socket_timeout_sec_ > 0
+          ? std::max(1, control_patience_sec / socket_timeout_sec_)
+          : 0;  // timeout disabled: blocking reads, rounds never consulted
   abort_reason_.clear();
   const char* timeline_path = std::getenv("HOROVOD_TIMELINE");
   if (timeline_path != nullptr && timeline_path[0] != '\0' && rank_ == 0) {
@@ -577,9 +591,26 @@ std::string Engine::TransportError(const std::string& op,
                                    const std::string& name,
                                    const std::string& detail, int next_rank,
                                    int prev_rank) const {
-  int peer = detail.rfind("recv", 0) == 0 ? prev_rank : next_rank;
-  return "rank " + std::to_string(peer) + " disconnected during " + op +
-         " of '" + name + "': " + detail;
+  // SendRecvAll prefixes every peer-attributable error with the direction
+  // that failed ("send"/"recv"); "link" means both directions stalled
+  // (either neighbor could be the culprit).  Anything else (poll, local
+  // resource errors) is a local failure — blaming a neighbor would send
+  // the operator to the wrong machine's logs.
+  if (detail.rfind("recv", 0) == 0) {
+    return "rank " + std::to_string(prev_rank) + " disconnected during " +
+           op + " of '" + name + "': " + detail;
+  }
+  if (detail.rfind("send", 0) == 0) {
+    return "rank " + std::to_string(next_rank) + " disconnected during " +
+           op + " of '" + name + "': " + detail;
+  }
+  if (detail.rfind("link", 0) == 0) {
+    return "ring neighbor rank " + std::to_string(next_rank) + " or rank " +
+           std::to_string(prev_rank) + " stalled during " + op + " of '" +
+           name + "': " + detail;
+  }
+  return "local transport failure during " + op + " of '" + name +
+         "': " + detail;
 }
 
 bool Engine::RunLoopOnce() {
@@ -621,11 +652,13 @@ bool Engine::RunLoopOnce() {
     lists[0] = std::move(my_list);
     // A worker's next frame only arrives after it finished executing the
     // previous cycle's collectives, which can legitimately span several
-    // socket-timeout rounds on slow links — hence the size-scaled patience
-    // (a crashed worker still fails immediately via EOF/keepalive).
+    // socket-timeout rounds on slow links — hence the idle allowance,
+    // bounded by HOROVOD_CONTROL_PATIENCE_SEC rather than scaling with
+    // world size (a crashed worker still fails immediately via
+    // EOF/keepalive).
     for (int r = 1; r < size_; ++r) {
       std::vector<uint8_t> frame;
-      if (!worker_conns_[r].RecvFrame(&frame, size_ + 4)) {
+      if (!worker_conns_[r].RecvFrame(&frame, control_patience_rounds_)) {
         abort_reason_ = "coordinator lost connection to rank " +
                         std::to_string(r) +
                         " — that process likely crashed or hung; check its "
@@ -673,7 +706,7 @@ bool Engine::RunLoopOnce() {
     return false;
   }
   std::vector<uint8_t> frame;
-  if (!coordinator_conn_.RecvFrame(&frame, size_ + 4)) {
+  if (!coordinator_conn_.RecvFrame(&frame, control_patience_rounds_)) {
     abort_reason_ = "lost connection to the coordinator (rank 0) — it "
                     "likely crashed or another rank failed; check rank 0's "
                     "logs.";

@@ -148,6 +148,9 @@ class Engine {
   // (HOROVOD_SOCKET_TIMEOUT_SEC; 0 disables).  A hung-but-connected peer
   // fails collectives with a descriptive error instead of blocking forever.
   int socket_timeout_sec_ = 120;
+  // Idle-round allowance for control-plane frames, derived from
+  // HOROVOD_CONTROL_PATIENCE_SEC (absolute, world-size independent).
+  int control_patience_rounds_ = 5;
 
   // Why the background loop aborted (set by the background thread before
   // RunLoopOnce returns false on a transport failure, read by it right

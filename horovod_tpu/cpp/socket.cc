@@ -259,8 +259,12 @@ bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
       return false;
     }
     if (rc == 0) {
-      *err = (sn > 0 ? "send to peer: " : "recv from peer: ") +
-             std::string("no progress for ") +
+      // With both directions pending either neighbor may be the one that
+      // stalled; "link" tells TransportError to name both candidates.
+      const char* dir = (sn > 0 && rn > 0) ? "link: "
+                        : sn > 0          ? "send to peer: "
+                                          : "recv from peer: ";
+      *err = dir + std::string("no progress for ") +
              std::to_string(timeout_ms / 1000) + "s (peer hung?)";
       return false;
     }

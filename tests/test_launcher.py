@@ -36,3 +36,70 @@ def test_launcher_propagates_failure():
         "sys.exit(3 if os.environ['HOROVOD_RANK'] == '1' else 0)\n"))
     assert p.returncode == 3
     assert b"terminating remaining" in p.stderr or p.returncode == 3
+
+
+def _run_multihost(body, n_hosts=2, pph=2, rank_fail=None, timeout=180):
+    """Two launcher invocations on localhost playing two hosts of one
+    world: global ranks = host_index * pph + local_rank, all rendezvous
+    at the shared coordinator (run.py's documented multi-host recipe)."""
+    import socket as socketlib
+
+    with socketlib.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    launchers = []
+    for host in range(n_hosts):
+        launchers.append(subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.run", "-np", str(pph),
+             "--host-index", str(host), "--hosts-total", str(n_hosts),
+             "--coordinator", f"127.0.0.1:{port}", "--",
+             sys.executable, "-c", body],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE))
+    try:
+        results = [p.communicate(timeout=timeout) for p in launchers]
+    finally:
+        for p in launchers:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return launchers, results
+
+
+def test_launcher_multihost_two_worlds_rendezvous():
+    """Two --host-index launchers form one 4-rank world: collective
+    identity across hosts plus the rank = host*pph + local_rank map."""
+    launchers, results = _run_multihost(
+        "import os\n"
+        "import horovod_tpu.torch as hvd\n"
+        "import torch\n"
+        "hvd.init()\n"
+        "assert hvd.size() == 4, hvd.size()\n"
+        "assert hvd.local_size() == 2\n"
+        "assert hvd.rank() == int(os.environ['HOROVOD_RANK'])\n"
+        "assert hvd.rank() // 2 * 2 + hvd.local_rank() == hvd.rank()\n"
+        "out = hvd.allreduce(torch.full((3,), float(hvd.rank() + 1)),"
+        " average=False)\n"
+        "assert out[0].item() == 10.0, out  # 1+2+3+4\n"
+        "g = hvd.allgather(torch.tensor([[float(hvd.rank())]]))\n"
+        "assert g.reshape(-1).tolist() == [0.0, 1.0, 2.0, 3.0], g\n"
+        "print('rank', hvd.rank(), 'multihost ok')\n"
+        "hvd.shutdown()\n")
+    for host, (p, (out, err)) in enumerate(zip(launchers, results)):
+        assert p.returncode == 0, (
+            f"host {host}: {out.decode()}\n{err.decode()}")
+    combined = b"".join(out for out, _ in results).decode()
+    for r in range(4):
+        assert f"[{r}] rank {r} multihost ok" in combined, combined
+
+
+def test_launcher_multihost_global_rank_error_attribution():
+    """A failure on the second host must be reported with its GLOBAL rank
+    (host_index * pph + local index), not the local process index."""
+    launchers, results = _run_multihost(
+        "import os, sys\n"
+        "sys.exit(5 if os.environ['HOROVOD_RANK'] == '3' else 0)\n")
+    assert launchers[1].returncode == 5
+    assert b"rank 3 exited with code 5" in results[1][1], results[1][1]

@@ -53,6 +53,13 @@ def test_torch_state_broadcast_equalizes():
     run_torch_workers(2, "state_bcast")
 
 
+def test_torch_optimizer_state_broadcast_sweep():
+    """broadcast_optimizer_state across 11 torch.optim classes, each with
+    and without a prior step (reference test_torch.py:734-936 breadth) —
+    per-param scalar state is where tensor-ization historically broke."""
+    run_torch_workers(2, "optimizer_sweep", timeout=300)
+
+
 def test_torch_state_broadcast_resume_asymmetry():
     """Root has restored optimizer state, peers start empty: the peers'
     state-materializing dummy step must stay local (no deadlock) and must

@@ -234,6 +234,86 @@ def scenario_sparse_force(rank, size):
             f"rank {rank}: diverged from rank {r}")
 
 
+def _flatten_opt_state(opt):
+    """Deterministic flat vector of every numeric leaf in the optimizer
+    state + param-group options, for cross-rank equality checks."""
+    sd = opt.state_dict()
+    parts = []
+    for gi, group in enumerate(sd["param_groups"]):
+        for key in sorted(group):
+            if key == "params":
+                continue
+            v = group[key]
+            if isinstance(v, (bool, int, float)):
+                parts.append(torch.tensor([float(v)]))
+            elif torch.is_tensor(v):
+                parts.append(v.detach().float().reshape(-1))
+    for pid in sorted(sd["state"], key=str):
+        for key in sorted(sd["state"][pid]):
+            v = sd["state"][pid][key]
+            if torch.is_tensor(v):
+                parts.append(v.detach().float().reshape(-1))
+            elif isinstance(v, (bool, int, float)):
+                parts.append(torch.tensor([float(v)]))
+    return torch.cat(parts) if parts else torch.zeros(1)
+
+
+def scenario_optimizer_sweep(rank, size):
+    # broadcast_optimizer_state across every torch.optim class except
+    # LBFGS (rejected) and SparseAdam (needs sparse grads), each with and
+    # without a prior step — the breadth of reference test_torch.py
+    # test_broadcast_state (:734-936).  Per-param scalar state (step
+    # counts, ASGD eta/mu, Rprop step sizes) is exactly where the scalar
+    # tensor-ization dance historically broke.
+    sweep = [
+        ("Adadelta", {}),
+        ("Adagrad", {}),
+        ("Adam", {}),
+        ("AdamW", {}),
+        ("Adamax", {}),
+        ("ASGD", {}),
+        ("NAdam", {}),
+        ("RAdam", {}),
+        ("RMSprop", {"momentum": 0.9, "centered": True}),
+        ("Rprop", {}),
+        ("SGD", {"momentum": 0.9, "weight_decay": 1e-4}),
+    ]
+    for cls_name, kwargs in sweep:
+        for prior_step in (False, True):
+            tag = f"{cls_name}.{int(prior_step)}"
+            torch.manual_seed(100 + rank)          # different init per rank
+            model = torch.nn.Linear(3, 2)
+            opt = getattr(torch.optim, cls_name)(
+                model.parameters(), lr=1e-3 * (rank + 1), **kwargs)
+            if prior_step:
+                torch.manual_seed(200 + rank)      # different data per rank
+                model(torch.randn(4, 3)).sum().backward()
+                opt.step()
+                opt.zero_grad()
+            hvd.broadcast_parameters(
+                {f"{tag}.{k}": v for k, v in model.state_dict().items()},
+                root_rank=0)
+            hvd.broadcast_optimizer_state(opt, root_rank=0)
+            assert opt.param_groups[0]["lr"] == 1e-3, (
+                f"{tag}: lr not root's: {opt.param_groups[0]['lr']}")
+            flat = torch.cat(
+                [p.detach().reshape(-1) for p in model.parameters()]
+                + [_flatten_opt_state(opt)])
+            gathered = hvd.allgather(flat.reshape(1, -1),
+                                     name=f"gather.{tag}")
+            for r in range(size):
+                assert torch.allclose(gathered[r], flat, atol=0), (
+                    f"{tag}: rank {rank} state diverged from rank {r}")
+    # LBFGS is explicitly rejected (reference excludes it for the same
+    # non-broadcastable closure-state reason).
+    try:
+        hvd.broadcast_optimizer_state(
+            torch.optim.LBFGS(torch.nn.Linear(2, 2).parameters()), 0)
+        raise AssertionError("LBFGS broadcast should have been rejected")
+    except ValueError:
+        pass
+
+
 def scenario_sparse_first_step(rank, size):
     # THE FIRST STEP: a sparse param whose hook fires on some ranks and not
     # others, with no prior step to have recorded sparsity.  The rank with
@@ -303,6 +383,7 @@ SCENARIOS = {
     "optimizer": scenario_optimizer,
     "state_bcast": scenario_state_bcast,
     "state_bcast_resume": scenario_state_bcast_resume,
+    "optimizer_sweep": scenario_optimizer_sweep,
     "grouped": scenario_grouped,
     "rs_alltoall": scenario_rs_alltoall,
     "sparse": scenario_sparse,
