@@ -46,6 +46,7 @@ def main():
     import horovod_tpu.jax as hvd
     from horovod_tpu.models import LlamaConfig, LlamaModel
     from horovod_tpu.ops.flash_attention import flash_attention
+    from horovod_tpu.ops.losses import softmax_cross_entropy
 
     args = example_args("packed-sequence Llama pretraining", steps=20)
     hvd.init()
@@ -75,17 +76,15 @@ def main():
             attention_fn=lambda q, k, v, *a: flash_attention(
                 q, k, v, causal=True, segment_ids=seg_ids))
         logits = model.apply(params, toks[:, :-1])
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-        tgt = toks[:, 1:]
-        nll = -jnp.take_along_axis(logp, tgt[:, :, None], -1)[..., 0]
         # Mask the loss at document boundaries: a doc's last token must
         # not be trained to predict the NEXT doc's first token (the
         # attention mask blocks cross-doc reads; this blocks cross-doc
-        # targets).
+        # targets).  softmax_cross_entropy (ops/losses.py) computes
+        # lse - target_logit without materializing fp32 log-probs.
         valid = jnp.concatenate(
             [seg_ids[:, 1:] == seg_ids[:, :-1],
              jnp.zeros((toks.shape[0], 1), bool)], axis=1)
-        return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+        return softmax_cross_entropy(logits, toks[:, 1:], where=valid)
 
     params = jax.jit(
         lambda: LlamaModel(cfg).init(jax.random.key(0), tokens[:, :-1]))()

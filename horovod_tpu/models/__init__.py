@@ -12,6 +12,7 @@ from horovod_tpu.models.mnist import MnistConvNet, MnistMLP
 from horovod_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
 from horovod_tpu.models.word2vec import SkipGramModel, nce_loss
 from horovod_tpu.models.bert import BertConfig, BertEncoder, BertForPretraining
+from horovod_tpu.models.generation import decode_step, generate, prefill
 from horovod_tpu.models.llama import LlamaConfig, LlamaModel
 
 __all__ = [
@@ -29,4 +30,7 @@ __all__ = [
     "BertForPretraining",
     "LlamaConfig",
     "LlamaModel",
+    "prefill",
+    "decode_step",
+    "generate",
 ]

@@ -1,0 +1,169 @@
+"""Autoregressive decoding with a KV cache for the Llama family.
+
+No reference equivalent (Horovod 0.15.1 is a training add-on; it serves
+models by exporting plain graphs — docs/inference.md).  This module
+completes the train→serve story for the flagship model: greedy /
+temperature sampling from a ``LlamaModel`` checkpoint with O(1) work per
+generated token instead of re-running the full sequence.
+
+Design (TPU-first):
+* Pure functions over the ``LlamaModel`` parameter pytree — the exact
+  params a train state holds; no module surgery, no separate decode
+  checkpoint format.  Forward math mirrors ``models/llama.py`` (RMSNorm
+  fp32, RoPE on the fly, GQA, SwiGLU) and is pinned to it by a
+  logits-parity test.
+* Static shapes end to end: the KV cache is [L, B, S0+N, Hkv, D] from
+  the start, the decode loop is one ``lax.scan`` over N steps — a single
+  compiled program, no per-step retrace, no dynamic shapes.
+* Prefill computes the prompt's logits and cache in one batched pass
+  (MXU-friendly), then scan steps decode one token at a time.
+
+MoE configs are not supported here (dense decode path only).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.llama import LlamaConfig, apply_rope, rope_freqs
+
+__all__ = ["prefill", "decode_step", "generate"]
+
+
+def _rms(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype)
+
+
+def _attend(q, k, v, *, q_pos, k_len):
+    """q: [B,Sq,Hq,D]; k/v: [B,T,Hkv,D] (cache, only [:k_len] valid).
+    ``q_pos``: [Sq] global positions.  fp32 logits, GQA via grouping."""
+    B, Sq, Hq, D = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    qg = q.reshape(B, Sq, Hkv, Hq // Hkv, D)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    k_pos = jnp.arange(T)
+    mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < k_len)
+    logits = jnp.where(mask[None, None, None], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, Sq, Hq, D)
+
+
+def _layer(cfg: LlamaConfig, lp, x, cache_k, cache_v, *, pos0, k_len):
+    """One decoder layer over x: [B,S,H], writing K/V at [pos0, pos0+S)
+    into this layer's cache [B,T,Hkv,D].  Returns (x, cache_k, cache_v)."""
+    D = cfg.head_dim
+    B, S, _ = x.shape
+    y = _rms(x, lp["norm_attn"]["scale"], cfg.rms_eps)
+    a = lp["attn"]
+    q = (y @ a["wq"]["kernel"].astype(cfg.dtype)).reshape(
+        B, S, cfg.num_heads, D)
+    k = (y @ a["wk"]["kernel"].astype(cfg.dtype)).reshape(
+        B, S, cfg.num_kv_heads, D)
+    v = (y @ a["wv"]["kernel"].astype(cfg.dtype)).reshape(
+        B, S, cfg.num_kv_heads, D)
+    cos, sin = rope_freqs(D, S, cfg.rope_theta, offset=pos0)
+    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, pos0, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, pos0, 0, 0))
+    out = _attend(q, cache_k, cache_v,
+                  q_pos=jnp.arange(S) + pos0, k_len=k_len)
+    x = x + out.reshape(B, S, cfg.num_heads * D) @ \
+        a["wo"]["kernel"].astype(cfg.dtype)
+    y = _rms(x, lp["norm_mlp"]["scale"], cfg.rms_eps)
+    m = lp["mlp"]
+    gate, up = jnp.split(y @ m["w_gate_up"]["kernel"].astype(cfg.dtype), 2,
+                         axis=-1)
+    return x + (jax.nn.silu(gate) * up) @ \
+        m["w_down"]["kernel"].astype(cfg.dtype), cache_k, cache_v
+
+
+def _forward(cfg, p, ids, caches_k, caches_v, *, pos0, k_len):
+    x = jnp.take(p["tok_emb"]["embedding"], ids, axis=0).astype(cfg.dtype)
+    new_k, new_v = [], []
+    for i in range(cfg.num_layers):
+        x, ck, cv = _layer(cfg, p[f"layer_{i}"], x, caches_k[i],
+                           caches_v[i], pos0=pos0, k_len=k_len)
+        new_k.append(ck)
+        new_v.append(cv)
+    x = _rms(x, p["norm_f"]["scale"], cfg.rms_eps)
+    logits = x @ p["lm_head"]["kernel"].astype(jnp.float32)
+    return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def _params(variables):
+    return variables["params"] if "params" in variables else variables
+
+
+def prefill(cfg: LlamaConfig, variables, prompt_ids, *, cache_len: int):
+    """Run the prompt [B, S0] through the model once, returning
+    (last-position logits [B, V], kv_cache) with caches sized
+    ``cache_len`` (>= S0 + tokens to generate)."""
+    if cfg.num_experts > 1:
+        raise NotImplementedError("KV-cache decode supports dense (non-MoE)"
+                                  " configs")
+    p = _params(variables)
+    B, S0 = prompt_ids.shape
+    shape = (cfg.num_layers, B, cache_len, cfg.num_kv_heads, cfg.head_dim)
+    ck = jnp.zeros(shape, cfg.dtype)
+    cv = jnp.zeros(shape, cfg.dtype)
+    logits, ck, cv = _forward(cfg, p, prompt_ids, ck, cv, pos0=0, k_len=S0)
+    return logits[:, -1], (ck, cv)
+
+
+def decode_step(cfg: LlamaConfig, variables, token, cache, *, pos):
+    """One token [B] in, next-position logits [B, V] out; ``pos`` is the
+    token's global position (traced ok)."""
+    p = _params(variables)
+    ck, cv = cache
+    logits, ck, cv = _forward(cfg, p, token[:, None], ck, cv,
+                              pos0=pos, k_len=pos + 1)
+    return logits[:, -1], (ck, cv)
+
+
+def generate(cfg: LlamaConfig, variables, prompt_ids, *,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None):
+    """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, S0].
+
+    ``temperature == 0`` is greedy argmax; otherwise softmax sampling at
+    the given temperature (``rng`` required).  Returns [B, max_new_tokens].
+    Wrap in ``jax.jit`` (static cfg/max_new_tokens) for production use —
+    the loop is a single ``lax.scan``, so it compiles once.
+    """
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    B, S0 = prompt_ids.shape
+    logits, cache = prefill(cfg, variables, prompt_ids,
+                            cache_len=S0 + max_new_tokens)
+
+    def pick(logits, key):
+        if temperature <= 0:
+            return jnp.argmax(logits, -1).astype(prompt_ids.dtype)
+        return jax.random.categorical(
+            key, logits / temperature, -1).astype(prompt_ids.dtype)
+
+    keys = (jax.random.split(rng, max_new_tokens) if rng is not None
+            else jnp.zeros((max_new_tokens, 2), jnp.uint32))
+    tok0 = pick(logits, keys[0] if rng is not None else None)
+
+    def body(carry, key_pos):
+        tok, cache = carry
+        key, pos = key_pos
+        logits, cache = decode_step(cfg, variables, tok, cache, pos=pos)
+        nxt = pick(logits, key if rng is not None else None)
+        return (nxt, cache), nxt  # emit the NEW token
+
+    # Step i consumes the token at global position S0+i and produces the
+    # token for position S0+i+1; tok0 (from prefill) is position S0.
+    (_, _), rest = jax.lax.scan(
+        body, (tok0, cache),
+        (keys[1:], S0 + jnp.arange(max_new_tokens - 1)))
+    return jnp.concatenate([tok0[:, None], rest.T], axis=1)  # [B, N]

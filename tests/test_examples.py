@@ -12,6 +12,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXAMPLES = [
     ("jax_mnist.py", []),
+    ("flax_mnist.py", []),
     ("jax_mnist_estimator.py", []),
     ("flax_mnist_advanced.py", []),
     ("jax_imagenet_resnet50.py", []),

@@ -1,0 +1,91 @@
+"""KV-cache decoding vs the full model: the decode math is a re-derivation
+of models/llama.py, so these tests pin it to the module exactly."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.models import LlamaConfig, LlamaModel
+from horovod_tpu.models.generation import decode_step, generate, prefill
+
+
+def _setup(seed=0, B=2, S0=12):
+    cfg = LlamaConfig.tiny()
+    model = LlamaModel(cfg)
+    ids = jax.random.randint(jax.random.key(seed), (B, S0), 0,
+                             cfg.vocab_size)
+    variables = model.init(jax.random.key(1), ids)
+    return cfg, model, variables, ids
+
+
+def test_prefill_matches_model_logits():
+    cfg, model, variables, ids = _setup()
+    want = model.apply(variables, ids)[:, -1]
+    got, _ = prefill(cfg, variables, ids, cache_len=ids.shape[1] + 4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_cached_decode_matches_full_recompute():
+    """The cached decode stream's logits equal re-running the full model
+    on the growing sequence at EVERY step, under an identical (teacher-
+    forced) token history.  Logit comparison, not argmax-sequence
+    comparison: bf16 compute makes near-tied logits flip argmax between
+    the two numerically-different-but-equivalent schedules, which says
+    nothing about cache correctness."""
+    cfg, model, variables, ids = _setup(seed=3)
+    N = 6
+    S0 = ids.shape[1]
+
+    cached_logits, cache = prefill(cfg, variables, ids, cache_len=S0 + N)
+    seq = ids
+    for i in range(N):
+        full_logits = model.apply(variables, seq)[:, -1]
+        np.testing.assert_allclose(
+            np.asarray(cached_logits), np.asarray(full_logits),
+            atol=5e-5, rtol=5e-5, err_msg=f"step {i}")
+        nxt = jnp.argmax(full_logits, -1).astype(ids.dtype)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        cached_logits, cache = decode_step(cfg, variables, nxt, cache,
+                                           pos=S0 + i)
+
+
+def test_decode_step_positions():
+    """decode_step at position p must see exactly the first p cache slots
+    plus itself (mask correctness at the cache boundary)."""
+    cfg, model, variables, ids = _setup(seed=5)
+    S0 = ids.shape[1]
+    logits, cache = prefill(cfg, variables, ids, cache_len=S0 + 3)
+    tok = jnp.argmax(logits, -1).astype(ids.dtype)
+    step_logits, _ = decode_step(cfg, variables, tok, cache, pos=S0)
+    full = model.apply(
+        variables, jnp.concatenate([ids, tok[:, None]], 1))[:, -1]
+    np.testing.assert_allclose(np.asarray(step_logits), np.asarray(full),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_generate_jits_and_samples():
+    cfg, model, variables, ids = _setup(seed=7)
+    gen = jax.jit(functools.partial(generate, cfg, max_new_tokens=5,
+                                    temperature=0.8),
+                  static_argnames=())
+    out = gen(variables, ids, rng=jax.random.key(11))
+    assert out.shape == (ids.shape[0], 5)
+    assert (np.asarray(out) >= 0).all() and \
+        (np.asarray(out) < cfg.vocab_size).all()
+    # Same key -> same sample (deterministic compiled program).
+    out2 = gen(variables, ids, rng=jax.random.key(11))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_generate_rejects_moe_and_missing_rng():
+    import pytest
+
+    cfg, model, variables, ids = _setup()
+    with pytest.raises(ValueError, match="rng"):
+        generate(cfg, variables, ids, max_new_tokens=2, temperature=1.0)
+    moe_cfg = LlamaConfig.tiny(num_experts=4)
+    with pytest.raises(NotImplementedError):
+        prefill(moe_cfg, variables, ids, cache_len=16)
