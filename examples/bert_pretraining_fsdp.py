@@ -22,6 +22,7 @@ import optax
 import horovod_tpu.jax as hvd
 from examples.common import example_args
 from horovod_tpu.models import BertConfig, BertForPretraining
+from horovod_tpu.ops.losses import softmax_cross_entropy
 from horovod_tpu.parallel.api import shard_params
 
 
@@ -63,13 +64,10 @@ def main():
         mlm_logits, nsp_logits = model.apply(params, input_ids,
                                              attention_mask=attn_mask,
                                              train=False)
-        logp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), -1)
-        mlm_nll = -jnp.take_along_axis(logp, mlm_labels[..., None], -1)
-        mlm_loss = jnp.sum(mlm_nll[..., 0] * mask_positions) / \
-            jnp.maximum(jnp.sum(mask_positions), 1.0)
-        nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), -1)
-        nsp_loss = -jnp.mean(
-            jnp.take_along_axis(nsp_logp, nsp_labels[:, None], -1))
+        # lse-form CE (ops/losses.py): no [B,S,V] fp32 log-prob tensor.
+        mlm_loss = softmax_cross_entropy(mlm_logits, mlm_labels,
+                                         where=mask_positions.astype(bool))
+        nsp_loss = softmax_cross_entropy(nsp_logits, nsp_labels)
         return mlm_loss + nsp_loss
 
     from jax.sharding import NamedSharding, PartitionSpec as P

@@ -45,6 +45,9 @@ class LlamaConfig:
     num_experts: int = 1          # >1 enables MoE
     experts_per_token: int = 2
     dtype: Any = jnp.bfloat16
+    # Fused Pallas RMSNorm (see RMSNorm.fused): enable on shard_map /
+    # single-device paths; leave off under GSPMD.
+    fused_rmsnorm: bool = False
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -68,10 +71,22 @@ class LlamaConfig:
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # Fused Pallas kernel (ops/rms_norm.py).  Opt-in twice over: (a)
+    # pallas_call cannot lower under non-Manual mesh axes, so it must
+    # stay off for GSPMD (plain jit + sharded params) paths — shard_map
+    # paths (make_train_step, ring attention, pipeline) are safe; (b) on
+    # the 400M bench config it measured only ~0.5% end-to-end (XLA's norm
+    # fusions were already fused with neighboring converts/residuals, and
+    # the kernel boundary forfeits that), so the default stays off.
+    fused: bool = False
 
     @nn.compact
     def __call__(self, x):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        if self.fused:
+            from horovod_tpu.ops.rms_norm import rms_norm
+
+            return rms_norm(x, scale, eps=self.eps, out_dtype=self.dtype)
         x32 = x.astype(jnp.float32)
         x32 = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1,
                                            keepdims=True) + self.eps)
@@ -201,10 +216,12 @@ class LlamaLayer(nn.Module):
     @nn.compact
     def __call__(self, x, cos, sin):
         cfg = self.config
-        y = RMSNorm(cfg.rms_eps, cfg.dtype, name="norm_attn")(x)
+        y = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.fused_rmsnorm,
+                    name="norm_attn")(x)
         x = x + LlamaAttention(cfg, attention_fn=self.attention_fn,
                                name="attn")(y, cos, sin)
-        y = RMSNorm(cfg.rms_eps, cfg.dtype, name="norm_mlp")(x)
+        y = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.fused_rmsnorm,
+                    name="norm_mlp")(x)
         if cfg.num_experts > 1:
             x = x + MoEBlock(cfg, name="moe")(y)
         else:
@@ -227,7 +244,8 @@ class LlamaModel(nn.Module):
         for i in range(cfg.num_layers):
             x = LlamaLayer(cfg, attention_fn=self.attention_fn,
                            name=f"layer_{i}")(x, cos, sin)
-        x = RMSNorm(cfg.rms_eps, cfg.dtype, name="norm_f")(x)
+        x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.fused_rmsnorm,
+                    name="norm_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           name="lm_head")(x)
         return logits

@@ -1,0 +1,176 @@
+"""Fused RMSNorm as a Pallas TPU kernel (forward + backward).
+
+Why: profiling the Llama train step (docs/perf-notes.md methodology)
+shows XLA's RMSNorm-backward fusions running ~13x slower than HBM
+bandwidth — the fp32 statistics math over (2,1)-tiled bf16 activations is
+VPU/layout-bound, costing ~6% of the step on the 400M bench config.  A
+fused kernel does each pass in one read: forward computes the row rstd
+and the normalized output together (saving rstd for backward), backward
+recomputes x̂ from the saved rstd and produces dx plus a per-rowblock
+partial dscale in the same pass.
+
+Measured caveat (why ``LlamaConfig.fused_rmsnorm`` defaults OFF): on the
+400M bench config the end-to-end win was only ~0.5% — XLA had already
+fused the norms with neighboring converts/residual adds, and the pallas
+kernel boundary forfeits that merging.  It remains available for configs
+where the norm is a measured bottleneck; benchmark before enabling.
+
+Matches ``models/llama.py:RMSNorm`` math exactly: statistics in fp32,
+output cast to the compute dtype, scale applied in fp32.
+
+Layout: x is [R, H] (callers flatten leading dims); H must be a multiple
+of 128 and is kept whole in the minor dim (H = 1024-8192 fits VMEM
+comfortably at the 256-row blocks used here).  Falls back to plain XLA
+math for off-tile shapes or non-TPU backends at equal semantics; tests
+pass ``use_kernel=True`` to exercise the kernel logic on CPU via the
+Pallas interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["rms_norm"]
+
+_BLOCK_R = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(x_ref, scale_ref, y_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)                     # [bR, H]
+    rstd = jax.lax.rsqrt(jnp.mean(x * x, axis=1, keepdims=True) + eps)
+    y = x * rstd * scale_ref[:].astype(jnp.float32)[None, :]
+    y_ref[:] = y.astype(y_ref.dtype)
+    # [bR] row statistics, sublane-replicated to the (8, 128) tile.
+    rstd_ref[:] = jnp.broadcast_to(rstd.T, (8, x.shape[0]))
+
+
+def _bwd_kernel(x_ref, scale_ref, rstd_ref, dy_ref, dx_ref, dscale_ref):
+    # (eps is not needed here: the derivative is exact through the saved
+    # rstd.)
+    x = x_ref[:].astype(jnp.float32)
+    dy = dy_ref[:].astype(jnp.float32)
+    scale = scale_ref[:].astype(jnp.float32)[None, :]
+    rstd = rstd_ref[0, :][:, None]                       # [bR, 1]
+    xhat = x * rstd
+    dys = dy * scale
+    # d/dx of mean-square rstd: dx = rstd*(dys - xhat*mean_H(dys*xhat)).
+    m = jnp.mean(dys * xhat, axis=1, keepdims=True)
+    dx_ref[:] = (rstd * (dys - xhat * m)).astype(dx_ref.dtype)
+    # Per-rowblock partial, sublane-replicated to the (8, 128) tile; the
+    # caller reads one replica per block.
+    part = jnp.sum(dy * xhat, axis=0)
+    dscale_ref[:] = jnp.broadcast_to(part[None, :], (8, part.shape[0]))
+
+
+def _rows_ok(R: int, H: int) -> int:
+    for b in (_BLOCK_R, 128, 64, 32, 16, 8):
+        if R % b == 0:
+            return b
+    return 0
+
+
+def _supported(R: int, H: int) -> bool:
+    return H % 128 == 0 and _rows_ok(R, H) > 0
+
+
+def _reference(x, scale, eps, dtype):
+    x32 = x.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (x32 * rstd * scale.astype(jnp.float32)).astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms(x, scale, eps, out_dtype):
+    y, _ = _rms_fwd_impl(x, scale, eps, out_dtype)
+    return y
+
+
+def _rms_fwd_impl(x, scale, eps, out_dtype):
+    R, H = x.shape
+    bR = _rows_ok(R, H)
+    y, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(R // bR,),
+        in_specs=[
+            pl.BlockSpec((bR, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bR, H), lambda i: (i, 0)),
+            pl.BlockSpec((8, bR), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), out_dtype),
+            jax.ShapeDtypeStruct((8, R), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, scale)
+    return y, rstd
+
+
+def _rms_fwd(x, scale, eps, out_dtype):
+    y, rstd = _rms_fwd_impl(x, scale, eps, out_dtype)
+    return y, (x, scale, rstd)
+
+
+def _rms_bwd(eps, out_dtype, res, dy):
+    x, scale, rstd = res
+    R, H = x.shape
+    bR = _rows_ok(R, H)
+    dx, dscale_parts = pl.pallas_call(
+        _bwd_kernel,
+        grid=(R // bR,),
+        in_specs=[
+            pl.BlockSpec((bR, H), lambda i: (i, 0)),
+            pl.BlockSpec((H,), lambda i: (0,)),
+            pl.BlockSpec((8, bR), lambda i: (0, i)),
+            pl.BlockSpec((bR, H), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bR, H), lambda i: (i, 0)),
+            pl.BlockSpec((8, H), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, H), x.dtype),
+            jax.ShapeDtypeStruct((R // bR * 8, H), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, scale, rstd, dy)
+    dscale = jnp.sum(
+        dscale_parts.reshape(R // bR, 8, H)[:, 0, :], axis=0)
+    return dx, dscale.astype(scale.dtype)
+
+
+_rms.defvjp(_rms_fwd, _rms_bwd)
+
+
+def rms_norm(x, scale, *, eps: float = 1e-5, out_dtype=None,
+             use_kernel: bool | None = None):
+    """RMS-normalize ``x`` over its last dim and multiply by ``scale``.
+
+    ``x``: [..., H] (any leading dims); ``scale``: [H].  Statistics in
+    fp32; output in ``out_dtype`` (default: ``x.dtype``).  Uses the fused
+    Pallas kernel on TPU when H is a multiple of 128; plain XLA math
+    (identical semantics) otherwise.  ``use_kernel=True`` forces the
+    kernel — off-TPU that means the (slow) Pallas interpreter, which the
+    tests use to exercise the kernel logic on CPU."""
+    out_dtype = out_dtype or x.dtype
+    H = x.shape[-1]
+    lead = x.shape[:-1]
+    R = 1
+    for d in lead:
+        R *= d
+    if use_kernel is None:
+        use_kernel = not _interpret()
+    if not use_kernel or not _supported(R, H):
+        return _reference(x, scale, eps, out_dtype)
+    y = _rms(x.reshape(R, H), scale, eps, out_dtype)
+    return y.reshape(*lead, H)
