@@ -20,13 +20,18 @@ import jax.numpy as jnp
 __all__ = ["softmax_cross_entropy"]
 
 
-def softmax_cross_entropy(logits, targets, *, where=None):
-    """Mean token cross-entropy from (possibly bf16) logits.
+def softmax_cross_entropy(logits, targets, *, where=None,
+                          reduction: str = "mean"):
+    """Token cross-entropy from (possibly bf16) logits.
 
     ``logits``: [..., V]; ``targets``: integer [...]; ``where``: optional
     boolean [...] mask of tokens to include (packing/padding).  Returns a
-    scalar fp32 mean over the selected tokens.
+    scalar fp32 ``reduction`` ("mean" over selected tokens, or "sum" —
+    the form sharded losses need when the mean denominator is the GLOBAL
+    token count psummed outside).
     """
+    if reduction not in ("mean", "sum"):
+        raise ValueError(f"unknown reduction {reduction!r}")
     logits32 = logits.astype(jnp.float32)
     lse = jax.nn.logsumexp(logits32, axis=-1)
     tgt = jnp.take_along_axis(
@@ -34,5 +39,8 @@ def softmax_cross_entropy(logits, targets, *, where=None):
     nll = lse - tgt
     if where is not None:
         nll = jnp.where(where, nll, 0.0)
+    if reduction == "sum":
+        return jnp.sum(nll)
+    if where is not None:
         return jnp.sum(nll) / jnp.maximum(jnp.sum(where), 1)
     return jnp.mean(nll)

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from horovod_tpu.ops.losses import softmax_cross_entropy
+
 __all__ = [
     "SHARDING_RULES",
     "infer_param_spec",
@@ -113,9 +115,8 @@ def lm_loss_fn(model) -> Callable:
     def loss_fn(params, tokens):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         logits = model.apply(params, inputs)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return jnp.mean(nll)
+        # lse-form CE (ops/losses.py): no [B,S,V] fp32 log-prob tensor.
+        return softmax_cross_entropy(logits, targets)
 
     return loss_fn
 

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.ops.losses import softmax_cross_entropy
+
 __all__ = [
     "pipeline_apply",
     "stack_pytrees",
@@ -188,9 +190,8 @@ def make_pipelined_llama_train_step(cfg, optimizer, mesh, *,
             jnp.mean(h32 * h32, axis=-1, keepdims=True) + cfg.rms_eps)
         h = (h32 * rest["norm_f"]["scale"]).astype(cfg.dtype)
         logits = (h @ rest["lm_head"]["kernel"]).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return jnp.sum(nll)
+        # Local sum in lse form (no fp32 log-prob tensor).
+        return softmax_cross_entropy(logits, targets, reduction="sum")
 
     def _grads(stages_sharded, rest, inputs, targets):
         stages = jax.tree.map(lambda a: a[0], stages_sharded)

@@ -20,6 +20,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from horovod_tpu.models.llama import LlamaConfig, LlamaModel
+from horovod_tpu.ops.losses import softmax_cross_entropy
 from horovod_tpu.parallel.ring_attention import make_ring_attention_fn
 
 __all__ = ["make_context_parallel_train_step"]
@@ -69,11 +70,10 @@ def make_context_parallel_train_step(cfg: LlamaConfig, optimizer,
     def _local_loss(params, inputs, targets):
         offset = lax.axis_index(seq_axis) * inputs.shape[1]
         logits = model.apply(params, inputs, positions_offset=offset)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        # Local *sum*; the mean denominator is the global token count so
-        # the psum over data+seq axes reconstructs the global mean.
-        return jnp.sum(nll)
+        # Local *sum* in lse form (no fp32 log-prob tensor); the mean
+        # denominator is the global token count so the psum over
+        # data+seq axes reconstructs the global mean.
+        return softmax_cross_entropy(logits, targets, reduction="sum")
 
     def _step(params, opt_state, inputs, targets):
         n_global = (inputs.shape[0] * lax.axis_size(batch_axes)

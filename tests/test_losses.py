@@ -66,3 +66,14 @@ def test_all_masked_returns_zero():
     logits, targets = _data(seed=4)
     where = jnp.zeros_like(targets, bool)
     assert float(softmax_cross_entropy(logits, targets, where=where)) == 0.0
+
+
+def test_sum_reduction():
+    logits, targets = _data(seed=5)
+    got = softmax_cross_entropy(logits, targets, reduction="sum")
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    want = jnp.sum(-jnp.take_along_axis(logp, targets[..., None], -1))
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+    import pytest
+    with pytest.raises(ValueError, match="reduction"):
+        softmax_cross_entropy(logits, targets, reduction="nope")
