@@ -631,7 +631,6 @@ bool Engine::RunLoopOnce() {
     for (auto& q : my_list.requests) {
       timeline_.NegotiateStart(q.tensor_name);
       timeline_.NegotiateRankReady(q.tensor_name, 0);
-      std::lock_guard<std::mutex> lk(mu_);
       auto& info = message_table_[q.tensor_name];
       info.requests.assign(1, q);
       info.seen.assign(1, true);
@@ -807,9 +806,9 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
 // collective behavior — the reference's most important failure-containment
 // feature (operations.cc:315-517).
 Response Engine::BuildResponse(const std::string& name) {
+  // message_table_ is background-thread-only (see engine.h); no lock.
   PendingInfo info;
   {
-    std::lock_guard<std::mutex> lk(mu_);
     auto it = message_table_.find(name);
     info = std::move(it->second);
     message_table_.erase(it);
@@ -1536,7 +1535,7 @@ void Engine::CheckForStalledTensors() {
     return;
   }
   last_stall_check_ = now;
-  std::lock_guard<std::mutex> lk(mu_);
+  // message_table_ is background-thread-only (see engine.h); no lock.
   bool preamble = false;
   for (auto& kv : message_table_) {
     auto age = std::chrono::duration_cast<std::chrono::seconds>(

@@ -168,13 +168,17 @@ class Engine {
   std::condition_variable handle_cv_;
   std::atomic<int64_t> next_handle_{0};
 
-  // -- coordinator state (rank 0 only) --
+  // -- coordinator state (rank 0 only; background-thread-only, NOT mu_) --
   struct PendingInfo {
     std::vector<Request> requests;        // one per reporting rank
     std::vector<bool> seen;               // which ranks reported
     int count = 0;
     std::chrono::steady_clock::time_point first_seen;
   };
+  // Owned exclusively by the background thread (RunLoopOnce and the
+  // functions it calls: CoordinatorStep, BuildResponse,
+  // CheckForStalledTensors).  Not guarded by mu_ — never touch it from
+  // an API thread.
   std::unordered_map<std::string, PendingInfo> message_table_;
   std::chrono::steady_clock::time_point last_stall_check_;
 

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import functools
 import math
+import threading
 import warnings
 
 import jax
@@ -49,19 +50,24 @@ __all__ = ["flash_attention", "flash_attention_fn", "fallback_count"]
 # kernel tiling should not silently lose the kernel's speedup.  Each
 # distinct reason warns once per process; the counter counts every
 # fallback TRACE (not execution — under jit the choice is made at trace
-# time).
+# time).  Guarded by a lock: jax tracing can run on multiple threads.
 _fallbacks: dict = {}
+_fallbacks_lock = threading.Lock()
 
 
 def fallback_count() -> int:
     """Number of times flash_attention has fallen back to the XLA dense
-    path at trace time (all reasons combined)."""
-    return sum(_fallbacks.values())
+    path at trace time, summed over every reason and call site in this
+    process (the counter is process-global, incremented once per traced
+    fallback, not per kernel execution)."""
+    with _fallbacks_lock:
+        return sum(_fallbacks.values())
 
 
 def _note_fallback(reason: str) -> None:
-    first = reason not in _fallbacks
-    _fallbacks[reason] = _fallbacks.get(reason, 0) + 1
+    with _fallbacks_lock:
+        first = reason not in _fallbacks
+        _fallbacks[reason] = _fallbacks.get(reason, 0) + 1
     if first:
         warnings.warn(
             "flash_attention falling back to the XLA dense path: " + reason,

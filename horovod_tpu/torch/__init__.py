@@ -86,10 +86,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if named_parameters is not None:
             named_parameters = list(named_parameters)
         else:
+            # Single running counter across param groups: per-group
+            # numbering would hand two groups the same synthesized name,
+            # and names are load-bearing for collective rendezvous.
             named_parameters = [
                 (f"allreduce.noname.{i}", v)
-                for param_group in self.param_groups
-                for i, v in enumerate(param_group["params"])
+                for i, v in enumerate(
+                    v for param_group in self.param_groups
+                    for v in param_group["params"])
             ]
         # Sanity checks mirroring the reference (torch/__init__.py:41-67).
         all_params = {

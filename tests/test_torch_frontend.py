@@ -203,3 +203,15 @@ def test_torch_jax_bridge_roundtrip():
     # dtypes dlpack may refuse still work via the copy fallback
     b = torch.tensor([True, False, True])
     assert bool(bridge.from_jax(bridge.to_jax(b))[0]) is True
+
+
+def test_unnamed_fallback_names_unique_across_param_groups():
+    """Synthesized fallback names must be unique across param GROUPS —
+    a per-group counter would hand two groups 'allreduce.noname.0' and
+    collide in the collective rendezvous."""
+    a = torch.nn.Parameter(torch.randn(2))
+    b = torch.nn.Parameter(torch.randn(3))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([{"params": [a]}, {"params": [b]}], lr=0.1))
+    names = list(opt._param_names.values())
+    assert len(names) == len(set(names)) == 2
