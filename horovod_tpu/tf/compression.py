@@ -1,0 +1,68 @@
+"""Gradient compression for the TensorFlow frontend.
+
+Reference parity: ``horovod/tensorflow/compression.py`` (74 LoC) — a
+``Compressor`` interface with ``none``/``fp16`` members; compress casts
+floats down for the wire, decompress casts back.  Adds ``bf16``: on the
+host data plane bf16 halves wire bytes with float32's exponent range, and
+it round-trips exactly through the TPU compute dtype.
+"""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+__all__ = ["Compressor", "NoneCompressor", "FP16Compressor",
+           "BF16Compressor", "Compression"]
+
+
+class Compressor:
+    """Interface for compressing and decompressing a given tensor."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, ctx) where ctx feeds decompress."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: tf.DType
+
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating and tensor.dtype != cls.wire_dtype:
+            return tf.cast(tensor, cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = tf.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = tf.bfloat16
+
+
+class Compression:
+    """Registry (reference compression.py:67-74)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -30,7 +30,8 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def run_workers(n, scenario, extra_env=None, timeout=90, expected_rc=None):
+def run_workers(n, scenario, extra_env=None, timeout=90, expected_rc=None,
+                worker=None):
     _ensure_lib()
     port = _free_port()
     procs = []
@@ -45,10 +46,17 @@ def run_workers(n, scenario, extra_env=None, timeout=90, expected_rc=None):
         })
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
-            [sys.executable, WORKER, scenario],
+            [sys.executable, worker or WORKER, scenario],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         ))
-    results = [p.communicate(timeout=timeout) for p in procs]
+    try:
+        results = [p.communicate(timeout=timeout) for p in procs]
+    finally:
+        # A hung rank must not leak live workers holding the port.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     expected_rc = expected_rc or {}
     for rank, (p, (out, err)) in enumerate(zip(procs, results)):
         want = expected_rc.get(rank, 0)

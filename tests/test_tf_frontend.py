@@ -1,0 +1,145 @@
+"""TensorFlow frontend tests, size-1 (multi-process coverage lives in
+tests/tf_worker.py via test_tf_multiproc.py).
+
+Mirrors the reference matrix (test/test_tensorflow.py): op identity,
+gradients through collectives, IndexedSlices, compression, optimizer
+wrappers — at size 1, where every collective degrades to the arithmetic
+identity, exactly as the reference behaves under ``mpirun -np 1``.
+"""
+
+import numpy as np
+import pytest
+import tensorflow as tf
+
+import horovod_tpu.tf as hvd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    hvd.init()
+
+
+def test_rank_size():
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+
+
+def test_allreduce_identity_size1():
+    x = tf.constant([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(hvd.allreduce(x).numpy(), x.numpy())
+    np.testing.assert_allclose(
+        hvd.allreduce(x, average=False).numpy(), x.numpy())
+
+
+def test_allreduce_int_average_floordiv():
+    x = tf.constant([4, 8])
+    out = hvd.allreduce(x, average=True)
+    assert out.dtype == tf.int32
+    np.testing.assert_array_equal(out.numpy(), [4, 8])
+
+
+def test_allgather_size1():
+    x = tf.reshape(tf.range(6, dtype=tf.float32), (2, 3))
+    np.testing.assert_allclose(hvd.allgather(x).numpy(), x.numpy())
+    # scalars gather to shape [size]
+    s = hvd.allgather(tf.constant(7.0))
+    assert s.shape == (1,)
+
+
+def test_broadcast_size1_and_rank_check():
+    x = tf.constant([1.0, 2.0])
+    np.testing.assert_allclose(hvd.broadcast(x, 0).numpy(), x.numpy())
+    with pytest.raises(ValueError):
+        hvd.broadcast(x, root_rank=5)
+
+
+def test_gradients_through_collectives():
+    v = tf.Variable([1.0, 2.0])
+    with tf.GradientTape() as t:
+        y = tf.reduce_sum(hvd.allreduce(v, average=False))
+    np.testing.assert_allclose(t.gradient(y, [v])[0].numpy(), [1.0, 1.0])
+
+    with tf.GradientTape() as t:
+        y = tf.reduce_sum(hvd.allgather(v))
+    np.testing.assert_allclose(t.gradient(y, [v])[0].numpy(), [1.0, 1.0])
+
+    with tf.GradientTape() as t:
+        y = tf.reduce_sum(hvd.broadcast(v, 0))
+    np.testing.assert_allclose(t.gradient(y, [v])[0].numpy(), [1.0, 1.0])
+
+
+def test_scalar_allgather_grad():
+    v = tf.Variable(3.0)
+    with tf.GradientTape() as t:
+        y = tf.reduce_sum(hvd.allgather(v))
+    (g,) = t.gradient(y, [v])
+    assert g.shape == ()
+    np.testing.assert_allclose(g.numpy(), 1.0)
+
+
+def test_tf_function_traced_path():
+    @tf.function
+    def step(z):
+        return hvd.allreduce(z, average=False, name="t_ar")
+
+    x = tf.constant([3.0, 4.0])
+    for _ in range(2):
+        np.testing.assert_allclose(step(x).numpy(), x.numpy())
+
+
+def test_indexed_slices_allreduce():
+    sl = tf.IndexedSlices(tf.ones((2, 4)), tf.constant([1, 3]),
+                          tf.constant([8, 4]))
+    red = hvd.allreduce(sl)
+    assert isinstance(red, tf.IndexedSlices)
+    np.testing.assert_allclose(red.values.numpy(), np.ones((2, 4)))
+    np.testing.assert_array_equal(red.indices.numpy(), [1, 3])
+
+
+def test_fp16_compression_roundtrip():
+    x = tf.constant([0.5, 1.5, -2.25])
+    out = hvd.allreduce(x, compression=hvd.Compression.fp16)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-2)
+
+
+def test_bf16_tensor_allreduce():
+    x = tf.ones(4, dtype=tf.bfloat16)
+    out = hvd.allreduce(x)
+    assert out.dtype == tf.bfloat16
+    np.testing.assert_allclose(tf.cast(out, tf.float32).numpy(), 1.0)
+
+
+def test_broadcast_variables():
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([[3.0]])
+    hvd.broadcast_variables([v1, v2], root_rank=0)
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+
+
+def test_distributed_gradient_tape_matches_plain():
+    v = tf.Variable([1.0, 3.0])
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        y = tf.reduce_sum(v * v)
+    (g,) = tape.gradient(y, [v])
+    np.testing.assert_allclose(g.numpy(), [2.0, 6.0])
+
+
+def test_create_distributed_optimizer_applies_and_roundtrips():
+    opt = hvd.create_distributed_optimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.5))
+    assert type(opt).__name__ == "DistributedSGD"
+    w = tf.Variable([2.0])
+    opt.apply_gradients([(tf.constant([1.0]), w)])
+    np.testing.assert_allclose(w.numpy(), [1.5])
+    # config round-trip (load_model reconstruction path)
+    clone = type(opt).from_config(opt.get_config())
+    assert clone.learning_rate.numpy() == pytest.approx(0.5)
+
+
+def test_distributed_optimizer_wraps_v1():
+    opt = hvd.DistributedOptimizer(
+        tf.compat.v1.train.GradientDescentOptimizer(0.1))
+    assert opt.get_slot_names() == []

@@ -1,0 +1,41 @@
+"""Multi-process TensorFlow frontend tests (reference: test_tensorflow.py
+under ``mpirun -np 2``; scenarios live in tests/tf_worker.py)."""
+
+import os
+
+import pytest
+
+from tests.test_native_engine import run_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "tf_worker.py")
+
+
+def run_tf_workers(n, scenario, timeout=240):
+    run_workers(n, scenario, timeout=timeout, worker=WORKER,
+                extra_env={"CUDA_VISIBLE_DEVICES": "-1"})
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_tf_ops(n):
+    run_tf_workers(n, "ops")
+
+
+def test_tf_gradients():
+    run_tf_workers(2, "grads")
+
+
+def test_tf_mismatch_errors():
+    run_tf_workers(2, "errors")
+
+
+def test_tf_sparse_indexed_slices():
+    run_tf_workers(2, "sparse")
+
+
+def test_tf_keras_training_loop_equalizes():
+    run_tf_workers(2, "keras_loop")
+
+
+def test_tf_v1_session_hook_and_optimizer():
+    run_tf_workers(2, "v1_session")

@@ -1,43 +1,18 @@
 """Multi-process torch frontend tests (reference: test_torch.py under
 ``mpirun -np 2``)."""
 
+import os
+
 import pytest
 
 from tests.test_native_engine import run_workers as _run_native
-
-import os
-import subprocess
-import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "torch_worker.py")
 
 
 def run_torch_workers(n, scenario, timeout=180):
-    from tests.test_native_engine import _ensure_lib, _free_port
-
-    _ensure_lib()
-    port = _free_port()
-    procs = []
-    for rank in range(n):
-        env = dict(os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(n),
-            "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
-            "HOROVOD_CYCLE_TIME": "2",
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER, scenario],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        ))
-    results = [p.communicate(timeout=timeout) for p in procs]
-    for rank, (p, (out, err)) in enumerate(zip(procs, results)):
-        assert p.returncode == 0, (
-            f"rank {rank} failed (rc={p.returncode}):\n"
-            f"stdout: {out.decode()}\nstderr: {err.decode()}"
-        )
+    _run_native(n, scenario, timeout=timeout, worker=WORKER)
 
 
 @pytest.mark.parametrize("n", [2, 3])

@@ -1,0 +1,242 @@
+"""Multi-process TensorFlow-frontend worker (launched by
+test_tf_multiproc.py; identity via HOROVOD_RANK/SIZE/COORDINATOR env).
+
+Mirrors the reference matrix (test/test_tensorflow.py:56-625): allreduce
+identity/average, cross-rank mismatch errors, gradient checks for all
+three ops, ragged allgather, per-root broadcast, IndexedSlices, plus the
+TF2 training loop and the v1 Session + hook path.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tf as hvd  # noqa: E402
+
+
+def scenario_ops(rank, size):
+    # allreduce sum / average (reference test_horovod_allreduce_cpu).
+    x = tf.fill([6, 2], float(rank + 1))
+    out = hvd.allreduce(x, average=False)
+    np.testing.assert_allclose(out.numpy(), size * (size + 1) / 2)
+    out = hvd.allreduce(tf.fill([4], float(rank)), average=True)
+    np.testing.assert_allclose(out.numpy(), (size - 1) / 2.0)
+
+    # same op under tf.function (the reference's graph-mode execution).
+    @tf.function
+    def traced(t):
+        return hvd.allreduce(t, average=False, name="traced_ar")
+
+    for _ in range(2):  # two steps: the traced name must be reusable
+        out = traced(tf.fill([3], float(rank + 1)))
+        np.testing.assert_allclose(out.numpy(), size * (size + 1) / 2)
+
+    # ragged allgather (test_horovod_allgather_variable_size).
+    g = tf.fill([rank + 1, 3], float(rank))
+    gat = hvd.allgather(g)
+    assert gat.shape[0] == size * (size + 1) // 2, gat.shape
+    off = 0
+    for r in range(size):
+        np.testing.assert_allclose(gat[off:off + r + 1].numpy(), float(r))
+        off += r + 1
+
+    # broadcast from every root (test_horovod_broadcast).
+    for root in range(size):
+        b = tf.range(5, dtype=tf.float32) * (rank + 1)
+        out = hvd.broadcast(b, root_rank=root, name=f"bcast_root{root}")
+        np.testing.assert_allclose(
+            out.numpy(), np.arange(5, dtype=np.float32) * (root + 1))
+
+    # int allreduce.
+    out = hvd.allreduce(tf.constant([rank, 2 * rank]), average=False)
+    s = size * (size - 1) // 2
+    np.testing.assert_array_equal(out.numpy(), [s, 2 * s])
+
+
+def scenario_grads(rank, size):
+    # allreduce grad = ones * size (test_horovod_allreduce_grad).
+    v = tf.Variable(tf.random.uniform([5, 5], -100, 100))
+    with tf.GradientTape() as t:
+        y = tf.reduce_sum(hvd.allreduce(v, average=False, name="ar_g"))
+    (grad,) = t.gradient(y, [v])
+    np.testing.assert_allclose(grad.numpy(), float(size))
+
+    # allgather grad: ragged, rank-valued upstream grads -> own slice of
+    # the allreduced concat = rank * size (test_horovod_allgather_grad).
+    sizes = [3, 2, 7, 4, 6, 8, 10][:size]
+    v = tf.Variable(tf.ones([sizes[rank], 17]) * rank)
+    grad_ys = tf.concat([tf.ones([s, 17]) * r
+                         for r, s in enumerate(sizes)], axis=0)
+    with tf.GradientTape() as t:
+        gathered = hvd.allgather(v, name="ag_g")
+    (grad,) = t.gradient(gathered, [v], output_gradients=grad_ys)
+    np.testing.assert_allclose(grad.numpy(), float(rank * size))
+
+    # broadcast grad: allreduce, zeroed off-root
+    # (test_horovod_broadcast_grad).
+    root = size - 1
+    v = tf.Variable(tf.ones([5]) * rank)
+    with tf.GradientTape() as t:
+        y = tf.reduce_sum(hvd.broadcast(v, root, name="bc_g"))
+    (grad,) = t.gradient(y, [v])
+    expected = float(size) if rank == root else 0.0
+    np.testing.assert_allclose(grad.numpy(), expected)
+
+
+def scenario_errors(rank, size):
+    # Cross-rank shape mismatch must raise a descriptive error on EVERY
+    # rank, not hang or corrupt (test_horovod_allreduce_error).
+    try:
+        hvd.allreduce(tf.ones([rank + 2, 3]), average=False, name="bad_shape")
+        raise SystemExit("expected a shape-mismatch error")
+    except Exception as e:  # InternalError wrapping the engine message
+        assert "shape" in str(e).lower(), e
+    # dtype mismatch (test_horovod_allreduce_type_error).
+    try:
+        t = (tf.ones([4], dtype=tf.float32) if rank == 0
+             else tf.ones([4], dtype=tf.float64))
+        hvd.allreduce(t, average=False, name="bad_dtype")
+        raise SystemExit("expected a dtype-mismatch error")
+    except Exception as e:
+        assert "type" in str(e).lower() or "dtype" in str(e).lower(), e
+    # broadcast root mismatch (test_horovod_broadcast_rank_error).
+    try:
+        hvd.broadcast(tf.ones([4]), root_rank=rank, name="bad_root")
+        raise SystemExit("expected a root-mismatch error")
+    except Exception as e:
+        assert "root" in str(e).lower(), e
+    # The engine must still work after delivered errors.
+    out = hvd.allreduce(tf.ones([2]), average=False, name="after_errors")
+    np.testing.assert_allclose(out.numpy(), float(size))
+
+
+def scenario_sparse(rank, size):
+    # IndexedSlices allreduce == gather values+indices; average matches
+    # the dense sum divided by size (reference __init__.py:67-78).
+    values = tf.ones([2, 4]) * (rank + 1)
+    indices = tf.constant([rank, size + rank], dtype=tf.int64)
+    sl = tf.IndexedSlices(values, indices, tf.constant([2 * size, 4],
+                                                       dtype=tf.int64))
+    red = hvd.allreduce(sl, average=True)
+    assert isinstance(red, tf.IndexedSlices)
+    dense = tf.math.unsorted_segment_sum(
+        red.values, red.indices, 2 * size).numpy()
+    expected = np.zeros([2 * size, 4], np.float32)
+    for r in range(size):
+        expected[r] += (r + 1) / size
+        expected[size + r] += (r + 1) / size
+    np.testing.assert_allclose(dense, expected, rtol=1e-6)
+
+    # sparse_as_dense via the tape: embedding-style gradient densified.
+    emb = tf.Variable(tf.ones([4, 3]))
+    with hvd.DistributedGradientTape(tf.GradientTape(),
+                                     sparse_as_dense=True) as tape:
+        picked = tf.gather(emb, [rank % 4])
+        loss = tf.reduce_sum(picked)
+    (grad,) = tape.gradient(loss, [emb])
+    assert not isinstance(grad, tf.IndexedSlices)
+    total = np.zeros([4, 3], np.float32)
+    for r in range(size):
+        total[r % 4] += 1.0 / size
+    np.testing.assert_allclose(grad.numpy(), total, rtol=1e-6)
+
+
+def scenario_keras_loop(rank, size):
+    # TF2 training loop: broadcast_variables + DistributedGradientTape +
+    # create_distributed_optimizer.  Different data per rank; params must
+    # stay bit-identical across ranks and the loss must drop.
+    tf.random.set_seed(100 + rank)  # deliberately different init
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(16, activation="tanh"),
+        tf.keras.layers.Dense(1),
+    ])
+    model(tf.zeros([1, 4]))  # build
+    opt = hvd.create_distributed_optimizer(
+        tf.keras.optimizers.SGD(learning_rate=0.05))
+    hvd.broadcast_variables(model.trainable_variables, root_rank=0)
+
+    rng = np.random.default_rng(1000 + rank)
+    losses = []
+    for _ in range(8):
+        X = rng.normal(size=(16, 4)).astype(np.float32)
+        Y = (X.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+        with tf.GradientTape() as t:
+            loss = tf.reduce_mean((model(X) - Y) ** 2)
+        grads = t.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    flat = tf.concat([tf.reshape(v, [-1])
+                      for v in model.trainable_variables], 0)
+    gathered = hvd.allgather(tf.reshape(flat, [1, -1]), name="param_check")
+    for r in range(size):
+        np.testing.assert_array_equal(gathered[r].numpy(), flat.numpy())
+
+
+def scenario_v1_session(rank, size):
+    # The reference's primary idiom: graph mode, DistributedOptimizer
+    # overriding compute_gradients, BroadcastGlobalVariablesHook
+    # (reference __init__.py:101-209).
+    tf.compat.v1.disable_eager_execution()
+    tf.compat.v1.set_random_seed(123 + rank)  # different init per rank
+    rng = np.random.default_rng(2000 + rank)  # different data per rank
+
+    x_ph = tf.compat.v1.placeholder(tf.float32, [None, 4])
+    y_ph = tf.compat.v1.placeholder(tf.float32, [None, 1])
+    w = tf.compat.v1.get_variable(
+        "w", [4, 1], initializer=tf.compat.v1.random_normal_initializer())
+    b = tf.compat.v1.get_variable(
+        "b", [1], initializer=tf.compat.v1.zeros_initializer())
+    loss = tf.reduce_mean((tf.matmul(x_ph, w) + b - y_ph) ** 2)
+    opt = hvd.DistributedOptimizer(
+        tf.compat.v1.train.GradientDescentOptimizer(0.05))
+    train = opt.minimize(loss)
+    hook = hvd.BroadcastGlobalVariablesHook(root_rank=0)
+
+    with tf.compat.v1.train.SingularMonitoredSession(hooks=[hook]) as sess:
+        w0 = sess.run(w)
+        for _ in range(4):
+            X = rng.normal(size=(8, 4)).astype(np.float32)
+            Y = X.sum(axis=1, keepdims=True).astype(np.float32)
+            sess.run(train, {x_ph: X, y_ph: Y})
+        w_final, b_final = sess.run([w, b])
+
+    # Re-enter eager to cross-check equality across ranks.
+    flat = np.concatenate([w0.ravel(), w_final.ravel(), b_final.ravel()])
+    eng_check = hvd.allgather(
+        tf.constant(flat.reshape(1, -1)), name="v1_check")
+    gathered = eng_check  # eager is disabled; run via session
+    with tf.compat.v1.Session() as s:
+        arr = s.run(gathered)
+    for r in range(size):
+        np.testing.assert_array_equal(arr[r], flat)
+
+
+SCENARIOS = {
+    "ops": scenario_ops,
+    "grads": scenario_grads,
+    "errors": scenario_errors,
+    "sparse": scenario_sparse,
+    "keras_loop": scenario_keras_loop,
+    "v1_session": scenario_v1_session,
+}
+
+
+def main():
+    scenario = sys.argv[1]
+    hvd.init()
+    rank = hvd.rank()
+    try:
+        SCENARIOS[scenario](rank, hvd.size())
+    finally:
+        hvd.shutdown()
+    print(f"rank {rank} scenario {scenario} ok")
+
+
+if __name__ == "__main__":
+    main()
