@@ -1,22 +1,148 @@
-"""Drop-in alias for the Keras-role frontend.
+"""Keras-3 frontend: real ``keras.Model``/``keras.optimizers`` support.
 
-Reference parity: users of the reference import ``horovod.keras`` (and
-``horovod.tensorflow.keras``, a byte-level near-copy of it — SURVEY.md
-§2.2 P8/P10).  In this framework the Keras role is played by the flax
-frontend (``horovod_tpu.flax``): ``fit`` is the ``model.fit`` analogue,
-``checkpoint.restore_and_broadcast`` the ``load_model`` analogue, and the
-four callbacks keep their reference names.  This module re-exports that
-frontend under the familiar name so reference-era imports read naturally::
+Reference parity: ``horovod/keras/__init__.py`` (148 LoC) —
+``DistributedOptimizer`` (:33-64), ``broadcast_global_variables`` /
+``allreduce`` wrappers (:67-114), ``load_model`` (:117-148) — and
+``horovod/tensorflow/keras``, its byte-level near-copy (SURVEY.md §2.2
+P8/P10).
 
-    import horovod_tpu.keras as hvd_keras
+Keras 3 on this stack is multi-backend (JAX, TensorFlow, torch); the
+JAX backend is the TPU-native flagship — the trainer jit-compiles the
+train step and the gradient allreduce runs as an ``io_callback`` into
+the native engine (see ``impl.py``).  The flax frontend
+(``horovod_tpu.flax``) remains the Keras-ROLE surface for pure-JAX
+training states; this module serves actual ``keras.Model`` users.
 
-    hvd_keras.init()
-    state = hvd_keras.fit(state, data_fn, epochs=..., callbacks=[
-        hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
-        hvd_keras.callbacks.MetricAverageCallback(),
+Usage::
+
+    import keras
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    model = keras.Sequential([...])
+    opt = hvd.DistributedOptimizer(keras.optimizers.Adam(1e-3 * hvd.size()))
+    model.compile(optimizer=opt, loss="mse")
+    model.fit(x, y, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
     ])
 """
 
-from horovod_tpu.flax import *          # noqa: F401,F403
-from horovod_tpu.flax import callbacks, checkpoint, estimator  # noqa: F401
-from horovod_tpu.flax import __all__    # noqa: F401
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.common.basics import basics
+from horovod_tpu.keras import callbacks
+from horovod_tpu.keras.impl import (
+    broadcast_variables, create_distributed_optimizer, wrap_optimizer_class,
+    _engine,
+)
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "DistributedOptimizer", "create_distributed_optimizer",
+    "broadcast_variables", "broadcast_global_variables", "allreduce",
+    "allgather", "broadcast", "load_model", "callbacks",
+]
+
+init = basics.init
+shutdown = basics.shutdown
+rank = basics.rank
+size = basics.size
+local_rank = basics.local_rank
+local_size = basics.local_size
+
+
+def DistributedOptimizer(optimizer, compression: str = "none"):
+    """Wrap a ``keras.optimizers.Optimizer`` so ``apply`` averages the
+    gradients across ranks first (reference __init__.py:33-64).  The
+    wrapped class keeps the original class name, so saved models reload
+    with or without this library."""
+    return create_distributed_optimizer(optimizer, compression)
+
+
+def broadcast_global_variables(model, root_rank: int = 0) -> None:
+    """Broadcast a model's weights (and built optimizer slots) from
+    ``root_rank`` (reference __init__.py:67-77; Keras 3 has no global
+    graph, so the model is explicit)."""
+    broadcast_variables(model.weights, root_rank, name_prefix="keras.bcast.w")
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and getattr(opt, "built", False):
+        broadcast_variables(opt.variables, root_rank,
+                            name_prefix="keras.bcast.opt")
+
+
+def allreduce(value, average: bool = True, name: Optional[str] = None):
+    """Average (or sum) a host scalar/array across ranks — the metric
+    path (reference __init__.py:80-98).  Returns a fresh numpy array
+    (python float for scalar input); never mutates the input (the engine
+    reduces in place, so a private copy goes on the wire)."""
+    scalar = np.isscalar(value) or getattr(value, "ndim", None) == 0
+    arr = np.array(value, dtype=np.float64 if scalar else None, copy=True,
+                   order="C")
+    if scalar:
+        arr = arr.reshape(1)
+    eng = _engine()
+    if eng is not None:
+        eng.synchronize(
+            eng.enqueue_allreduce(arr, name=name or "keras.allreduce"))
+        if average:
+            n = basics.size()
+            arr = arr / n if arr.dtype.kind == "f" else arr // n
+    return float(arr[0]) if scalar else arr
+
+
+def allgather(value, name: Optional[str] = None):
+    """Concatenate each rank's array along dim 0 (reference
+    __init__.py:101-107)."""
+    arr = np.array(value, copy=True, order="C")
+    if arr.ndim == 0:
+        arr = arr.reshape(1)
+    eng = _engine()
+    if eng is None:
+        return arr
+    return eng.synchronize(
+        eng.enqueue_allgather(arr, name=name or "keras.allgather"))
+
+
+def broadcast(value, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast a host array from ``root_rank`` (reference
+    __init__.py:110-114).  Returns a fresh array; never mutates the
+    input."""
+    if root_rank < 0 or root_rank >= basics.size():
+        raise ValueError(
+            f"root_rank {root_rank} out of range for size {basics.size()}")
+    arr = np.array(value, copy=True, order="C")
+    eng = _engine()
+    if eng is not None:
+        eng.synchronize(eng.enqueue_broadcast(
+            arr, root_rank, name=name or "keras.broadcast"))
+    return arr
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression: str = "none"):
+    """Load a saved ``keras.Model`` and make its optimizer distributed
+    (reference __init__.py:117-148, impl.py:93-109).
+
+    The file is loaded as plain keras (wrapped optimizers serialize
+    under their base class's public name — see ``wrap_optimizer_class``),
+    then the deserialized optimizer's class is swapped to the wrapped
+    subclass IN PLACE, preserving the restored slot variables — which a
+    from-config reconstruction would lose.  ``custom_optimizers`` /
+    ``custom_objects`` feed deserialization of custom classes.
+    """
+    import keras
+
+    objects = dict(custom_objects or {})
+    if custom_optimizers is not None:
+        objects.update({cls.__name__: cls for cls in custom_optimizers})
+    with keras.saving.custom_object_scope(objects):
+        model = keras.saving.load_model(filepath)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not getattr(type(opt), "_hvd_wrapped", False):
+        opt.__class__ = wrap_optimizer_class(type(opt), compression)
+    return model

@@ -1,0 +1,206 @@
+"""Keras-3 callbacks: broadcast, metric averaging, LR schedule/warmup.
+
+Reference parity: ``horovod/keras/callbacks_impl.py`` —
+BroadcastGlobalVariables (:20-30), MetricAverage (:33-67),
+LearningRateSchedule with momentum correction (:70-146), Warmup with the
+Goyal et al. ramp (:149-168).  Rebuilt on ``keras.callbacks.Callback``
+(Keras 3 objects, no sessions); metric averaging rides the host engine
+directly instead of building per-metric graph variables.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import keras
+
+from horovod_tpu.common.basics import basics
+from horovod_tpu.keras.impl import (_host_average_many, broadcast_variables)
+
+__all__ = [
+    "BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+    "LearningRateScheduleCallback", "LearningRateWarmupCallback",
+]
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast all model variables (and optimizer slots, once built)
+    from ``root_rank`` at train start, so every worker begins from
+    identical state whether initialized randomly or restored from a
+    checkpoint (reference callbacks_impl.py:20-30)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._weights_done = False
+        self._opt_done = False
+
+    def _broadcast_what_exists(self):
+        # Keras builds lazily, backend-dependently: the JAX trainer
+        # materializes weights before on_train_begin, the TF trainer only
+        # inside the first train step, and optimizer slots appear after
+        # the first apply everywhere.  Broadcast each group as soon as it
+        # exists; until the weights broadcast lands, per-rank steps use
+        # averaged (identical) gradients on divergent weights, and the
+        # batch-0-end broadcast then equalizes — from batch 1 on, state
+        # is bit-identical.
+        if not self._weights_done and self.model.weights:
+            broadcast_variables(self.model.weights, self.root_rank,
+                                name_prefix="keras.bcast.w")
+            self._weights_done = True
+        opt = getattr(self.model, "optimizer", None)
+        if not self._opt_done and opt is not None \
+                and getattr(opt, "built", False):
+            broadcast_variables(opt.variables, self.root_rank,
+                                name_prefix="keras.bcast.opt")
+            self._opt_done = True
+
+    def on_train_begin(self, logs=None):
+        self._broadcast_what_exists()
+
+    def on_train_batch_end(self, batch, logs=None):
+        if not (self._weights_done and self._opt_done):
+            self._broadcast_what_exists()
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch-end metrics over ranks in place, so rank-0 logging,
+    checkpoint-on-best, and LR plateaus act on global values (reference
+    callbacks_impl.py:33-67).  Keys are sorted for cross-rank rendezvous
+    order; non-scalar entries pass through untouched."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs or basics.size() == 1:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if np.isscalar(v) or getattr(v, "ndim", None) == 0)
+        arrays = [np.asarray(float(logs[k]), dtype=np.float64).reshape(1)
+                  for k in keys]
+        reduced = _host_average_many(arrays, f"keras.metric.ep{epoch}")
+        for k, r in zip(keys, reduced):
+            logs[k] = float(r[0])
+
+
+def _get_lr(optimizer) -> float:
+    return float(keras.ops.convert_to_numpy(optimizer.learning_rate))
+
+
+def _set_lr(optimizer, value: float) -> None:
+    # Keras 3 exposes learning_rate as an assignable variable property
+    # (raises for LearningRateSchedule objects, same as the reference's
+    # backend.set_value on a schedule).
+    optimizer.learning_rate = value
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the initial LR by ``multiplier(epoch)`` inside
+    [start_epoch, end_epoch) (reference callbacks_impl.py:70-146).
+
+    ``staircase=True`` adjusts on epoch boundaries; ``staircase=False``
+    interpolates per batch using ``steps_per_epoch`` (autodetected from
+    ``params['steps']`` when possible).  Momentum correction rescales
+    momentum by new_lr/old_lr around the boundary (Goyal et al. 2017) —
+    Keras 3 stores momentum as a plain python attribute, so under the
+    JAX trainer's jitted step the corrected value only takes effect on
+    retrace; a warning is emitted once there.
+    """
+
+    def __init__(self, multiplier, start_epoch: int = 0, end_epoch=None,
+                 staircase: bool = True, momentum_correction: bool = True,
+                 steps_per_epoch=None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = None
+        self.restore_momentum = None
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.staircase = True
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _autodetect_steps_per_epoch(self):
+        if self.params and self.params.get("steps"):
+            return self.params["steps"]
+        raise ValueError(
+            "Could not autodetect steps_per_epoch; pass steps_per_epoch= "
+            "to %s()" % type(self).__name__)
+
+    def _adjust_lr(self, epoch):
+        opt = self.model.optimizer
+        old_lr = _get_lr(opt)
+        new_lr = self.initial_lr * self.multiplier(epoch)
+        _set_lr(opt, new_lr)
+        if self.momentum_correction and hasattr(opt, "momentum") \
+                and np.isscalar(opt.momentum) and opt.momentum:
+            if keras.backend.backend() == "jax":
+                warnings.warn(
+                    "momentum correction is inert under the jitted JAX "
+                    "trainer (momentum is a python attribute, baked at "
+                    "trace time)", RuntimeWarning)
+            else:
+                self.restore_momentum = opt.momentum
+                opt.momentum = opt.momentum * new_lr / old_lr
+
+    def _restore_momentum_if_needed(self):
+        if self.restore_momentum:
+            self.model.optimizer.momentum = self.restore_momentum
+            self.restore_momentum = None
+
+    def on_train_begin(self, logs=None):
+        self.initial_lr = _get_lr(self.model.optimizer)
+        if not self.staircase and not self.steps_per_epoch:
+            self.steps_per_epoch = self._autodetect_steps_per_epoch()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if (self.current_epoch < self.start_epoch or
+                (self.end_epoch is not None and
+                 self.current_epoch >= self.end_epoch)):
+            return
+        if self.staircase and batch == 0:
+            self._adjust_lr(self.current_epoch)
+        elif not self.staircase:
+            epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+            self._adjust_lr(epoch)
+
+    def on_train_batch_end(self, batch, logs=None):
+        self._restore_momentum_if_needed()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = _get_lr(self.model.optimizer)
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual warmup from lr to lr*size over ``warmup_epochs`` (Goyal
+    et al. 2017; reference callbacks_impl.py:149-168).  Pair with an
+    initial lr already scaled by ``size()``."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        def multiplier(epoch):
+            epoch += 1.0 / self.steps_per_epoch
+            return 1.0 / basics.size() * (
+                epoch * (basics.size() - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose > 0 \
+                and basics.rank() == 0:
+            print("\nEpoch %d: finished gradual learning rate warmup to %g."
+                  % (epoch + 1, _get_lr(self.model.optimizer)))

@@ -1,0 +1,127 @@
+"""Multi-process Keras-3 frontend worker (launched by
+test_keras_multiproc.py; backend chosen via KERAS_BACKEND env by the
+launcher — the JAX backend is the TPU-native flagship, where the whole
+train step runs jitted and the allreduce rides io_callback).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import keras  # noqa: E402
+
+import horovod_tpu.keras as hvd  # noqa: E402
+
+
+def _model():
+    return keras.Sequential([
+        keras.layers.Dense(16, activation="tanh"),
+        keras.layers.Dense(1),
+    ])
+
+
+def _data(rank, n=64):
+    rng = np.random.default_rng(1000 + rank)  # different data per rank
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    Y = (X.sum(axis=1, keepdims=True) * 0.5).astype(np.float32)
+    return X, Y
+
+
+def _assert_equal_across_ranks(model, size, name):
+    flat = np.concatenate([
+        np.asarray(keras.ops.convert_to_numpy(v)).ravel()
+        for v in model.trainable_variables])
+    gathered = hvd.allgather(flat.reshape(1, -1), name=name)
+    for r in range(size):
+        np.testing.assert_array_equal(gathered[r], flat)
+
+
+def scenario_fit(rank, size):
+    keras.utils.set_random_seed(100 + rank)  # deliberately different init
+    model = _model()
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.05)),
+        loss="mse")
+    X, Y = _data(rank)
+    hist = model.fit(X, Y, epochs=4, batch_size=16, verbose=0, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0], losses
+    # Identical data-parallel updates -> bit-identical params.
+    _assert_equal_across_ranks(model, size, "fit_check")
+    # MetricAverageCallback rewrote logs in place: every rank recorded the
+    # same averaged loss history.
+    lh = np.asarray(losses, dtype=np.float64).reshape(1, -1)
+    gathered = hvd.allgather(lh, name="loss_hist")
+    for r in range(size):
+        np.testing.assert_allclose(gathered[r], lh[0], rtol=1e-12)
+
+
+def scenario_resume(rank, size):
+    # Rank 0 trains + saves; everyone reloads via hvd.load_model (class
+    # swap preserves restored slots), broadcasts, and continues in step.
+    path = os.environ["HVD_TEST_CKPT"]
+    keras.utils.set_random_seed(7)
+    if rank == 0:
+        model = _model()
+        model.compile(optimizer=keras.optimizers.Adam(1e-2), loss="mse")
+        X, Y = _data(0)
+        model.fit(X, Y, epochs=2, batch_size=16, verbose=0)
+        model.save(path)
+    # Barrier: peers must not read the file before rank 0 wrote it.
+    hvd.allreduce(0.0, name="save_barrier")
+    model = hvd.load_model(path)
+    assert type(model.optimizer)._hvd_wrapped
+    assert model.optimizer.built  # restored slots survived the wrap
+    hvd.broadcast_global_variables(model, root_rank=0)
+    X, Y = _data(rank)
+    model.fit(X, Y, epochs=2, batch_size=16, verbose=0)
+    _assert_equal_across_ranks(model, size, "resume_check")
+
+
+def scenario_warmup(rank, size):
+    keras.utils.set_random_seed(3)
+    model = _model()
+    base_lr = 0.02 * size  # pre-scaled, as the callback contract expects
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=base_lr)),
+        loss="mse")
+    X, Y = _data(rank)
+    warmup = hvd.callbacks.LearningRateWarmupCallback(
+        warmup_epochs=3, momentum_correction=False)
+    hist = model.fit(X, Y, epochs=4, batch_size=16, verbose=0, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0), warmup])
+    lrs = hist.history["lr"]
+    # Ramp: starts near base/size, reaches base by the end of warmup.
+    assert lrs[0] < base_lr / size * 1.5, lrs
+    np.testing.assert_allclose(lrs[2], base_lr, rtol=1e-5)
+    _assert_equal_across_ranks(model, size, "warmup_check")
+
+
+SCENARIOS = {
+    "fit": scenario_fit,
+    "resume": scenario_resume,
+    "warmup": scenario_warmup,
+}
+
+
+def main():
+    scenario = sys.argv[1]
+    hvd.init()
+    rank = hvd.rank()
+    try:
+        SCENARIOS[scenario](rank, hvd.size())
+    finally:
+        hvd.shutdown()
+    print(f"rank {rank} scenario {scenario} ok")
+
+
+if __name__ == "__main__":
+    main()

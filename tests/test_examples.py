@@ -19,6 +19,7 @@ EXAMPLES = [
     ("jax_word2vec.py", []),
     ("torch_mnist.py", []),
     ("tf_mnist.py", []),
+    ("keras_mnist.py", []),
     ("torch_imagenet_resnet50.py", []),
     ("torch_synthetic_benchmark.py", []),
     ("bert_pretraining_fsdp.py", []),

@@ -253,17 +253,18 @@ def test_momentum_correction_warns_for_adaptive(recwarn):
     assert sum("no SGD momentum trace" in m for m in msgs) == 1
 
 
-def test_keras_alias_reexports_flax_frontend():
-    """horovod_tpu.keras is the reference-familiar name for the Keras-role
-    frontend (reference horovod/keras + horovod/tensorflow/keras, SURVEY.md
-    P8/P10)."""
-    import horovod_tpu.flax as hf
+def test_keras_module_is_real_keras_frontend():
+    """horovod_tpu.keras serves actual keras.Model users (reference
+    horovod/keras, SURVEY.md P8/P10); the flax frontend remains the
+    Keras-ROLE surface for pure-JAX training states."""
     import horovod_tpu.keras as hk
 
-    assert hk.fit is hf.fit
-    assert hk.callbacks is hf.callbacks
-    assert hk.checkpoint is hf.checkpoint
-    assert set(hk.__all__) == set(hf.__all__)
+    for name in ("DistributedOptimizer", "load_model",
+                 "broadcast_global_variables", "allreduce", "callbacks"):
+        assert hasattr(hk, name), name
+    for cb in ("BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+               "LearningRateScheduleCallback", "LearningRateWarmupCallback"):
+        assert hasattr(hk.callbacks, cb), cb
 
 
 def test_sharded_checkpoint_roundtrip(tmp_path, n_devices):

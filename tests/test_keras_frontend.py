@@ -1,0 +1,116 @@
+"""Keras-3 frontend tests, size-1 (multi-process coverage lives in
+tests/keras_worker.py via test_keras_multiproc.py; backend here is
+whatever the process default is — the JAX-backend path is exercised by
+the subprocess workers, where KERAS_BACKEND is set before import).
+"""
+
+import numpy as np
+import pytest
+import keras
+
+import horovod_tpu.keras as hvd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    hvd.init()
+
+
+def _tiny_model():
+    m = keras.Sequential([keras.layers.Dense(4, activation="relu"),
+                          keras.layers.Dense(1)])
+    m.compile(optimizer=hvd.DistributedOptimizer(
+        keras.optimizers.Adam(1e-2)), loss="mse")
+    return m
+
+
+def _xy(n=32):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, 3)).astype(np.float32)
+    return X, X.sum(axis=1, keepdims=True).astype(np.float32)
+
+
+def test_distributed_optimizer_keeps_class_name():
+    opt = hvd.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.5))
+    assert type(opt).__name__ == "SGD"
+    assert type(opt)._hvd_wrapped
+    # wrapping an already-wrapped optimizer is a no-op
+    assert hvd.DistributedOptimizer(opt) is opt
+
+
+def test_fit_trains_and_metric_callbacks_run():
+    keras.utils.set_random_seed(0)
+    model = _tiny_model()
+    X, Y = _xy()
+    h = model.fit(X, Y, epochs=3, batch_size=8, verbose=0, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ])
+    assert h.history["loss"][-1] < h.history["loss"][0]
+
+
+def test_save_load_model_roundtrip(tmp_path):
+    keras.utils.set_random_seed(1)
+    model = _tiny_model()
+    X, Y = _xy()
+    model.fit(X, Y, epochs=1, batch_size=8, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+
+    m2 = hvd.load_model(path)
+    assert type(m2.optimizer)._hvd_wrapped
+    assert type(m2.optimizer).__name__ == "Adam"
+    # restored slot variables survived the in-place class swap
+    assert m2.optimizer.built
+    assert len(m2.optimizer.variables) == len(model.optimizer.variables)
+    np.testing.assert_allclose(
+        np.asarray(m2.predict(X[:4], verbose=0)),
+        np.asarray(model.predict(X[:4], verbose=0)), rtol=1e-5)
+    m2.fit(X, Y, epochs=1, batch_size=8, verbose=0)  # still trains
+
+
+def test_saved_file_loads_without_horovod(tmp_path):
+    """The wrapped optimizer serializes under its public keras name, so
+    the artifact is portable to environments without this library
+    (reference impl.py:64-67)."""
+    keras.utils.set_random_seed(2)
+    model = _tiny_model()
+    X, Y = _xy()
+    model.fit(X, Y, epochs=1, batch_size=8, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    m3 = keras.saving.load_model(path)  # plain keras, no custom objects
+    assert not getattr(type(m3.optimizer), "_hvd_wrapped", False)
+    assert type(m3.optimizer).__name__ == "Adam"
+    m3.fit(X, Y, epochs=1, batch_size=8, verbose=0)
+
+
+def test_host_collectives_size1():
+    assert hvd.allreduce(3.0) == 3.0
+    assert hvd.allreduce(4.0, average=False) == 4.0
+    out = hvd.allgather(np.ones((2, 2)))
+    assert out.shape == (2, 2)
+    np.testing.assert_allclose(hvd.broadcast(np.arange(3.0)), np.arange(3.0))
+
+
+def test_lr_schedule_callback_staircase():
+    keras.utils.set_random_seed(3)
+    model = _tiny_model()
+    X, Y = _xy()
+    cb = hvd.callbacks.LearningRateScheduleCallback(
+        lambda e: 0.1 if e >= 1 else 1.0, momentum_correction=False)
+    h = model.fit(X, Y, epochs=2, batch_size=8, verbose=0, callbacks=[cb])
+    lrs = h.history["lr"]
+    np.testing.assert_allclose(lrs[0], 1e-2, rtol=1e-5)
+    np.testing.assert_allclose(lrs[1], 1e-3, rtol=1e-5)
+
+
+def test_warmup_callback_ramps_to_base_lr():
+    keras.utils.set_random_seed(4)
+    model = _tiny_model()
+    X, Y = _xy()
+    cb = hvd.callbacks.LearningRateWarmupCallback(
+        warmup_epochs=2, momentum_correction=False)
+    h = model.fit(X, Y, epochs=3, batch_size=8, verbose=0, callbacks=[cb])
+    # size 1: multiplier is exactly 1 -> lr untouched
+    np.testing.assert_allclose(h.history["lr"], 1e-2, rtol=1e-5)

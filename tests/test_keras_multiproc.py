@@ -1,0 +1,39 @@
+"""Multi-process Keras-3 frontend tests on both the JAX backend (the
+TPU-native flagship: jitted train step, allreduce via io_callback) and
+the TensorFlow backend (py_function path).  Scenarios live in
+tests/keras_worker.py."""
+
+import os
+
+import pytest
+
+from tests.test_native_engine import run_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "keras_worker.py")
+
+
+def run_keras_workers(n, scenario, backend, timeout=300, extra_env=None):
+    env = {
+        "KERAS_BACKEND": backend,
+        "CUDA_VISIBLE_DEVICES": "-1",
+    }
+    if backend == "jax":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+    env.update(extra_env or {})
+    run_workers(n, scenario, timeout=timeout, worker=WORKER, extra_env=env)
+
+
+@pytest.mark.parametrize("backend", ["jax", "tensorflow"])
+def test_keras_fit_equalizes(backend):
+    run_keras_workers(2, "fit", backend)
+
+
+def test_keras_load_model_resume(tmp_path):
+    run_keras_workers(2, "resume", "jax", extra_env={
+        "HVD_TEST_CKPT": str(tmp_path / "model.keras")})
+
+
+def test_keras_lr_warmup(tmp_path):
+    run_keras_workers(2, "warmup", "jax")
