@@ -276,6 +276,7 @@ def _llama_result(measured_peak: float | None = None) -> dict:
     from horovod_tpu.models import LlamaConfig, LlamaModel
     from horovod_tpu.ops.flash_attention import flash_attention_fn
     from horovod_tpu.ops.losses import softmax_cross_entropy
+    from horovod_tpu.ops.mixed_precision import cast_compute, master_weights
 
     hvd.init()
     on_tpu = jax.default_backend() == "tpu"
@@ -298,9 +299,13 @@ def _llama_result(measured_peak: float | None = None) -> dict:
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq + 1),
                                       dtype=np.int32))
-    params = jax.jit(lambda: model.init(jax.random.key(0),
-                                        tokens[:, :-1]))()
-    opt = hvd.DistributedOptimizer(optax.adamw(3e-4))
+    # bf16-stored params + fp32 masters in the optimizer state: fp32
+    # storage makes XLA convert-AND-RETILE every weight to its bf16
+    # compute layout each step (~25 ms of `convert_bitcast_fusion` on the
+    # 284 ms round-3 step, docs/perf-notes.md).
+    params = jax.jit(lambda: cast_compute(model.init(jax.random.key(0),
+                                                     tokens[:, :-1])))()
+    opt = hvd.DistributedOptimizer(master_weights(optax.adamw(3e-4)))
 
     def loss_fn(params, batch_tokens):
         logits = model.apply(params, batch_tokens[:, :-1])

@@ -657,7 +657,9 @@ bool Engine::RunLoopOnce() {
     // EOF/keepalive).
     for (int r = 1; r < size_; ++r) {
       std::vector<uint8_t> frame;
-      if (!worker_conns_[r].RecvFrame(&frame, control_patience_rounds_)) {
+      std::string who = "control frame from rank " + std::to_string(r);
+      if (!worker_conns_[r].RecvFrame(&frame, control_patience_rounds_,
+                                      who.c_str())) {
         abort_reason_ = "coordinator lost connection to rank " +
                         std::to_string(r) +
                         " — that process likely crashed or hung; check its "
@@ -705,7 +707,9 @@ bool Engine::RunLoopOnce() {
     return false;
   }
   std::vector<uint8_t> frame;
-  if (!coordinator_conn_.RecvFrame(&frame, control_patience_rounds_)) {
+  if (!coordinator_conn_.RecvFrame(&frame, control_patience_rounds_,
+                                   "response frame from the coordinator "
+                                   "(rank 0)")) {
     abort_reason_ = "lost connection to the coordinator (rank 0) — it "
                     "likely crashed or another rank failed; check rank 0's "
                     "logs.";

@@ -83,7 +83,8 @@ bool Socket::RecvAll(void* data, size_t n) {
   return true;
 }
 
-bool Socket::RecvAllPatient(void* data, size_t n, int max_idle_rounds) {
+bool Socket::RecvAllPatient(void* data, size_t n, int max_idle_rounds,
+                            const char* wait_label) {
   char* p = static_cast<char*>(data);
   int idle = 0;
   while (n > 0) {
@@ -92,6 +93,16 @@ bool Socket::RecvAllPatient(void* data, size_t n, int max_idle_rounds) {
       if (got < 0 && errno == EINTR) continue;
       if (got < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) &&
           ++idle <= max_idle_rounds) {
+        // Burn patience LOUDLY: a wedged-but-alive peer can hold the
+        // control plane for minutes before the descriptive abort, and a
+        // silent wait reads as a hang (reference stall-warning cadence,
+        // operations.cc:1366-1412, applied to transport waits).
+        if (wait_label != nullptr) {
+          std::fprintf(stderr,
+                       "horovod_tpu: still waiting on %s (idle timeout "
+                       "%d/%d before abort)\n",
+                       wait_label, idle, max_idle_rounds);
+        }
         continue;  // waiting its turn in the relay chain, peer still alive
       }
       return false;
@@ -110,9 +121,12 @@ bool Socket::SendFrame(const std::vector<uint8_t>& payload) {
   return SendAll(payload.data(), payload.size());
 }
 
-bool Socket::RecvFrame(std::vector<uint8_t>* payload, int max_idle_rounds) {
+bool Socket::RecvFrame(std::vector<uint8_t>* payload, int max_idle_rounds,
+                       const char* wait_label) {
   uint64_t len = 0;
-  if (!RecvAllPatient(&len, sizeof(len), max_idle_rounds)) return false;
+  if (!RecvAllPatient(&len, sizeof(len), max_idle_rounds, wait_label)) {
+    return false;
+  }
   if (len > (1ull << 34)) return false;  // 16 GB sanity cap
   payload->resize(len);
   if (len == 0) return true;

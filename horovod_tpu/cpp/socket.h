@@ -42,15 +42,19 @@ class Socket {
   // chain hops) where zero bytes for a while can mean "upstream hops still
   // in flight", not "peer hung": tolerates up to `max_idle_rounds`
   // consecutive SO_RCVTIMEO expiries before failing; EOF / hard errors
-  // still fail immediately.
-  bool RecvAllPatient(void* data, size_t n, int max_idle_rounds);
+  // still fail immediately.  A non-null `wait_label` names who is being
+  // waited for in a stderr warning each idle round, so patience burns
+  // visibly instead of reading as a hang.
+  bool RecvAllPatient(void* data, size_t n, int max_idle_rounds,
+                      const char* wait_label = nullptr);
 
   // Length-prefixed frames (u64 length + payload).  `max_idle_rounds` > 0
   // tolerates that many SO_RCVTIMEO expiries while waiting for the frame —
   // the control plane must ride out ranks that are legitimately busy
   // executing a long data-plane collective before their next cycle frame.
   bool SendFrame(const std::vector<uint8_t>& payload);
-  bool RecvFrame(std::vector<uint8_t>* payload, int max_idle_rounds = 0);
+  bool RecvFrame(std::vector<uint8_t>* payload, int max_idle_rounds = 0,
+                 const char* wait_label = nullptr);
 
  private:
   int fd_;

@@ -144,54 +144,63 @@ def broadcast(tensor, root_rank=0, *, axis_name="data", name=None):
 def reducescatter(tensor, *, axis_name="data", op=Sum, scatter_axis=0,
                   tiled=True, name=None):
     """Reduce-scatter.  Traced: one XLA psum_scatter over ``axis_name``.
-    Eager: cross-process ring reduce-scatter through the runtime engine
-    (dim-0 rows split as evenly as possible across ranks)."""
+    Eager: cross-process ring reduce-scatter through the runtime engine.
+
+    Full axis generality on BOTH paths (``scatter_axis``/``tiled`` match
+    ``lax.psum_scatter``): the eager engine scatters dim-0 rows, so other
+    axes ride a moveaxis shim around the wire op; ``tiled=False`` removes
+    the scattered axis (its length must equal ``size()``)."""
     if _is_traced(tensor):
         return _cops.reducescatter(tensor, axis_name=axis_name, op=op,
                                    scatter_axis=scatter_axis, tiled=tiled)
-    if not tiled:
-        # Untiled (leading-dim-removed) output shapes are only implemented
-        # on the traced path; the eager engine always returns the tiled
-        # per-rank slice.  Raise rather than silently ignoring the flag.
-        raise NotImplementedError(
-            "eager reducescatter implements tiled=True semantics only; "
-            "use the traced path for tiled=False"
-        )
+    import jax.numpy as jnp
+
+    x = jnp.asarray(tensor)
+    if not tiled and x.shape[scatter_axis] != size():
+        raise ValueError(
+            f"tiled=False requires dim {scatter_axis} (length "
+            f"{x.shape[scatter_axis]}) to equal size() ({size()}), like "
+            "lax.psum_scatter")
     if size() == 1:
         # World of one: reduce is identity, the scatter keeps the full
         # shard — for any op/axis (matches the reference under -np 1).
-        import jax.numpy as jnp
-
-        return jnp.asarray(tensor)
-    if scatter_axis != 0:
-        raise NotImplementedError(
-            "eager reducescatter scatters along dim 0; transpose first or "
-            "use the traced path for other axes"
-        )
+        return jnp.squeeze(x, scatter_axis) if not tiled else x
     from horovod_tpu.runtime import eager
 
-    return eager.reducescatter(tensor, op=op, name=name)
+    moved = jnp.moveaxis(x, scatter_axis, 0)
+    out = eager.reducescatter(moved, op=op, name=name)
+    out = jnp.moveaxis(out, 0, scatter_axis)
+    return jnp.squeeze(out, scatter_axis) if not tiled else out
 
 
 def alltoall(tensor, *, axis_name="seq", split_axis=0, concat_axis=0,
              name=None):
     """All-to-all.  Traced: one XLA all_to_all over ``axis_name``.  Eager:
-    cross-process ring exchange of equal dim-0 blocks."""
+    cross-process ring exchange of equal blocks, axis-general via a
+    moveaxis shim (the wire op exchanges dim-0 blocks): split ``tensor``
+    into ``size()`` blocks along ``split_axis``; block i goes to rank i;
+    the received blocks concatenate along ``concat_axis`` — same
+    semantics as ``lax.all_to_all`` on the traced path."""
     if _is_traced(tensor):
         return _cops.alltoall(tensor, axis_name=axis_name,
                               split_axis=split_axis, concat_axis=concat_axis)
-    if size() == 1:
-        import jax.numpy as jnp
+    import jax.numpy as jnp
 
-        return jnp.asarray(tensor)
-    if split_axis != 0 or concat_axis != 0:
-        raise NotImplementedError(
-            "eager alltoall splits/concats along dim 0; transpose first or "
-            "use the traced path for other axes"
-        )
+    x = jnp.asarray(tensor)
+    if size() == 1:
+        return x
     from horovod_tpu.runtime import eager
 
-    return eager.alltoall(tensor, name=name)
+    if split_axis == 0 and concat_axis == 0:
+        return eager.alltoall(x, name=name)  # wire semantics, copy-free
+    moved = jnp.moveaxis(x, split_axis, 0)
+    z = eager.alltoall(moved, name=name)
+    # z: size() received blocks stacked along dim 0, each the moved shape
+    # with dim 0 shrunk by size().  Restore each block's axis order, then
+    # concatenate where the caller asked.
+    blocks = jnp.split(z, size(), axis=0)
+    blocks = [jnp.moveaxis(b, 0, split_axis) for b in blocks]
+    return jnp.concatenate(blocks, axis=concat_axis)
 
 
 # ---------------------------------------------------------------------------

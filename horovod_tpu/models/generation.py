@@ -94,7 +94,10 @@ def _forward(cfg, p, ids, caches_k, caches_v, *, pos0, k_len):
         new_k.append(ck)
         new_v.append(cv)
     x = _rms(x, p["norm_f"]["scale"], cfg.rms_eps)
-    logits = x @ p["lm_head"]["kernel"].astype(jnp.float32)
+    # Same head dtype as LlamaModel (cfg.logits_dtype) so cached decode
+    # is logit-exact against model.apply.
+    logits = (x.astype(cfg.logits_dtype)
+              @ p["lm_head"]["kernel"].astype(cfg.logits_dtype))
     return logits, jnp.stack(new_k), jnp.stack(new_v)
 
 

@@ -7,7 +7,9 @@ parallelism axis the framework supports: data, fsdp, tensor, sequence
 (ring attention), pipeline, and expert (MoE variant).
 
 TPU-first design:
-* RMSNorm in fp32, everything else bf16; logits in fp32.
+* RMSNorm in fp32, everything else bf16 — including logits
+  (``logits_dtype``): the loss upcasts per-tile inside its reductions,
+  so no logits-sized f32 tensor is ever stored (ops/losses.py).
 * RoPE applied on-the-fly (no position-embedding table to shard).
 * GQA: ``num_kv_heads <= num_heads`` — shrinks the KV all-gather under
   tensor parallelism.
@@ -45,6 +47,12 @@ class LlamaConfig:
     num_experts: int = 1          # >1 enables MoE
     experts_per_token: int = 2
     dtype: Any = jnp.bfloat16
+    # Output-head compute dtype.  bf16 keeps every logits-sized tensor —
+    # the forward residual AND the cross-entropy cotangent, 2 GB each in
+    # f32 at B=8/S=2048/V=32k — in half the bytes; the loss
+    # (ops/losses.py) upcasts per-tile inside its reductions, so lse and
+    # loss stay f32-accurate.  Set to jnp.float32 to save f32 logits.
+    logits_dtype: Any = jnp.bfloat16
     # Fused Pallas RMSNorm (see RMSNorm.fused): enable on shard_map /
     # single-device paths; leave off under GSPMD.
     fused_rmsnorm: bool = False
@@ -246,6 +254,6 @@ class LlamaModel(nn.Module):
                            name=f"layer_{i}")(x, cos, sin)
         x = RMSNorm(cfg.rms_eps, cfg.dtype, cfg.fused_rmsnorm,
                     name="norm_f")(x)
-        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                          name="lm_head")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=cfg.logits_dtype, name="lm_head")(x)
         return logits

@@ -4,12 +4,24 @@ No reference equivalent (the reference has no loss library); this exists
 because the naive causal-LM loss — ``log_softmax`` then gather —
 materializes a full fp32 log-probability tensor the size of the logits
 ([B, S, V]; 2 GB at B=8, S=2048, V=32k) and then re-reads it, making the
-loss a multi-gigabyte HBM round trip.  ``softmax_cross_entropy`` computes
-``logsumexp(logits) - logits[target]`` instead: XLA fuses the fp32
-convert into the reduction passes over the (bf16) logits and no
-logits-sized fp32 tensor is ever written.  Same math, same gradients
-(d/dlogits = softmax - onehot via autodiff of the lse), measured ~4%
-step-time win on the 400M-param Llama bench config on one v5e.
+loss a multi-gigabyte HBM round trip.
+
+``softmax_cross_entropy`` computes ``logsumexp(logits) - logits[target]``
+with a custom VJP whose residuals are the logits AS GIVEN (bf16 when the
+model's head emits bf16 — ``LlamaConfig.logits_dtype``) plus the tiny
+fp32 lse ``[B, S]``:
+
+* forward: the fp32 upcast fuses into the reduction passes over the
+  logits, so the only logits-sized tensor in memory is the model's own
+  output;
+* backward: ``softmax - onehot`` is recomputed from those residuals and
+  the cotangent is emitted in the logits dtype, so the grad matmuls
+  (dW, dX) read half-width operands.
+
+Versus plain autodiff of the lse form (which stores an f32 copy of the
+logits and emits an f32 cotangent), this halves every logits-sized
+tensor's bytes when the head computes in bf16.  Same math; gradients
+match autodiff to bf16 rounding (tests/test_losses.py).
 """
 
 from __future__ import annotations
@@ -18,6 +30,49 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["softmax_cross_entropy"]
+
+
+def _nll_impl(logits, targets):
+    # Hand-rolled logsumexp: max and gather read the logits dtype
+    # directly, and the f32 upcast has exactly ONE consumer (the exp-sum
+    # reduce), so XLA fuses the convert into the reduction pass instead
+    # of materializing an f32 copy of the logits for multiple readers
+    # (profiled: jax.nn.logsumexp over the upcast wrote an f32 [B,S,V]).
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1)).astype(jnp.float32)
+    s = jnp.sum(jnp.exp(logits.astype(jnp.float32) - m[..., None]), axis=-1)
+    lse = m + jnp.log(s)
+    tgt = jnp.take_along_axis(
+        logits, targets[..., None].astype(jnp.int32),
+        axis=-1)[..., 0].astype(jnp.float32)
+    return lse - tgt, lse
+
+
+@jax.custom_vjp
+def _nll(logits, targets):
+    """Per-token negative log-likelihood [...], from logits [..., V]."""
+    return _nll_impl(logits, targets)[0]
+
+
+def _nll_fwd(logits, targets):
+    nll, lse = _nll_impl(logits, targets)
+    return nll, (logits, targets, lse)
+
+
+def _nll_bwd(res, g):
+    logits, targets, lse = res
+    # softmax recomputed from the saved (possibly bf16) logits + f32 lse;
+    # the onehot subtraction fuses as iota==target, so nothing V-sized
+    # materializes beyond the returned cotangent — which is emitted in
+    # the logits dtype so the downstream dW/dX matmuls read half-width.
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, logits.shape,
+                                       logits.ndim - 1)
+              == targets[..., None].astype(jnp.int32))
+    d = (p - onehot.astype(jnp.float32)) * g[..., None].astype(jnp.float32)
+    return d.astype(logits.dtype), None
+
+
+_nll.defvjp(_nll_fwd, _nll_bwd)
 
 
 def softmax_cross_entropy(logits, targets, *, where=None,
@@ -32,11 +87,9 @@ def softmax_cross_entropy(logits, targets, *, where=None,
     """
     if reduction not in ("mean", "sum"):
         raise ValueError(f"unknown reduction {reduction!r}")
-    logits32 = logits.astype(jnp.float32)
-    lse = jax.nn.logsumexp(logits32, axis=-1)
-    tgt = jnp.take_along_axis(
-        logits32, targets[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    nll = lse - tgt
+    # Reverse-mode only: the custom_vjp that keeps the residuals bf16
+    # forfeits forward-mode AD (jax.jvp/jax.hessian over this op raise).
+    nll = _nll(logits, targets)
     if where is not None:
         nll = jnp.where(where, nll, 0.0)
     if reduction == "sum":

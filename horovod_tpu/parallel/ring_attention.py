@@ -143,14 +143,14 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq",
     qh = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kh = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vh = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    from horovod_tpu.models.llama import causal_attention
+    # The local attention runs over the FULL sequence — exactly where the
+    # Pallas flash kernel earns its keep (the dense path materializes
+    # [B, H, S, S] scores).  shard_map bodies are Manual-mesh, so the
+    # kernel lowers legally here; unsupported shapes fall back to the
+    # dense path inside flash_attention with a counted warning.
+    from horovod_tpu.ops.flash_attention import flash_attention
 
-    if causal:
-        out = causal_attention(qh, kh, vh)
-    else:
-        from horovod_tpu.models.bert import dot_product_attention
-
-        out = dot_product_attention(qh, kh, vh)
+    out = flash_attention(qh, kh, vh, causal=causal)
     # [B, S_full, H/P, D] -> [B, S_loc, H, D]
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)

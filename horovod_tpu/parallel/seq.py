@@ -29,7 +29,7 @@ __all__ = ["make_context_parallel_train_step"]
 def make_context_parallel_train_step(cfg: LlamaConfig, optimizer,
                                      mesh: Mesh, *,
                                      seq_axis: str = "seq",
-                                     attention: str = "ring",
+                                     attention: str = "auto",
                                      donate: bool = True):
     """Jitted LM train step with sequence sharded over ``seq_axis`` and
     batch sharded over the data-like axes.
@@ -37,8 +37,12 @@ def make_context_parallel_train_step(cfg: LlamaConfig, optimizer,
     ``step(params, opt_state, inputs, targets) ->
     (params, opt_state, loss)`` where inputs/targets are [B, S] token ids
     (S divisible by the seq-axis size, B by the data axes' product).
-    ``attention``: "ring" (blockwise ppermute ring) or "ulysses"
-    (all-to-all head scatter).
+    ``attention``: "ulysses" (all-to-all head scatter; the local
+    full-sequence attention runs the Pallas flash kernel — shard_map
+    bodies are Manual-mesh, so it lowers legally), "ring" (blockwise
+    ppermute ring; scales sequence past what one chip's heads allow), or
+    "auto" (default): ulysses whenever the head counts divide the
+    ``seq_axis`` size — the flash-backed path — ring otherwise.
     """
     import optax
 
@@ -46,6 +50,11 @@ def make_context_parallel_train_step(cfg: LlamaConfig, optimizer,
     from horovod_tpu.parallel.mesh import data_axes
     from horovod_tpu.parallel.ring_attention import ulysses_attention
 
+    if attention == "auto":
+        seq_size = mesh.shape[seq_axis]
+        heads_divide = (cfg.num_heads % seq_size == 0
+                        and cfg.num_kv_heads % seq_size == 0)
+        attention = "ulysses" if heads_divide else "ring"
     if attention == "ring":
         attention_fn = make_ring_attention_fn(seq_axis)
     elif attention == "ulysses":

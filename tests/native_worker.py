@@ -286,6 +286,29 @@ def scenario_worker_death(rank, size, eng):
     raise AssertionError("expected HorovodInternalError after peer death")
 
 
+def scenario_wedged_peer(rank, size, eng):
+    # A peer that is ALIVE but has stopped cycling (its cycle time is
+    # cranked to 20 s in main(), vs the survivors' 2 ms): the coordinator
+    # must burn its control patience LOUDLY — a "still waiting on control
+    # frame from rank k" warning per idle timeout (socket.cc
+    # RecvAllPatient) — then abort descriptively instead of stalling
+    # silently for the whole patience window.
+    import time
+
+    if rank == size - 1:
+        time.sleep(8)   # outlive the survivors' abort, prove we never died
+        os._exit(0)     # skip the shutdown handshake; coordinator is gone
+    x = np.full((8,), float(rank + 1), dtype=np.float32)
+    try:
+        eng.allreduce(x, name="stalled")
+    except HorovodInternalError as e:
+        msg = str(e)
+        assert ("lost connection" in msg or "could not reach" in msg
+                or "disconnected" in msg), msg
+        return
+    raise AssertionError("expected an abort while a peer is wedged")
+
+
 SCENARIOS = {
     "allreduce": scenario_allreduce,
     "fused": scenario_fused,
@@ -303,6 +326,7 @@ SCENARIOS = {
     "mixed_stress": scenario_mixed_stress,
     "restart": scenario_restart,
     "worker_death": scenario_worker_death,
+    "wedged_peer": scenario_wedged_peer,
     "all": None,
 }
 
@@ -337,6 +361,13 @@ def main():
         basics.shutdown()
         print(f"worker rank={world_rank} OK", flush=True)
         return
+    if scenario == "wedged_peer":
+        wr, ws = int(os.environ["HOROVOD_RANK"]), int(
+            os.environ["HOROVOD_SIZE"])
+        if wr == ws - 1:
+            # Wedge THIS rank: its background loop wakes every 20 s, so
+            # its control frames stop arriving at the coordinator.
+            os.environ["HOROVOD_CYCLE_TIME"] = "20000"
     basics.init()
     rank, size = basics.rank(), basics.size()
     eng = get_engine()

@@ -127,6 +127,7 @@ def test_llama_with_flash_attention():
     from horovod_tpu.ops.flash_attention import flash_attention_fn
 
     cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                               logits_dtype=jnp.float32,
                               hidden_size=512, num_heads=4, num_kv_heads=4)
     ids = jax.random.randint(jax.random.key(0), (2, 256), 0, cfg.vocab_size)
     dense = LlamaModel(cfg)

@@ -77,3 +77,16 @@ def test_sum_reduction():
     import pytest
     with pytest.raises(ValueError, match="reduction"):
         softmax_cross_entropy(logits, targets, reduction="nope")
+
+
+def test_bf16_grads_match_autodiff_and_keep_dtype():
+    """The custom VJP's bf16 cotangent (half-width residuals + grad
+    matmuls, the whole point of the op) matches fp32 autodiff to bf16
+    rounding."""
+    logits, targets = _data(dtype=jnp.bfloat16, seed=6)
+    g_bf = jax.grad(lambda l: softmax_cross_entropy(l, targets))(logits)
+    assert g_bf.dtype == jnp.bfloat16
+    g_ref = jax.grad(
+        lambda l: _naive(l, targets))(logits.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(g_bf, np.float32),
+                               np.asarray(g_ref), atol=2e-3, rtol=2e-2)

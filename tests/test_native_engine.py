@@ -220,3 +220,18 @@ def test_timeline_written(tmp_path):
     assert "RING_ALLREDUCE" in names or "RING_BROADCAST" in names
     cats = {e.get("cat") for e in events if "cat" in e}
     assert "NEGOTIATE" in cats and "ACTIVITY" in cats
+
+
+def test_wedged_peer_warns_while_patience_burns():
+    """A live-but-wedged peer must produce periodic 'still waiting on
+    control frame from rank k' warnings on the coordinator while
+    HOROVOD_CONTROL_PATIENCE_SEC burns down, then the descriptive abort
+    (reference stall-warning cadence, operations.cc:1366-1412, applied
+    to transport waits)."""
+    results = run_workers(3, "wedged_peer", timeout=60, extra_env={
+        "HOROVOD_SOCKET_TIMEOUT_SEC": "1",
+        "HOROVOD_CONTROL_PATIENCE_SEC": "3",
+    })
+    rank0_err = results[0][1].decode()
+    assert "still waiting on control frame from rank 2" in rank0_err, \
+        rank0_err

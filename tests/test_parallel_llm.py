@@ -20,6 +20,7 @@ from horovod_tpu.parallel.seq import make_context_parallel_train_step
 
 def _cfg(num_layers=2):
     return dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                               logits_dtype=jnp.float32,
                                num_layers=num_layers)
 
 

@@ -99,7 +99,7 @@ def test_llama_with_ring_attention_matches_dense(n_devices):
 
     import dataclasses
 
-    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32, logits_dtype=jnp.float32)
     mesh = hvd.build_mesh({"seq": 4}, devices=jax.devices()[:4])
     ids = jax.random.randint(jax.random.key(0), (2, 32), 0, cfg.vocab_size)
 
@@ -123,3 +123,52 @@ def test_llama_with_ring_attention_matches_dense(n_devices):
     got = sharded_fwd(params, ids)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_ulysses_local_attention_is_flash(n_devices):
+    """Flash-legal head dims (D % 64 == 0): the ulysses local attention
+    runs the Pallas kernel — asserted structurally in the jaxpr — and
+    matches the dense reference (round-3 VERDICT item 5: flash by
+    default on shard_map paths)."""
+    mesh = hvd.build_mesh({"seq": 2}, devices=jax.devices()[:2])
+    q, k, v = _rand_qkv(B=1, S=128, H=4, Hkv=4, D=64, seed=5)
+    fn = _shard_over_seq(
+        functools.partial(ulysses_attention, axis_name="seq"), mesh)
+    jaxpr = jax.make_jaxpr(fn)(q, k, v)
+    assert "pallas_call" in str(jaxpr)
+    from horovod_tpu.ops import flash_attention as fa
+    before = fa.fallback_count()
+    got = fn(q, k, v)
+    assert fa.fallback_count() == before  # the kernel path, no fallback
+    expected = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_context_parallel_auto_selects_flash(n_devices):
+    """make_context_parallel_train_step(attention="auto") picks the
+    flash-backed ulysses path when heads divide the seq axis: the
+    compiled step's jaxpr contains the Pallas call."""
+    import dataclasses
+
+    import optax
+
+    from horovod_tpu.models.llama import LlamaConfig
+    from horovod_tpu.parallel.seq import make_context_parallel_train_step
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), hidden_size=256,
+                              num_heads=4, num_kv_heads=2)
+    assert cfg.head_dim == 64
+    mesh = hvd.build_mesh({"seq": 2}, devices=jax.devices()[:2])
+    step = make_context_parallel_train_step(cfg, optax.sgd(1e-2), mesh,
+                                            donate=False)
+    from horovod_tpu.models.llama import LlamaModel
+
+    ids = jnp.zeros((2, 128), jnp.int32)
+    params = LlamaModel(cfg).init(jax.random.key(0), ids)
+    opt_state = optax.sgd(1e-2).init(params)
+    jaxpr = jax.make_jaxpr(step)(params, opt_state, ids, ids)
+    assert "pallas_call" in str(jaxpr)
+    # and it runs
+    params, opt_state, loss = step(params, opt_state, ids, ids)
+    assert np.isfinite(float(loss))
