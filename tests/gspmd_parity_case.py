@@ -1,0 +1,45 @@
+"""The SHARED tiny GSPMD training program for the multi-controller
+parity test: tests/jaxdist_worker.py runs it across 2 processes x 2
+devices, tests/test_jax_distributed.py runs it single-process on 4
+virtual devices, and the assertion that the losses match is only
+meaningful because both sides execute THIS function byte-for-byte.
+Side-effect-free on import (the worker mutates os.environ; this module
+must not)."""
+
+
+def run_tiny_gspmd_train(mesh_devices=None):
+    """Three adamw steps of the tiny f32 Llama on a data x fsdp = 2 x 2
+    mesh; returns the per-step losses as floats."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.models import LlamaConfig, LlamaModel
+    from horovod_tpu.parallel.api import (make_parallel_train_step,
+                                          shard_params)
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32,
+                              logits_dtype=jnp.float32)
+    mesh = hvd.build_mesh({"data": 2, "fsdp": 2}, devices=mesh_devices)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(42)
+    tokens_np = rng.integers(0, cfg.vocab_size, (8, 33), dtype=np.int32)
+
+    with hvd.use_mesh(mesh):
+        ids = jnp.zeros((8, 32), jnp.int32)
+        params = shard_params(
+            jax.jit(lambda: model.init(jax.random.key(0), ids))(), mesh)
+        opt = optax.adamw(1e-3)
+        step = make_parallel_train_step(model, opt, mesh)
+        opt_state = jax.jit(opt.init)(params)
+        tokens = jax.device_put(tokens_np, NamedSharding(mesh, P()))
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+    return losses

@@ -13,7 +13,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "jaxdist_worker.py")
 
 
-def test_jax_distributed_bootstrap_two_processes():
+def _run_jaxdist(scenario, timeout=240):
     port = _free_port()
     jax_port = _free_port()  # explicit: the derived port+64 may be taken
     procs = []
@@ -29,13 +29,50 @@ def test_jax_distributed_bootstrap_two_processes():
             "PALLAS_AXON_POOL_IPS": "",
         })
         procs.append(subprocess.Popen(
-            [sys.executable, WORKER],
+            [sys.executable, WORKER, scenario],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         ))
-    results = [p.communicate(timeout=180) for p in procs]
+    try:
+        results = [p.communicate(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for rank, (p, (out, err)) in enumerate(zip(procs, results)):
         assert p.returncode == 0, (
             f"rank {rank} failed (rc={p.returncode}):\n"
             f"stdout: {out.decode()}\nstderr: {err.decode()}"
         )
         assert b"OK" in out
+    return results
+
+
+def test_jax_distributed_bootstrap_two_processes():
+    _run_jaxdist("bootstrap")
+
+
+def test_gspmd_train_step_two_processes_matches_single():
+    """make_parallel_train_step across 2 processes x 2 devices (4-device
+    data x fsdp mesh via jax.distributed): both ranks observe identical
+    losses, and they match the SAME step run single-process on a 4-device
+    mesh — multi-controller GSPMD is numerically the same program
+    (round-3 VERDICT item 6)."""
+    results = _run_jaxdist("gspmd_step")
+    losses = []
+    for out, _err in results:
+        for line in out.decode().splitlines():
+            if line.startswith("LOSSES "):
+                losses.append([float(x) for x in line.split()[1:]])
+    assert len(losses) == 2, results
+    assert losses[0] == losses[1], losses
+
+    # Single-process reference on 4 of this process's virtual devices —
+    # the SAME program the workers ran (shared module, cannot drift).
+    import jax
+    import numpy as np
+
+    from tests.gspmd_parity_case import run_tiny_gspmd_train
+
+    ref = run_tiny_gspmd_train(mesh_devices=jax.devices()[:4])
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-5, atol=1e-6)
