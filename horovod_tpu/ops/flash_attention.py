@@ -131,8 +131,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, sm_scale,
         # Query block qi covers rows [qi*bq, (qi+1)*bq); KV blocks fully
         # above the diagonal contribute nothing — bound the loop instead
         # of masking.
+        # ceil((qi+1)*bq / bk): every KV block touching or below the
+        # diagonal, valid for ANY bq/bk ratio (bq < bk included).
         n_kv_live = jnp.minimum(
-            (qi * block_q) // block_k + block_q // block_k, n_kv)
+            ((qi + 1) * block_q + block_k - 1) // block_k, n_kv)
     else:
         n_kv_live = n_kv
     kv_first = 0
@@ -276,8 +278,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     n_kv = s // block_k
     if causal:
+        # ceil((qi+1)*bq / bk): every KV block touching or below the
+        # diagonal, valid for ANY bq/bk ratio (bq < bk included).
         n_kv_live = jnp.minimum(
-            (qi * block_q) // block_k + block_q // block_k, n_kv)
+            ((qi + 1) * block_q + block_k - 1) // block_k, n_kv)
     else:
         n_kv_live = n_kv
     kv_first = 0

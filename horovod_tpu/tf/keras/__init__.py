@@ -1,0 +1,13 @@
+"""Reference-style import alias: ``horovod.tensorflow.keras`` users
+import ``horovod_tpu.tf.keras``.
+
+Reference parity: ``horovod/tensorflow/keras/__init__.py`` is a thin
+re-export of the same impl as ``horovod/keras`` (SURVEY.md §2.2 P10, a
+byte-level near-copy of P8).  Here the real implementation lives in
+``horovod_tpu.keras`` (Keras 3, multi-backend — on TF 2.21 ``tf.keras``
+IS Keras 3, so one frontend serves both import styles); this module
+re-exports it under the familiar path.
+"""
+
+from horovod_tpu.keras import *                    # noqa: F401,F403
+from horovod_tpu.keras import callbacks, __all__   # noqa: F401

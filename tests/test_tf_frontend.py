@@ -143,3 +143,14 @@ def test_distributed_optimizer_wraps_v1():
     opt = hvd.DistributedOptimizer(
         tf.compat.v1.train.GradientDescentOptimizer(0.1))
     assert opt.get_slot_names() == []
+
+
+def test_tf_keras_alias_module():
+    """horovod_tpu.tf.keras mirrors the reference's horovod.tensorflow.keras
+    import path, re-exporting the Keras-3 frontend."""
+    import horovod_tpu.keras as real
+    import horovod_tpu.tf.keras as alias
+
+    assert alias.DistributedOptimizer is real.DistributedOptimizer
+    assert alias.load_model is real.load_model
+    assert alias.callbacks is real.callbacks

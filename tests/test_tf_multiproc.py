@@ -39,3 +39,7 @@ def test_tf_keras_training_loop_equalizes():
 
 def test_tf_v1_session_hook_and_optimizer():
     run_tf_workers(2, "v1_session")
+
+
+def test_tf_v1_sparse_indexed_slices_gradients():
+    run_tf_workers(2, "v1_sparse")

@@ -217,6 +217,31 @@ def scenario_v1_session(rank, size):
         np.testing.assert_array_equal(arr[r], flat)
 
 
+def scenario_v1_sparse(rank, size):
+    # The reference's TF sparse path (tensorflow/__init__.py:67-78):
+    # embedding_lookup yields IndexedSlices gradients; DistributedOptimizer
+    # compute_gradients allreduces them as allgathered values+indices, and
+    # apply_gradients scatter-applies the gathered (duplicate-index) rows.
+    tf.compat.v1.disable_eager_execution()
+    emb = tf.compat.v1.get_variable(
+        "emb", [4, 3], initializer=tf.compat.v1.ones_initializer())
+    picked = tf.nn.embedding_lookup(emb, tf.constant([rank % 4]))
+    loss = tf.reduce_sum(picked)
+    opt = hvd.DistributedOptimizer(
+        tf.compat.v1.train.GradientDescentOptimizer(1.0))
+    gvs = opt.compute_gradients(loss, var_list=[emb])
+    assert isinstance(gvs[0][0], tf.IndexedSlices), gvs
+    train = opt.apply_gradients(gvs)
+    with tf.compat.v1.Session() as s:
+        s.run(tf.compat.v1.global_variables_initializer())
+        s.run(train)
+        w = s.run(emb)
+    expected = np.ones((4, 3), np.float32)
+    for r in range(size):
+        expected[r % 4] -= 1.0 / size  # averaged sparse contribution
+    np.testing.assert_allclose(w, expected, rtol=1e-6)
+
+
 SCENARIOS = {
     "ops": scenario_ops,
     "grads": scenario_grads,
@@ -224,6 +249,7 @@ SCENARIOS = {
     "sparse": scenario_sparse,
     "keras_loop": scenario_keras_loop,
     "v1_session": scenario_v1_session,
+    "v1_sparse": scenario_v1_sparse,
 }
 
 
