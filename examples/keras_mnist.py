@@ -1,12 +1,15 @@
 """MNIST with the Keras-3 frontend (JAX backend by default).
 
-Role parity with reference ``examples/keras_mnist.py``: lr scaled by
-world size (ref :25), ``DistributedOptimizer`` wrap (ref :28),
-BroadcastGlobalVariables + MetricAverage callbacks (ref :33-40), rank-0
-checkpointing, and the ``load_model`` resume pattern (ref
-keras_imagenet_resnet50.py:74-78).  The train step runs jitted by the
-Keras JAX trainer; gradient averaging rides an io_callback into the
-native engine (horovod_tpu/keras/impl.py).
+Role parity with reference ``examples/keras_mnist.py`` AND the
+``keras_mnist_advanced.py`` callback stack: lr scaled by world size
+(ref :25), ``DistributedOptimizer`` wrap (ref :28),
+BroadcastGlobalVariables + MetricAverage callbacks (advanced :87-93),
+gradual LR warmup feeding a ReduceLROnPlateau that acts on AVERAGED
+metrics (advanced :98-101 — the interplay is why MetricAverage must run
+before plateau), rank-0 checkpointing, and the ``load_model`` resume
+pattern (ref keras_imagenet_resnet50.py:74-78).  The train step runs
+jitted by the Keras JAX trainer; gradient averaging rides an
+io_callback into the native engine (horovod_tpu/keras/impl.py).
 """
 
 import os
@@ -58,15 +61,23 @@ def main():
             metrics=["accuracy"],
         )
 
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        # Order matters: metric averaging must rewrite logs BEFORE the
+        # plateau scheduler reads them, so every rank reduces lr on the
+        # same (global) signal (reference keras_mnist_advanced.py:93-101).
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            warmup_epochs=1 if args.smoke else 3, verbose=hvd.rank() == 0),
+        keras.callbacks.ReduceLROnPlateau(monitor="loss", patience=2,
+                                          factor=0.5, verbose=0),
+    ]
     model.fit(
         images, labels.astype(np.int32),
         batch_size=args.batch_size,
         epochs=1 if args.smoke else args.epochs,
         verbose=2 if hvd.rank() == 0 else 0,
-        callbacks=[
-            hvd.callbacks.BroadcastGlobalVariablesCallback(0),
-            hvd.callbacks.MetricAverageCallback(),
-        ],
+        callbacks=callbacks,
     )
     if ckpt_file and hvd.rank() == 0:
         os.makedirs(ckpt, exist_ok=True)
