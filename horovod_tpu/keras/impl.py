@@ -29,7 +29,7 @@ from __future__ import annotations
 import numpy as np
 
 from horovod_tpu.common.basics import basics
-from horovod_tpu.runtime.eager import _engine
+from horovod_tpu.runtime import engine_or_none as _engine
 
 _COMPRESS_WIRE = {"none": None, "fp16": np.float16, "bf16": "bf16"}
 

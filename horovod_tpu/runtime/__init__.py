@@ -1,1 +1,14 @@
 """Host-side runtime: eager collectives, negotiation engine bridge."""
+
+
+def engine_or_none():
+    """The native multi-process engine, or None at size 1 (every caller's
+    size-1 fast path).  Lives here, jax-free, so the torch/tf frontends
+    can share it without pulling jax into their worker processes."""
+    from horovod_tpu.common.basics import basics
+
+    if basics.size() == 1:
+        return None
+    from horovod_tpu.runtime.engine import get_engine
+
+    return get_engine()

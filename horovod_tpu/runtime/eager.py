@@ -46,13 +46,7 @@ _WIRE_OPS = {Sum: "sum", Average: "sum", Min: "min", Max: "max",
              Product: "prod"}
 
 
-def _engine():
-    """The native multi-process engine (None at size 1)."""
-    if basics.size() == 1:
-        return None
-    from horovod_tpu.runtime import engine
-
-    return engine.get_engine()
+from horovod_tpu.runtime import engine_or_none as _engine  # noqa: E402
 
 
 def allreduce(tensor, *, op=Average, average=None,

@@ -39,6 +39,7 @@ import numpy as np
 import tensorflow as tf
 
 from horovod_tpu.common.basics import basics
+from horovod_tpu.runtime import engine_or_none as _engine
 
 __all__ = [
     "init", "shutdown", "size", "rank", "local_size", "local_rank",
@@ -51,14 +52,6 @@ rank = basics.rank
 size = basics.size
 local_rank = basics.local_rank
 local_size = basics.local_size
-
-
-def _engine():
-    if basics.size() == 1:
-        return None
-    from horovod_tpu.runtime.engine import get_engine
-
-    return get_engine()
 
 
 def _normalize_name(name: str) -> str:

@@ -23,6 +23,7 @@ import numpy as np
 import torch
 
 from horovod_tpu.common.basics import basics
+from horovod_tpu.runtime import engine_or_none as _engine
 
 __all__ = [
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
@@ -41,14 +42,6 @@ rank = basics.rank
 size = basics.size
 local_rank = basics.local_rank
 local_size = basics.local_size
-
-
-def _engine():
-    if basics.size() == 1:
-        return None
-    from horovod_tpu.runtime.engine import get_engine
-
-    return get_engine()
 
 
 def _np_view(t: torch.Tensor) -> np.ndarray:
