@@ -67,9 +67,11 @@ def run_workers(n, scenario, extra_env=None, timeout=90, expected_rc=None,
     return results
 
 
-@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize("n", [2, 4, 8])
 def test_allreduce_identity(n):
-    run_workers(n, "allreduce")
+    # n=8: the widest ring this host exercises — catches off-by-one ring
+    # arithmetic (segment splits, neighbor indices) that 2/4 ranks mask.
+    run_workers(n, "allreduce", timeout=180)
 
 
 def test_fused_allreduce():
