@@ -44,7 +44,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "flash_attention_fn", "fallback_count"]
+__all__ = ["flash_attention", "flash_attention_fn", "flash_attention_lse",
+           "flash_lse_supported", "fallback_count"]
 
 # Dense-fallback observability: a production config one head-dim off the
 # kernel tiling should not silently lose the kernel's speedup.  Each
@@ -392,11 +393,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
-def _bwd_impl(causal, sm_scale, res, do, bias=None, seg=None):
+def _bwd_impl(causal, sm_scale, res, do, bias=None, seg=None, g_lse=None):
     q, k, v, out, lse = res
     bh, s, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # [BH, S]
+    if g_lse is not None:
+        # lse cotangent folds into delta: dL/ds_ij = p_ij * (dp_ij -
+        # delta_i + g_lse_i), so delta_eff = delta - g_lse feeds the
+        # UNCHANGED backward kernels (dv = p^T do has no lse term).
+        delta = delta - g_lse.astype(jnp.float32)
     # Same sublane-replicated [BH, 8, S] layout as lse (TPU block tiling).
     delta = jnp.broadcast_to(delta[:, None, :], delta.shape[:1] + (8,)
                              + delta.shape[1:])
@@ -468,6 +474,74 @@ def _flash_fwd(q, k, v, causal, sm_scale):
 
 
 _flash.defvjp(_flash_fwd, _bwd)
+
+
+def _flat_layout(q, k, v):
+    """[B, S, H, D] -> the kernels' flat [B*H, S, D] operands, GQA KV
+    heads repeated to Hq (shared by both public entry points)."""
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != Hq:
+        k = jnp.repeat(k, Hq // Hkv, axis=2)
+        v = jnp.repeat(v, Hq // Hkv, axis=2)
+
+    def t(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
+
+    return t(q), t(k), t(v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_lse(q, k, v, causal, sm_scale):
+    """Like ``_flash`` but ALSO returns the per-row log-sum-exp [BH, S]
+    as a differentiable output — the merge statistic blockwise consumers
+    (ring attention) need to combine partial attentions."""
+    out, lse = _fwd(q, k, v, causal, sm_scale)
+    return out, lse[:, 0, :]
+
+
+def _flash_lse_fwd(q, k, v, causal, sm_scale):
+    out, lse = _fwd(q, k, v, causal, sm_scale)
+    return (out, lse[:, 0, :]), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, sm_scale, res, cts):
+    do, g_lse = cts
+    return _bwd_impl(causal, sm_scale, res, do, g_lse=g_lse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_lse(q, k, v, *, causal: bool = True):
+    """Flash attention returning ``(out [B,S,H,D], lse [B,H,S] fp32)``.
+
+    The lse output makes partial attentions COMPOSABLE: blockwise
+    consumers (ring attention over a sequence-sharded mesh) merge per-
+    block results as ``out = sum_t exp(lse_t - logsumexp_t(lse)) out_t``
+    and AD flows through both outputs (the lse cotangent folds into the
+    backward kernels' delta sideband — see ``_bwd_impl``).
+
+    Kernel-only surface: requires D % 64 == 0 and S % 128 == 0 (no
+    dense fallback, no padding — callers check ``flash_lse_supported``
+    and keep their own fallback, since a silent dense path would defeat
+    the memory contract the caller is composing for).
+    """
+    B, S, Hq, D = q.shape
+    if not flash_lse_supported(S, D):
+        raise ValueError(
+            f"flash_attention_lse requires D % 64 == 0 and S % 128 == 0, "
+            f"got S={S}, D={D}; gate on flash_lse_supported()")
+    sm_scale = 1.0 / math.sqrt(D)
+    qt, kt, vt = _flat_layout(q, k, v)
+    out, lse = _flash_lse(qt, kt, vt, causal, sm_scale)
+    return (out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3),
+            lse.reshape(B, Hq, S))
+
+
+def flash_lse_supported(S: int, D: int) -> bool:
+    """Shapes the lse-returning kernel path accepts (no padding shim)."""
+    return D % 64 == 0 and S % 128 == 0 and _pick_block(S, BLOCK_Q) > 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -628,14 +702,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
         return flash_attention(
             q, k, v, causal=causal, key_padding_mask=key_padding_mask,
             segment_ids=segment_ids)[:, :S]
-    if Hkv != Hq:
-        k = jnp.repeat(k, Hq // Hkv, axis=2)
-        v = jnp.repeat(v, Hq // Hkv, axis=2)
     sm_scale = 1.0 / math.sqrt(D)
-    # [B, S, H, D] -> [B*H, S, D]
-    qt = q.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
-    kt = k.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
-    vt = v.transpose(0, 2, 1, 3).reshape(B * Hq, S, D)
+    qt, kt, vt = _flat_layout(q, k, v)
     if segment_ids is not None:
         starts = _segment_starts(jnp.asarray(segment_ids))
         # [B, S] -> [B, 8, S]: sublane-replicated (TPU tiling); heads are

@@ -53,13 +53,71 @@ def _block_attend(q, k, v, mask):
     return scores
 
 
+def _ring_attention_flash(q, k, v, axis_name, causal):
+    """Ring attention with the Pallas flash kernel as the per-hop block
+    attention: each hop computes ``(out_t, lse_t)`` via
+    ``flash_attention_lse`` and the hops merge by log-sum-exp weights —
+    so no [B, H, S_loc, S_loc] fp32 score block ever materializes, per
+    hop memory is O(S_loc * D), and AD flows through both kernel outputs
+    (the lse cotangent rides the backward kernels' delta sideband).
+
+    Hop visibility under causality is BLOCK-level: hop t carries the KV
+    block of shard ``src = (my - t) mod n``; t == 0 is the causal
+    diagonal (static flag), src < my is fully visible, src > my is
+    killed by setting its lse to -inf (weight 0 in the merge — the
+    compute still runs, matching the XLA path's lockstep cost).
+    """
+    from horovod_tpu.ops.flash_attention import flash_attention_lse
+
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    # Online pairwise merge: O(1)-hop accumulators, like the XLA path
+    # below — never a [T, ...] stack of hop outputs.
+    out_acc = lse_acc = None                        # f32 [B,Sq,H,D]/[B,H,Sq]
+    k_blk, v_blk = k, v
+    for t in range(axis_size):
+        o_t, lse_t = flash_attention_lse(q, k_blk, v_blk,
+                                         causal=(causal and t == 0))
+        o_t = o_t.astype(jnp.float32)
+        if causal and t > 0:
+            src = (my_idx - t) % axis_size
+            lse_t = jnp.where(src < my_idx, lse_t, -jnp.inf)
+        if t == 0:
+            # The t=0 hop (the causal diagonal) is never masked, so the
+            # accumulators start finite.
+            out_acc, lse_acc = o_t, lse_t
+        else:
+            new_lse = jnp.logaddexp(lse_acc, lse_t)  # -inf hops: no-op
+            w_old = jnp.exp(lse_acc - new_lse)
+            w_new = jnp.exp(lse_t - new_lse)
+            out_acc = (out_acc * jnp.moveaxis(w_old, 1, 2)[..., None]
+                       + o_t * jnp.moveaxis(w_new, 1, 2)[..., None])
+            lse_acc = new_lse
+        if t < axis_size - 1:
+            k_blk = lax.ppermute(k_blk, axis_name, perm)
+            v_blk = lax.ppermute(v_blk, axis_name, perm)
+    return out_acc.astype(q.dtype)
+
+
 def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     """Blockwise attention with KV rotating around the ``axis_name`` ring.
 
     Shapes (per shard): q [B, S_loc, H, D]; k, v [B, S_loc, Hkv, D] with
     H % Hkv == 0 (GQA).  Sequence order is the natural shard order: shard
     ``i`` holds positions [i*S_loc, (i+1)*S_loc).  Returns [B, S_loc, H, D].
+
+    When the local shard fits the flash kernel (D % 64 == 0, S_loc a
+    block multiple), each hop's block attention runs the Pallas kernel
+    and hops merge by log-sum-exp (see :func:`_ring_attention_flash`);
+    otherwise the XLA online-softmax path below runs.
     """
+    from horovod_tpu.ops.flash_attention import flash_lse_supported
+
+    if flash_lse_supported(q.shape[1], q.shape[3]) \
+            and k.shape[1] == q.shape[1]:
+        return _ring_attention_flash(q, k, v, axis_name, causal)
+
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     B, Sq, Hq, D = q.shape
