@@ -52,6 +52,16 @@ def main():
 
     if scenario == "gspmd_step":
         gspmd_train_parity()
+    elif scenario == "hybrid_mesh":
+        # The mesh must place the OUTER axis across processes ("DCN")
+        # and the inner axis within each process ("ICI") — the contract
+        # the sharding rules assume (see the test's docstring for what
+        # this does and does not pin).
+        import horovod_tpu.jax as hvd
+
+        mesh = hvd.build_mesh({"data": 2, "fsdp": 2})
+        procs = [[d.process_index for d in row] for row in mesh.devices]
+        assert procs[0] == [0, 0] and procs[1] == [1, 1], procs
     else:
         # A real cross-process data movement: rank 0's value reaches
         # everyone.

@@ -1,7 +1,7 @@
-"""Multi-process Keras-3 frontend tests on both the JAX backend (the
-TPU-native flagship: jitted train step, allreduce via io_callback) and
-the TensorFlow backend (py_function path).  Scenarios live in
-tests/keras_worker.py."""
+"""Multi-process Keras-3 frontend tests across the JAX backend (the
+TPU-native flagship: jitted train step, allreduce via io_callback),
+the TensorFlow backend (py_function path), and the torch backend
+(eager host path).  Scenarios live in tests/keras_worker.py."""
 
 import os
 
@@ -25,7 +25,7 @@ def run_keras_workers(n, scenario, backend, timeout=300, extra_env=None):
     run_workers(n, scenario, timeout=timeout, worker=WORKER, extra_env=env)
 
 
-@pytest.mark.parametrize("backend", ["jax", "tensorflow"])
+@pytest.mark.parametrize("backend", ["jax", "tensorflow", "torch"])
 def test_keras_fit_equalizes(backend):
     run_keras_workers(2, "fit", backend)
 
