@@ -1,0 +1,165 @@
+"""Host-engine data-plane benchmark: throughput of the TCP ring engine
+under the torch and TF frontends at 2 and 4 ranks.
+
+Role parity with the reference's benchmark methodology
+(``examples/pytorch_synthetic_benchmark.py:96-110`` — timed fwd+bwd+step
+loops, img/sec), applied to the part of THIS stack the main ``bench.py``
+does not exercise: the native TCP engine serving the host frontends
+(torch hooks, TF grouped allreduce).  The numbers are CPU-host numbers by
+design — they track frontend + negotiation + ring-collective overhead,
+so hot-path regressions (e.g. a fusion/batching break) become visible as
+throughput drops.
+
+Prints ONE JSON line, e.g.::
+
+    {"metric": "engine_data_plane", "torch_img_per_sec": {"2": ..,
+     "4": ..}, "tf_img_per_sec": {"2": .., "4": ..},
+     "tf_step_ms": {"2": .., "4": ..}}
+
+``bench.py`` merges these keys into the bench artifact under an
+``engine_`` prefix; standalone use: ``python bench_engine.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---------------------------------------------------------------------------
+# TF worker (run as: bench_engine.py --tf-worker)
+# ---------------------------------------------------------------------------
+
+def _tf_worker() -> None:
+    """MNIST-shaped training step over DistributedGradientTape: every
+    dense gradient rides the grouped single-cycle allreduce
+    (``horovod_tpu/tf/mpi_ops.py``)."""
+    import numpy as np
+    import tensorflow as tf
+
+    sys.path.insert(0, REPO)
+    import horovod_tpu.tf as hvd
+
+    hvd.init()
+    tf.keras.utils.set_random_seed(1 + hvd.rank())
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    model(tf.zeros([1, 784]))
+    hvd.broadcast_variables(model.trainable_variables, root_rank=0)
+    opt = tf.keras.optimizers.SGD(0.01 * hvd.size())
+    batch = 32
+    rng = np.random.default_rng(7 + hvd.rank())
+    X = tf.constant(rng.standard_normal((batch, 784)), dtype=tf.float32)
+    Y = tf.constant(rng.integers(0, 10, batch), dtype=tf.int64)
+
+    def step():
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            logits = model(X)
+            loss = tf.reduce_mean(
+                tf.nn.sparse_softmax_cross_entropy_with_logits(
+                    labels=Y, logits=logits))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+    for _ in range(3):
+        step()
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step()
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        print(f"TF_STEP_MS {dt / iters * 1e3:.3f} "
+              f"TF_IMG_PER_SEC {batch * hvd.size() * iters / dt:.1f}",
+              flush=True)
+    hvd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_ranks(n: int, argv: list, timeout: int = 240) -> str:
+    """Run ``argv`` as n engine ranks; returns rank 0's stdout."""
+    port = _free_port()
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(n),
+            "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
+            "CUDA_VISIBLE_DEVICES": "-1",
+        })
+        procs.append(subprocess.Popen(
+            argv, env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out.decode(), err.decode()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for rank, (rc, out, err) in enumerate(outs):
+        if rc != 0:
+            raise RuntimeError(
+                f"rank {rank} failed (rc={rc}):\n{out}\n{err}")
+    return outs[0][1]
+
+
+def main() -> None:
+    result: dict = {"metric": "engine_data_plane"}
+    torch_rates: dict = {}
+    tf_rates: dict = {}
+    tf_step_ms: dict = {}
+    for n in (2, 4):
+        # No --smoke: it would force num_iters to 1, and these numbers
+        # exist to catch regressions — keep the 3-sample mean the
+        # example reports (its ±1.96σ methodology, ref :96-110).
+        out = _run_ranks(n, [
+            sys.executable,
+            os.path.join(REPO, "examples", "torch_synthetic_benchmark.py"),
+            "--batch-size", "16", "--num-iters", "3",
+            "--num-batches-per-iter", "4",
+        ])
+        m = re.search(r"Total img/sec on \d+ device\(s\): ([\d.]+)", out)
+        if m:
+            torch_rates[str(n)] = float(m.group(1))
+
+        out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
+                             "--tf-worker"])
+        m = re.search(r"TF_STEP_MS ([\d.]+) TF_IMG_PER_SEC ([\d.]+)", out)
+        if m:
+            tf_step_ms[str(n)] = float(m.group(1))
+            tf_rates[str(n)] = float(m.group(2))
+    result["torch_img_per_sec"] = torch_rates
+    result["tf_img_per_sec"] = tf_rates
+    result["tf_step_ms"] = tf_step_ms
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    if "--tf-worker" in sys.argv:
+        _tf_worker()
+    else:
+        main()

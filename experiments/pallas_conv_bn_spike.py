@@ -1,0 +1,183 @@
+"""Bounded spike (round 5): can a Pallas matmul+BN-stats kernel beat
+XLA's fused conv+stats on ResNet's bottleneck shapes?
+
+Context (docs/perf-notes.md): ResNet MFU has been flat at 0.3047 for
+three rounds.  The trace shows XLA already fuses BN statistics into every
+conv's epilogue; the fwd+BN group sustains ~44 TF/s vs ~81 TF/s for the
+pure conv chain.  The one remaining idea is a hand-written Pallas kernel
+keeping the stats accumulators VMEM-resident across output tiles
+(the MLPerf-class trick).  This spike implements that kernel for the
+1x1 bottleneck convs (which are matmuls — the only conv family Pallas
+can express without an im2col blowup) on the real stage-2 shapes, and
+A/Bs it against XLA's own conv+stats on chained end-to-end loops
+(microbenches through the tunnel are dispatch-dominated — memory:
+tpu-environment-landmines).
+
+Run:  python experiments/pallas_conv_bn_spike.py        (needs the TPU)
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Stage-2 bottleneck 1x1 shapes at the bench's batch 256:
+# x: [256, 28, 28, 512] -> 1x1 conv -> [256, 28, 28, 128]
+B, H, W, K, C = 256, 28, 28, 512, 128
+N = B * H * W              # 200704 rows
+BN_ROWS = 512              # row tile
+BK = 512                   # full K in one step (512 fits VMEM easily)
+REPEATS = 12               # chained iterations per timed call
+
+
+def _kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref):
+    """One [BN_ROWS, K] x [K, C] tile: matmul in f32, write bf16 y, and
+    accumulate per-channel sum / sum-of-squares into VMEM-resident
+    accumulators shared across the whole row grid (grid dim is
+    'arbitrary' = sequential on a TPU core, so += across steps is
+    well-defined)."""
+    i = pl.program_id(0)
+    y = jnp.dot(x_ref[...], w_ref[...],
+                preferred_element_type=jnp.float32)
+    y_ref[...] = y.astype(y_ref.dtype)
+
+    @pl.when(i == 0)
+    def _init():
+        s1_ref[...] = jnp.zeros_like(s1_ref)
+        s2_ref[...] = jnp.zeros_like(s2_ref)
+
+    s1_ref[...] += jnp.sum(y, axis=0, keepdims=True)
+    s2_ref[...] += jnp.sum(y * y, axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def pallas_conv_stats(x2d, w):
+    grid = (N // BN_ROWS,)
+    y, s1, s2 = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((BN_ROWS, K), lambda i: (i, 0)),
+            pl.BlockSpec((K, C), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((BN_ROWS, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, C), jnp.bfloat16),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+    )(x2d, w)
+    mean = s1[0] / N
+    var = s2[0] / N - mean * mean
+    return y, mean, var
+
+
+@jax.jit
+def xla_conv_stats(x4d, w4d):
+    y = jax.lax.conv_general_dilated(
+        x4d, w4d, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    mean = jnp.mean(y, axis=(0, 1, 2))
+    var = jnp.mean(y * y, axis=(0, 1, 2)) - mean * mean
+    return y.astype(jnp.bfloat16), mean, var
+
+
+@jax.jit
+def xla_conv_only(x4d, w4d):
+    y = jax.lax.conv_general_dilated(
+        x4d, w4d, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    return y.astype(jnp.bfloat16)
+
+
+def _chain(one_step, x, w, shape_w):
+    """REPEATS dependent iterations: each step's stats perturb the next
+    step's weights (real data dependency, negligible FLOPs) so the chain
+    can't be DCE'd or overlapped away — end-to-end A/B per the
+    tunnel-microbench landmine."""
+
+    def body(carry, _):
+        w = carry
+        out = one_step(w)
+        y, mean, var = out if isinstance(out, tuple) else (out, None, None)
+        if mean is None:
+            mean = y[0, :C].astype(jnp.float32) if y.ndim == 2 \
+                else y[0, 0, 0, :].astype(jnp.float32)
+            var = mean
+        w = w + (1e-12 * mean)[None, :].astype(w.dtype)  # [C] -> [K, C]
+        return w, y[..., 0].sum()
+
+    return jax.lax.scan(body, w, None, length=REPEATS)
+
+
+def time_it(fn, *args, warmup=2, reps=3):
+    f = jax.jit(fn)
+    for _ in range(warmup):
+        out = f(*args)
+    jax.tree.map(lambda a: a.block_until_ready(), out)
+    float(jax.tree.leaves(out)[-1].sum().astype(jnp.float32))
+    dts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = f(*args)
+        float(jax.tree.leaves(out)[-1].sum().astype(jnp.float32))
+        dts.append(time.perf_counter() - t0)
+    return sorted(dts)[len(dts) // 2]
+
+
+def main(arm: str):
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    rng = np.random.default_rng(0)
+    x2d = jnp.asarray(rng.standard_normal((N, K)), jnp.bfloat16)
+    w2d = jnp.asarray(0.05 * rng.standard_normal((K, C)), jnp.bfloat16)
+    x4d = x2d.reshape(B, H, W, K)
+    w4d = w2d.reshape(1, 1, K, C)
+
+    flops = 2.0 * N * K * C * REPEATS
+
+    if arm == "check":
+        y_p, m_p, v_p = jax.jit(pallas_conv_stats)(x2d, w2d)
+        y_x, m_x, v_x = xla_conv_stats(x4d, w4d)
+        np.testing.assert_allclose(np.asarray(m_p), np.asarray(m_x),
+                                   rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(
+            np.asarray(y_p, np.float32).reshape(B, H, W, C)[:2],
+            np.asarray(y_x, np.float32)[:2], rtol=5e-2, atol=5e-2)
+        print("correctness ok", flush=True)
+        return
+
+    # Remote compile through the tunnel takes minutes per chain; each arm
+    # therefore runs as its OWN invocation (argv) with its own budget.
+    arms = {
+        "pallas": lambda w: _chain(lambda v: pallas_conv_stats(x2d, v),
+                                   x2d, w, (K, C)),
+        "xla": lambda w: _chain(
+            lambda v: xla_conv_stats(x4d, v.reshape(1, 1, K, C)),
+            x2d, w, (K, C)),
+        "conv_only": lambda w: _chain(
+            lambda v: xla_conv_only(x4d, v.reshape(1, 1, K, C)),
+            x2d, w, (K, C)),
+    }
+    dt = time_it(arms[arm], w2d)
+    print(f"ARM {arm} ms {dt*1e3:.2f} tflops {flops/dt/1e12:.1f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    main(_sys.argv[1] if len(_sys.argv) > 1 else "check")

@@ -68,6 +68,18 @@ int64_t horovod_enqueue_probe(const char* name, int dtype, int ndim,
                                /*probe=*/true);
 }
 
+// Execution stats: negotiation cycles that executed work, responses
+// executed (a fused batch counts once), and tensors executed.  Lets
+// frontends and tests assert the async+fusion property (N tensors batched
+// into ~1 cycle, tensors/responses > 1) instead of trusting it.
+int64_t horovod_exec_cycles() { return Engine::Get().exec_cycles(); }
+int64_t horovod_responses_executed() {
+  return Engine::Get().responses_executed();
+}
+int64_t horovod_tensors_executed() {
+  return Engine::Get().tensors_executed();
+}
+
 int horovod_poll(int64_t handle) { return Engine::Get().Poll(handle); }
 int horovod_wait(int64_t handle) { return Engine::Get().Wait(handle); }
 

@@ -1,6 +1,7 @@
 #include "engine.h"
 
 #include <algorithm>
+#include <cassert>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -547,7 +548,16 @@ void Engine::Shutdown() {
 // Background negotiation loop
 // ---------------------------------------------------------------------------
 
+// message_table_ is background-thread-only by design (no mu_); this makes
+// the invariant self-checking at every access site instead of
+// comment-enforced.  Cheap enough to keep on in release builds.
+void Engine::AssertBackgroundThread() const {
+  assert(std::this_thread::get_id() == bg_thread_id_.load() &&
+         "message_table_ accessed off the background thread");
+}
+
 void Engine::BackgroundLoop() {
+  bg_thread_id_.store(std::this_thread::get_id());
   while (RunLoopOnce()) {
   }
   // Fail anything still in flight (reference SHUT_DOWN_ERROR,
@@ -628,6 +638,7 @@ bool Engine::RunLoopOnce() {
 
   if (size_ == 1) {
     // Single process: every tensor is instantly "globally ready".
+    AssertBackgroundThread();
     for (auto& q : my_list.requests) {
       timeline_.NegotiateStart(q.tensor_name);
       timeline_.NegotiateRankReady(q.tensor_name, 0);
@@ -642,6 +653,7 @@ bool Engine::RunLoopOnce() {
       responses.push_back(BuildResponse(q.tensor_name));
     }
     FuseResponses(responses);
+    if (!responses.empty()) exec_cycles_.fetch_add(1);
     for (auto& resp : responses) PerformResponse(resp);
     return !my_list.shutdown;
   }
@@ -690,6 +702,7 @@ bool Engine::RunLoopOnce() {
         return false;
       }
     }
+    if (!response_list.responses.empty()) exec_cycles_.fetch_add(1);
     for (auto& resp : response_list.responses) PerformResponse(resp);
     if (!stall_check_disabled_) CheckForStalledTensors();
     return !response_list.shutdown;
@@ -724,6 +737,7 @@ bool Engine::RunLoopOnce() {
     std::fprintf(stderr, "horovod_tpu rank %d: bad response frame\n", rank_);
     return false;
   }
+  if (!response_list.responses.empty()) exec_cycles_.fetch_add(1);
   for (auto& resp : response_list.responses) PerformResponse(resp);
   return !response_list.shutdown;
 }
@@ -732,6 +746,7 @@ bool Engine::RunLoopOnce() {
 // Reference: IncrementTensorCount (operations.cc:282-307) +
 // ConstructMPIResponse (315-517) + fusion (1815-1842).
 ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
+  AssertBackgroundThread();
   ResponseList out;
   std::vector<std::string> became_ready;
   for (int r = 0; r < size_; ++r) {
@@ -811,6 +826,7 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
 // feature (operations.cc:315-517).
 Response Engine::BuildResponse(const std::string& name) {
   // message_table_ is background-thread-only (see engine.h); no lock.
+  AssertBackgroundThread();
   PendingInfo info;
   {
     auto it = message_table_.find(name);
@@ -1034,6 +1050,8 @@ void Engine::PerformResponse(const Response& response) {
     return;
   }
   if (entries.empty()) return;
+  responses_executed_.fetch_add(1);
+  tensors_executed_.fetch_add(static_cast<int64_t>(entries.size()));
   switch (response.type) {
     case ResponseType::ALLREDUCE:
       ExecAllreduce(response, entries);
@@ -1540,6 +1558,7 @@ void Engine::CheckForStalledTensors() {
   }
   last_stall_check_ = now;
   // message_table_ is background-thread-only (see engine.h); no lock.
+  AssertBackgroundThread();
   bool preamble = false;
   for (auto& kv : message_table_) {
     auto age = std::chrono::duration_cast<std::chrono::seconds>(

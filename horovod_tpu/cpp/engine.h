@@ -93,6 +93,17 @@ class Engine {
                   int root_rank, ReduceOp red_op = ReduceOp::SUM,
                   bool probe = false);
 
+  // Execution stats (readable from any thread).  `exec_cycles` counts
+  // negotiation cycles that executed at least one response on this rank;
+  // `responses_executed` counts responses (a fused batch is ONE);
+  // `tensors_executed` counts tensors.  tensors/responses > 1 ⇒ fusion;
+  // frontends batching N tensors into one cycle see exec_cycles grow by
+  // ~1 instead of N (reference async+fusion property,
+  // operations.cc:1815-1842).
+  int64_t exec_cycles() const { return exec_cycles_.load(); }
+  int64_t responses_executed() const { return responses_executed_.load(); }
+  int64_t tensors_executed() const { return tensors_executed_.load(); }
+
   int Poll(int64_t handle);                  // 0 pending, 1 ok, -1 error
   int Wait(int64_t handle);                  // blocks; returns Poll result
   std::string ErrorMessage(int64_t handle);
@@ -178,8 +189,11 @@ class Engine {
   // Owned exclusively by the background thread (RunLoopOnce and the
   // functions it calls: CoordinatorStep, BuildResponse,
   // CheckForStalledTensors).  Not guarded by mu_ — never touch it from
-  // an API thread.
+  // an API thread; AssertBackgroundThread() makes the invariant
+  // self-checking at every access site.
   std::unordered_map<std::string, PendingInfo> message_table_;
+  std::atomic<std::thread::id> bg_thread_id_{};
+  void AssertBackgroundThread() const;
   std::chrono::steady_clock::time_point last_stall_check_;
 
   // -- network --
@@ -205,6 +219,11 @@ class Engine {
 
   // -- fusion scratch --
   std::vector<uint8_t> fusion_buffer_;
+
+  // -- execution stats --
+  std::atomic<int64_t> exec_cycles_{0};
+  std::atomic<int64_t> responses_executed_{0};
+  std::atomic<int64_t> tensors_executed_{0};
 
   // -- timeline --
   Timeline timeline_;

@@ -10,8 +10,6 @@ directly instead of building per-metric graph variables.
 
 from __future__ import annotations
 
-import warnings
-
 import numpy as np
 import keras
 
@@ -35,16 +33,17 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
         self.root_rank = root_rank
         self._weights_done = False
         self._opt_done = False
+        self._tf_hooked = False
+        self._tf_unhook = None
 
     def _broadcast_what_exists(self):
         # Keras builds lazily, backend-dependently: the JAX trainer
         # materializes weights before on_train_begin, the TF trainer only
-        # inside the first train step, and optimizer slots appear after
-        # the first apply everywhere.  Broadcast each group as soon as it
-        # exists; until the weights broadcast lands, per-rank steps use
-        # averaged (identical) gradients on divergent weights, and the
-        # batch-0-end broadcast then equalizes — from batch 1 on, state
-        # is bit-identical.
+        # while the first train step traces, and optimizer slots appear
+        # after the first build everywhere.  Broadcast each group as soon
+        # as it exists; on the TF backend _install_tf_first_step_hook
+        # runs this inside the traced step, after build but strictly
+        # before batch 0's variable reads.
         if not self._weights_done and self.model.weights:
             broadcast_variables(self.model.weights, self.root_rank,
                                 name_prefix="keras.bcast.w")
@@ -56,12 +55,74 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
                                 name_prefix="keras.bcast.opt")
             self._opt_done = True
 
+    def _install_tf_first_step_hook(self):
+        # On the TF backend an unbuilt model only materializes weights
+        # while the first train step TRACES — after on_train_begin, too
+        # late for a strictly-before-batch-0 broadcast from callbacks
+        # alone.  Wrap ``train_step`` to (1) force-build model+optimizer
+        # symbolically at trace time (Keras's own ``_symbolic_build``, so
+        # variables are eagerly initialized BEFORE the graph first runs —
+        # deferred inits race the broadcast otherwise) and (2) run the
+        # broadcast in a py_function ordered before every variable read.
+        # Batch 0's forward then runs on equalized weights on every rank,
+        # matching the reference's strictly-before-training broadcast
+        # (callbacks_impl.py:20-30).
+        import tensorflow as tf
+
+        model, cb = self.model, self
+        orig_train_step = model.train_step
+
+        def _host_broadcast():
+            if not (cb._weights_done and cb._opt_done):
+                cb._broadcast_what_exists()
+            return np.int32(0)
+
+        def train_step_with_broadcast(*args, **kwargs):
+            data = args[0] if args else kwargs.get("data")
+            build = getattr(model, "_symbolic_build", None)
+            if callable(build) and data is not None:
+                build(data_batch=data)
+            done = tf.py_function(_host_broadcast, [], Tout=tf.int32)
+            with tf.control_dependencies([done]):
+                return orig_train_step(*args, **kwargs)
+
+        model.train_step = train_step_with_broadcast
+        # fit() already captured the unwrapped train_step into its
+        # train_function (make_train_function runs before
+        # on_train_begin); rebuild so the wrapper is the one traced.
+        if getattr(model, "train_function", None) is not None:
+            model.make_train_function(force=True)
+        self._tf_hooked = True
+
+        def _unhook():
+            model.train_step = orig_train_step
+            if getattr(model, "train_function", None) is not None:
+                model.make_train_function(force=True)
+
+        self._tf_unhook = _unhook
+
     def on_train_begin(self, logs=None):
         self._broadcast_what_exists()
+        if not (self._weights_done and self._opt_done) \
+                and not self._tf_hooked \
+                and keras.backend.backend() == "tensorflow":
+            self._install_tf_first_step_hook()
 
     def on_train_batch_end(self, batch, logs=None):
         if not (self._weights_done and self._opt_done):
             self._broadcast_what_exists()
+        if self._tf_unhook and self._weights_done and self._opt_done:
+            # Broadcast landed: drop the traced-step wrapper (one retrace)
+            # so steady-state steps pay no per-step host roundtrip.
+            self._tf_unhook()
+            self._tf_unhook = None
+
+    def on_train_end(self, logs=None):
+        # Safety net for fits that never reach a batch end (zero-step
+        # epoch, early interrupt): never leave train_step wrapped.
+        if self._tf_unhook:
+            self._tf_unhook()
+            self._tf_unhook = None
 
 
 class MetricAverageCallback(keras.callbacks.Callback):
@@ -77,7 +138,13 @@ class MetricAverageCallback(keras.callbacks.Callback):
                       if np.isscalar(v) or getattr(v, "ndim", None) == 0)
         arrays = [np.asarray(float(logs[k]), dtype=np.float64).reshape(1)
                   for k in keys]
-        reduced = _host_average_many(arrays, f"keras.metric.ep{epoch}")
+        # The metric key is part of the collective name: if ranks ever see
+        # different key sets (e.g. a rank-0-only callback injected a
+        # metric earlier in the list), the engine fails with a clear
+        # per-metric rendezvous error instead of positionally misaligned
+        # values.
+        reduced = _host_average_many(
+            arrays, f"keras.metric.ep{epoch}", names=keys)
         for k, r in zip(keys, reduced):
             logs[k] = float(r[0])
 
@@ -100,10 +167,14 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
     ``staircase=True`` adjusts on epoch boundaries; ``staircase=False``
     interpolates per batch using ``steps_per_epoch`` (autodetected from
     ``params['steps']`` when possible).  Momentum correction rescales
-    momentum by new_lr/old_lr around the boundary (Goyal et al. 2017) —
-    Keras 3 stores momentum as a plain python attribute, so under the
-    JAX trainer's jitted step the corrected value only takes effect on
-    retrace; a warning is emitted once there.
+    momentum by new_lr/old_lr around the boundary (Goyal et al. 2017).
+    Keras 3 stores the momentum COEFFICIENT as a plain python attribute
+    baked into the jitted JAX step at trace time, so on that backend the
+    correction instead scales the velocity SLOTS once by new_lr/old_lr —
+    the mathematically identical trace-safe form: v1 = m*(r*v0) -
+    new_lr*g == (m*r)*v0 - new_lr*g, with no restore needed.  Any LR/slot
+    change under the JAX trainer first flushes the live jitted state via
+    ``jax_state_sync`` so the trainer re-reads variables next batch.
     """
 
     def __init__(self, multiplier, start_epoch: int = 0, end_epoch=None,
@@ -133,16 +204,33 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
 
     def _adjust_lr(self, epoch):
         opt = self.model.optimizer
+        on_jax = keras.backend.backend() == "jax"
+        if on_jax:
+            # Flush the live jitted state into the variables BEFORE
+            # reading/writing lr or slots (mid-epoch the JAX trainer's
+            # source of truth is its threaded state, not the variables);
+            # the flag this sets makes the trainer re-read all variables
+            # at the next batch, so the changes below take effect without
+            # a retrace.
+            sync = getattr(self.model, "jax_state_sync", None)
+            if callable(sync):
+                sync()
         old_lr = _get_lr(opt)
         new_lr = self.initial_lr * self.multiplier(epoch)
         _set_lr(opt, new_lr)
         if self.momentum_correction and hasattr(opt, "momentum") \
                 and np.isscalar(opt.momentum) and opt.momentum:
-            if keras.backend.backend() == "jax":
-                warnings.warn(
-                    "momentum correction is inert under the jitted JAX "
-                    "trainer (momentum is a python attribute, baked at "
-                    "trace time)", RuntimeWarning)
+            if on_jax:
+                # Trace-safe equivalent of the reference's one-step
+                # coefficient correction (callbacks_impl.py:108-113):
+                # scale the velocity slots once by new_lr/old_lr (see
+                # class docstring).  Unbuilt slots (before the first
+                # apply) are all-zero — nothing to scale.
+                slots = getattr(opt, "momentums", None)
+                if slots and old_lr > 0:
+                    ratio = new_lr / old_lr
+                    for v in slots:
+                        v.assign(keras.ops.multiply(v, ratio))
             else:
                 self.restore_momentum = opt.momentum
                 opt.momentum = opt.momentum * new_lr / old_lr

@@ -33,6 +33,8 @@ from horovod_tpu.runtime import engine_or_none as _engine
 
 _COMPRESS_WIRE = {"none": None, "fp16": np.float16, "bf16": "bf16"}
 
+_API_EXPORT_WARNED = False
+
 
 def _check_compression(compression: str) -> str:
     if compression not in _COMPRESS_WIRE:
@@ -51,7 +53,8 @@ def _wire_dtype(compression: str):
     return wire
 
 
-def _host_average_many(arrays, name_prefix: str, compression: str = "none"):
+def _host_average_many(arrays, name_prefix: str, compression: str = "none",
+                       names=None):
     """Average a batch of host arrays across ranks, NEVER mutating the
     inputs (the engine reduces in place, so every enqueued buffer is a
     fresh copy).
@@ -59,9 +62,18 @@ def _host_average_many(arrays, name_prefix: str, compression: str = "none"):
     Every allreduce is enqueued before any is synchronized, so the
     coordinator negotiates the whole batch in one cycle and the engine's
     fusion packs same-dtype tensors into single ring operations.
+
+    ``names`` (optional, one per array) joins the rendezvous key — pass
+    semantic names wherever ranks could disagree about the batch
+    contents, so a divergence fails with a clear per-name error instead
+    of positional misalignment.
     """
     eng = _engine()
     arrays = [np.ascontiguousarray(a) for a in arrays]
+    keys = (list(range(len(arrays))) if names is None else list(names))
+    if len(keys) != len(arrays):
+        raise ValueError(
+            f"{len(arrays)} arrays but {len(keys)} names")
     if eng is None:
         return arrays
     wire = _wire_dtype(compression)
@@ -71,8 +83,8 @@ def _host_average_many(arrays, name_prefix: str, compression: str = "none"):
             sent.append((a.astype(wire), a.dtype))
         else:
             sent.append((a.copy(), None))
-    handles = [eng.enqueue_allreduce(w, name=f"{name_prefix}.{i}")
-               for i, (w, _) in enumerate(sent)]
+    handles = [eng.enqueue_allreduce(w, name=f"{name_prefix}.{k}")
+               for k, (w, _) in zip(keys, sent)]
     n = basics.size()
     outs = []
     for (w, orig), h in zip(sent, handles):
@@ -118,11 +130,21 @@ def allreduce_gradients(grads, name_prefix: str = "keras.grad",
     elif backend == "torch":
         import torch
 
+        # torch cannot round-trip bf16 through .numpy(); reuse the torch
+        # frontend's uint16/ml_dtypes reinterpretation in BOTH directions
+        # (the engine understands the wire dtype natively).
+        from horovod_tpu.torch.mpi_ops import _from_np, _np_view
+
+        def _to_torch(r, v):
+            r = np.ascontiguousarray(r)
+            wire = (torch.bfloat16 if r.dtype.name == "bfloat16"
+                    else torch.float32)  # selects _from_np's branch only
+            return _from_np(r, wire).to(device=v.device, dtype=v.dtype)
+
         reduced = _host_average_many(
-            [g.detach().cpu().numpy() for g in vals], name_prefix,
-            compression)
-        outs = [torch.as_tensor(r).to(v.device)
-                for r, v in zip(reduced, vals)]
+            [_np_view(g.detach().cpu().contiguous()) for g in vals],
+            name_prefix, compression)
+        outs = [_to_torch(r, v) for r, v in zip(reduced, vals)]
     else:  # numpy / openvino
         outs = _host_average_many([np.asarray(g) for g in vals],
                                   name_prefix, compression)
@@ -187,7 +209,20 @@ def wrap_optimizer_class(cls, compression: str = "none"):
         if public is not None:
             _ae.REGISTERED_OBJS_TO_NAMES[_Distributed] = public
     except (ImportError, AttributeError):
-        pass  # older/newer keras: saved configs carry the wrapper path
+        # Private keras internals moved: saved configs will carry the
+        # wrapper's module path, so models saved with this optimizer need
+        # horovod_tpu installed to reload.  Losing that documented
+        # portability property must be VISIBLE, not silent.
+        global _API_EXPORT_WARNED
+        if not _API_EXPORT_WARNED:
+            _API_EXPORT_WARNED = True
+            import warnings
+
+            warnings.warn(
+                "keras.src.api_export internals not found in this keras "
+                "version; models saved with the distributed optimizer "
+                "will record the wrapper module path and require "
+                "horovod_tpu to reload", RuntimeWarning, stacklevel=2)
     return _Distributed
 
 

@@ -124,6 +124,18 @@ class NativeEngine:
         lib.horovod_release_handle.argtypes = [ctypes.c_int64]
         lib.horovod_release_handle.restype = None
         lib.horovod_size.restype = ctypes.c_int
+        # Diagnostics-only counters: degrade (stats() raises a clear
+        # rebuild hint) instead of hard-failing init against a stale
+        # prebuilt .so that predates these symbols.
+        try:
+            for sym in ("horovod_exec_cycles",
+                        "horovod_responses_executed",
+                        "horovod_tensors_executed"):
+                fn = getattr(lib, sym)
+                fn.argtypes = []
+                fn.restype = ctypes.c_int64
+        except AttributeError:
+            pass  # stale .so: stats() raises the rebuild hint instead
 
     # -- naming (auto names must be identical across ranks, which holds when
     #    ranks enqueue in the same program order — same contract as the
@@ -215,6 +227,25 @@ class NativeEngine:
         """Exchange equal dim-0 blocks: output block i came from rank i."""
         return self._enqueue(
             _OP_ALLTOALL, arr, self._auto_name("alltoall", name))
+
+    # -- execution stats --
+
+    def stats(self) -> dict:
+        """Cumulative execution counters: negotiation ``cycles`` that
+        executed work, ``responses`` executed (a fused batch counts once),
+        and ``tensors`` executed.  ``tensors/responses > 1`` ⇒ fusion;
+        a frontend batching N tensors into one cycle moves ``cycles`` by
+        ~1 instead of N."""
+        if getattr(getattr(self._lib, "horovod_exec_cycles", None),
+                   "restype", None) is not ctypes.c_int64:
+            raise RuntimeError(
+                "libhorovod_core.so predates the execution counters — "
+                "rebuild it with `make -C horovod_tpu/cpp`")
+        return {
+            "cycles": self._lib.horovod_exec_cycles(),
+            "responses": self._lib.horovod_responses_executed(),
+            "tensors": self._lib.horovod_tensors_executed(),
+        }
 
     # -- handle API --
 

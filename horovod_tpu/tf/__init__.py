@@ -25,12 +25,13 @@ import tensorflow as tf
 from horovod_tpu.tf.compression import Compression
 from horovod_tpu.tf.mpi_ops import (
     init, shutdown, size, rank, local_size, local_rank,
-    _allreduce, allgather, broadcast, _normalize_name,
+    _allreduce, _grouped_allreduce, _auto_name, allgather, broadcast,
+    _normalize_name,
 )
 
 __all__ = [
     "init", "shutdown", "size", "rank", "local_size", "local_rank",
-    "allreduce", "allgather", "broadcast",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "broadcast_variables", "broadcast_global_variables",
     "BroadcastGlobalVariablesHook", "DistributedOptimizer",
     "DistributedGradientTape", "create_distributed_optimizer",
@@ -69,6 +70,66 @@ def allreduce(tensor, average: bool = True, device_dense: str = "",
     summed = _allreduce(compressed, name=name)
     summed = compression.decompress(summed, ctx)
     return _avg(summed, tensor.dtype) if average else summed
+
+
+def grouped_allreduce(tensors, average: bool = True,
+                      compression=Compression.none,
+                      name: Optional[str] = None, names=None):
+    """Allreduce a list of dense tensors in ONE negotiation cycle (one
+    ``py_function`` async-enqueues the whole batch; the engine fuses
+    same-dtype tensors into single ring collectives).  This is the hot
+    path under :class:`DistributedOptimizer` and
+    :class:`DistributedGradientTape`.
+
+    ``name`` prefixes auto-generated per-tensor names (a fresh counter
+    suffix is drawn when omitted, so overlapping default-named calls
+    cannot collide in the engine); ``names`` supplies exact per-tensor
+    rendezvous names instead."""
+    tensors = [tf.convert_to_tensor(t) for t in tensors]
+    if names is None:
+        prefix = _auto_name("grouped_allreduce", name and
+                            _normalize_name(name))
+        names = [f"{prefix}_{i}" for i in range(len(tensors))]
+    compressed, ctxs = [], []
+    for t in tensors:
+        c, ctx = compression.compress(t)
+        compressed.append(c)
+        ctxs.append(ctx)
+    summed = _grouped_allreduce(compressed, names)
+    outs = []
+    for s, ctx, t in zip(summed, ctxs, tensors):
+        s = compression.decompress(s, ctx)
+        outs.append(_avg(s, t.dtype) if average else s)
+    return outs
+
+
+def _group_reduce_grads(grads, names, compression, sparse_as_dense,
+                        average: bool = True):
+    """Average a gradient structure across ranks: ``None`` passes
+    through, IndexedSlices ride the sparse allgather path per tensor,
+    and every dense gradient joins ONE grouped allreduce."""
+    out = list(grads)
+    dense_idx = []
+    for i, g in enumerate(grads):
+        if g is None:
+            continue
+        if isinstance(g, tf.IndexedSlices):
+            if sparse_as_dense:
+                out[i] = tf.convert_to_tensor(g)
+                dense_idx.append(i)
+            else:
+                out[i] = allreduce(g, average=average,
+                                   compression=compression, name=names[i])
+        else:
+            dense_idx.append(i)
+    if dense_idx:
+        reduced = grouped_allreduce(
+            [out[i] for i in dense_idx], average=average,
+            compression=compression,
+            names=[names[i] for i in dense_idx])
+        for j, i in enumerate(dense_idx):
+            out[i] = reduced[j]
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -117,19 +178,12 @@ class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
 # optimizers
 # ---------------------------------------------------------------------------
 
-def _allreduce_grad(grad, var_name: str, compression, sparse_as_dense: bool):
-    if grad is None:
-        return None
-    if sparse_as_dense and isinstance(grad, tf.IndexedSlices):
-        grad = tf.convert_to_tensor(grad)
-    return allreduce(grad, average=True, compression=compression,
-                     name="DistributedGrad_" + _normalize_name(var_name))
-
-
 class DistributedOptimizer(tf.compat.v1.train.Optimizer):
     """Wraps a ``tf.compat.v1.train.Optimizer``; ``compute_gradients``
     also averages the gradients across ranks before they are applied
-    (reference __init__.py:135-225).
+    (reference __init__.py:135-225).  All dense gradients ride a single
+    grouped allreduce — one negotiation cycle, fused rings — matching
+    the reference's async+fusion hot path.
 
     For a Keras optimizer, use :func:`create_distributed_optimizer`; for
     an eager/`tf.function` training loop, :class:`DistributedGradientTape`.
@@ -153,11 +207,12 @@ class DistributedOptimizer(tf.compat.v1.train.Optimizer):
         if size() <= 1:
             return gradients
         with tf.name_scope(self._name + "_Allreduce"):
-            return [
-                (_allreduce_grad(grad, var.name, self._compression,
-                                 self._sparse_as_dense), var)
-                for grad, var in gradients
-            ]
+            grads = [g for g, _ in gradients]
+            names = ["DistributedGrad_" + _normalize_name(v.name)
+                     for _, v in gradients]
+            reduced = _group_reduce_grads(
+                grads, names, self._compression, self._sparse_as_dense)
+            return [(g, v) for g, (_, v) in zip(reduced, gradients)]
 
     def apply_gradients(self, *args, **kwargs):
         return self._optimizer.apply_gradients(*args, **kwargs)
@@ -194,11 +249,10 @@ def create_distributed_optimizer(optimizer, name: Optional[str] = None,
 
         def apply(self, grads, trainable_variables=None, **kwargs):
             if size() > 1:
-                grads = [
-                    _allreduce_grad(g, f"grad_{i}", self._hvd_compression,
-                                    self._hvd_sparse_as_dense)
-                    for i, g in enumerate(grads)
-                ]
+                grads = _group_reduce_grads(
+                    list(grads),
+                    [f"DistributedGrad_{i}" for i in range(len(grads))],
+                    self._hvd_compression, self._hvd_sparse_as_dense)
             return super().apply(grads, trainable_variables, **kwargs)
 
     _DistributedKerasOptimizer.__name__ = "Distributed" + cls.__name__
@@ -243,17 +297,10 @@ class DistributedGradientTape:
         grads = self._tape.gradient(target, sources, output_gradients)
         if size() <= 1:
             return grads
-        counter = [0]
-
-        def _reduce(g):
-            i = counter[0]
-            counter[0] += 1
-            if g is None:
-                return None
-            if self._sparse_as_dense and isinstance(g, tf.IndexedSlices):
-                g = tf.convert_to_tensor(g)
-            return allreduce(g, average=self._average,
-                             compression=self._compression,
-                             name=f"DistributedGradientTape_grad_{i}")
-
-        return tf.nest.map_structure(_reduce, grads)
+        flat = tf.nest.flatten(grads)
+        reduced = _group_reduce_grads(
+            flat,
+            [f"DistributedGradientTape_grad_{i}" for i in range(len(flat))],
+            self._compression, self._sparse_as_dense,
+            average=self._average)
+        return tf.nest.pack_sequence_as(grads, reduced)

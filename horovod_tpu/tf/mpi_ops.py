@@ -43,7 +43,7 @@ from horovod_tpu.runtime import engine_or_none as _engine
 
 __all__ = [
     "init", "shutdown", "size", "rank", "local_size", "local_rank",
-    "_allreduce", "allgather", "broadcast",
+    "_allreduce", "_grouped_allreduce", "allgather", "broadcast",
 ]
 
 init = basics.init
@@ -106,6 +106,53 @@ def _allreduce(tensor, name: Optional[str] = None):
         return out, grad
 
     return fn(tf.convert_to_tensor(tensor))
+
+
+def _grouped_allreduce(tensors, names):
+    """Sum-allreduce a batch of tensors through ONE ``py_function``.
+
+    Every tensor is async-enqueued before any is synchronized, so the
+    coordinator negotiates the whole batch in a single cycle and the
+    engine's fusion packs same-dtype tensors into single ring
+    collectives — the reference's async-kernel + fusion property
+    (``tensorflow/mpi_ops.cc:281-303`` + ``operations.cc:1815-1842``)
+    carried onto the host data plane.  One host call per batch is also
+    order-independent across ranks (see module docstring), where N
+    independent blocking py_functions would each burn a negotiation
+    cycle and could deadlock a thread-starved executor.
+
+    Differentiable: the cotangent batch rides the same grouped path.
+    """
+    if len(tensors) != len(names):
+        raise ValueError(f"{len(tensors)} tensors but {len(names)} names")
+    if not tensors:
+        return []
+    names = list(names)
+
+    @tf.custom_gradient
+    def fn(*xs):
+        def _host(*xts):
+            eng = _engine()
+            if eng is None:
+                return [x.numpy() for x in xts]
+            arrs = [_np(x) for x in xts]
+            handles = [eng.enqueue_allreduce(a, name=n)
+                       for a, n in zip(arrs, names)]
+            return [eng.synchronize(h) for h in handles]
+
+        outs = tf.py_function(_host, list(xs), Tout=[x.dtype for x in xs])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for o, x in zip(outs, xs):
+            o.set_shape(x.shape)
+
+        def grad(*dys):
+            return _grouped_allreduce(
+                list(dys), [n + "_grad" for n in names])
+
+        return list(outs), grad
+
+    return fn(*[tf.convert_to_tensor(t) for t in tensors])
 
 
 def allgather(tensor, name: Optional[str] = None):

@@ -55,6 +55,18 @@ def scenario_fit(rank, size):
     assert losses[-1] < losses[0], losses
     # Identical data-parallel updates -> bit-identical params.
     _assert_equal_across_ranks(model, size, "fit_check")
+    if keras.backend.backend() == "torch":
+        # bf16 grads ride the uint16/ml_dtypes reinterpretation (torch
+        # cannot round-trip bf16 through .numpy()).
+        import torch
+
+        from horovod_tpu.keras.impl import allreduce_gradients
+
+        (r,) = allreduce_gradients(
+            [torch.ones(4, dtype=torch.bfloat16) * (rank + 1)],
+            name_prefix="bf16check")
+        assert r.dtype == torch.bfloat16, r.dtype
+        np.testing.assert_allclose(r.float().numpy(), (size + 1) / 2.0)
     # MetricAverageCallback rewrote logs in place: every rank recorded the
     # same averaged loss history.
     lh = np.asarray(losses, dtype=np.float64).reshape(1, -1)
@@ -105,10 +117,125 @@ def scenario_warmup(rank, size):
     _assert_equal_across_ranks(model, size, "warmup_check")
 
 
+def scenario_batch0(rank, size):
+    # Divergent init, IDENTICAL data: the batch-0 loss is rank-dependent
+    # unless weights broadcast strictly BEFORE the first train step —
+    # the reference's before-training broadcast (callbacks_impl.py:20-30).
+    # On the TF backend this exercises the traced-step broadcast hook
+    # (the model only builds while batch 0 traces).
+    keras.utils.set_random_seed(100 + rank)  # deliberately different init
+    model = _model()
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.05)),
+        loss="mse")
+    X, Y = _data(0)  # same data on every rank
+    batch_losses = []
+
+    class Rec(keras.callbacks.Callback):
+        def on_train_batch_end(self, batch, logs=None):
+            if logs and "loss" in logs:
+                batch_losses.append(float(logs["loss"]))
+
+    # shuffle=False: fit's shuffling uses the (rank-dependent) global
+    # seed, which would put different samples in batch 0 per rank.
+    model.fit(X, Y, epochs=1, batch_size=16, verbose=0, shuffle=False,
+              callbacks=[
+                  hvd.callbacks.BroadcastGlobalVariablesCallback(0), Rec()])
+    assert batch_losses, "no per-batch losses recorded"
+    first = np.asarray(batch_losses[:1], dtype=np.float64).reshape(1, 1)
+    gathered = hvd.allgather(first, name="batch0_loss")
+    for r in range(size):
+        np.testing.assert_allclose(gathered[r], gathered[0], rtol=1e-6,
+                                   err_msg="batch-0 loss diverged: weights "
+                                           "were not equalized before the "
+                                           "first step")
+
+
+def scenario_momentum(rank, size):
+    # Momentum correction on the JAX backend: trace-safe velocity-slot
+    # scaling (v *= new_lr/old_lr), mathematically identical to the
+    # reference's one-step coefficient correction
+    # (callbacks_impl.py:108-113), with no RuntimeWarning.
+    import warnings
+
+    keras.utils.set_random_seed(5)
+    model = _model()
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.1, momentum=0.9)),
+        loss="mse")
+    X, Y = _data(0)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        model.fit(X, Y, epochs=1, batch_size=16, verbose=0, callbacks=[
+            hvd.callbacks.BroadcastGlobalVariablesCallback(0)])
+        v0 = [np.asarray(keras.ops.convert_to_numpy(v))
+              for v in model.optimizer.momentums]
+        assert any(np.abs(a).sum() > 0 for a in v0), "slots never moved"
+
+        cb = hvd.callbacks.LearningRateScheduleCallback(
+            lambda e: 0.1, momentum_correction=True)
+        cb.set_model(model)
+        cb.initial_lr = 0.1
+        cb._adjust_lr(1)
+    assert not [w for w in caught if "momentum" in str(w.message)], caught
+    np.testing.assert_allclose(
+        float(keras.ops.convert_to_numpy(model.optimizer.learning_rate)),
+        0.01, rtol=1e-6)
+    for a, b in zip(v0, model.optimizer.momentums):
+        np.testing.assert_allclose(
+            np.asarray(keras.ops.convert_to_numpy(b)), a * 0.1, rtol=1e-5,
+            err_msg="velocity slots were not scaled by new_lr/old_lr")
+
+    # The corrected state keeps training under the jitted step, staying
+    # bit-identical across ranks, including per-batch warmup correction.
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        model.fit(X, Y, epochs=2, batch_size=16, verbose=0, callbacks=[
+            hvd.callbacks.LearningRateWarmupCallback(
+                warmup_epochs=1, momentum_correction=True)])
+    assert not [w for w in caught if "momentum" in str(w.message)], caught
+    _assert_equal_across_ranks(model, size, "momentum_check")
+
+
+def scenario_death(rank, size):
+    # A peer crashing mid-training must surface a contained, descriptive
+    # error on the surviving ranks (not a hang): the engine's failure
+    # containment through the whole Keras stack.
+    keras.utils.set_random_seed(9)
+    model = _model()
+    model.compile(
+        optimizer=hvd.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.05)),
+        loss="mse")
+    X, Y = _data(rank)
+    model.fit(X, Y, epochs=1, batch_size=16, verbose=0, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0)])
+    if rank == size - 1:
+        os._exit(31)  # crash without any shutdown handshake
+    try:
+        model.fit(X, Y, epochs=4, batch_size=16, verbose=0)
+    except Exception as e:
+        # Either the failing collective's own transport error, or — when
+        # the background loop already aborted and shut the engine down —
+        # the next enqueue's "engine is not running" (the descriptive
+        # peer-crash reason is printed to stderr by the engine thread).
+        msg = str(e).lower()
+        assert ("crash" in msg or "lost" in msg or "connection" in msg
+                or "disconnect" in msg or "not running" in msg
+                or "horovod" in msg), e
+        os._exit(0)  # coordinator may be gone; skip shutdown handshake
+    raise AssertionError("expected an error after peer death")
+
+
 SCENARIOS = {
     "fit": scenario_fit,
     "resume": scenario_resume,
     "warmup": scenario_warmup,
+    "batch0": scenario_batch0,
+    "momentum": scenario_momentum,
+    "death": scenario_death,
 }
 
 

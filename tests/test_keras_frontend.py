@@ -85,6 +85,30 @@ def test_saved_file_loads_without_horovod(tmp_path):
     m3.fit(X, Y, epochs=1, batch_size=8, verbose=0)
 
 
+def test_saved_config_records_plain_keras_module(tmp_path):
+    """Version pin for the api_export registry poke (keras/impl.py
+    wrap_optimizer_class): the saved archive's config must record the
+    BASE optimizer under its public keras module with no registered_name
+    — the property that makes saves portable to horovod-less
+    environments.  If keras moves those internals, this fails (and the
+    runtime emits a RuntimeWarning)."""
+    import json
+    import zipfile
+
+    keras.utils.set_random_seed(6)
+    model = _tiny_model()
+    X, Y = _xy()
+    model.fit(X, Y, epochs=1, batch_size=8, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+    with zipfile.ZipFile(path) as z:
+        cfg = json.loads(z.read("config.json"))
+    opt_cfg = cfg["compile_config"]["optimizer"]
+    assert opt_cfg["module"] == "keras.optimizers", opt_cfg
+    assert opt_cfg["class_name"] == "Adam", opt_cfg
+    assert not opt_cfg.get("registered_name"), opt_cfg
+
+
 def test_host_collectives_size1():
     assert hvd.allreduce(3.0) == 3.0
     assert hvd.allreduce(4.0, average=False) == 4.0

@@ -13,7 +13,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "keras_worker.py")
 
 
-def run_keras_workers(n, scenario, backend, timeout=300, extra_env=None):
+def run_keras_workers(n, scenario, backend, timeout=300, extra_env=None,
+                      expected_rc=None):
     env = {
         "KERAS_BACKEND": backend,
         "CUDA_VISIBLE_DEVICES": "-1",
@@ -22,12 +23,38 @@ def run_keras_workers(n, scenario, backend, timeout=300, extra_env=None):
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""
     env.update(extra_env or {})
-    run_workers(n, scenario, timeout=timeout, worker=WORKER, extra_env=env)
+    run_workers(n, scenario, timeout=timeout, worker=WORKER, extra_env=env,
+                expected_rc=expected_rc)
 
 
 @pytest.mark.parametrize("backend", ["jax", "tensorflow", "torch"])
 def test_keras_fit_equalizes(backend):
     run_keras_workers(2, "fit", backend)
+
+
+def test_keras_fit_equalizes_4rank():
+    run_keras_workers(4, "fit", "jax")
+
+
+@pytest.mark.parametrize("backend", ["tensorflow", "jax"])
+def test_keras_batch0_loss_identical(backend):
+    """Weights broadcast strictly before the first train step: batch-0
+    losses match across ranks even with divergent init (reference
+    callbacks_impl.py:20-30)."""
+    run_keras_workers(2, "batch0", backend)
+
+
+def test_keras_momentum_correction_jax():
+    """Momentum correction is active (velocity-slot scaling) under the
+    jitted JAX trainer — no warning, slots scaled by new_lr/old_lr."""
+    run_keras_workers(2, "momentum", "jax")
+
+
+@pytest.mark.parametrize("backend", ["jax", "tensorflow"])
+def test_keras_worker_death_contained(backend):
+    """A crashed peer surfaces a descriptive error on survivors instead
+    of hanging the fit loop."""
+    run_keras_workers(3, "death", backend, expected_rc={2: 31})
 
 
 def test_keras_load_model_resume(tmp_path):

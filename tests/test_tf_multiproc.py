@@ -16,13 +16,20 @@ def run_tf_workers(n, scenario, timeout=240):
                 extra_env={"CUDA_VISIBLE_DEVICES": "-1"})
 
 
-@pytest.mark.parametrize("n", [2, 3])
+@pytest.mark.parametrize("n", [2, 3, 4])
 def test_tf_ops(n):
     run_tf_workers(n, "ops")
 
 
 def test_tf_gradients():
     run_tf_workers(2, "grads")
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_tf_grouped_allreduce_single_cycle(n):
+    """The whole gradient batch completes in ~one negotiation cycle with
+    fused responses (reference async+fusion property)."""
+    run_tf_workers(n, "grouped")
 
 
 def test_tf_mismatch_errors():

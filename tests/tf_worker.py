@@ -88,6 +88,66 @@ def scenario_grads(rank, size):
     np.testing.assert_allclose(grad.numpy(), expected)
 
 
+def scenario_grouped(rank, size):
+    # grouped_allreduce: one py_function async-enqueues the whole batch —
+    # the reference's async-kernel + fusion property
+    # (tensorflow/mpi_ops.cc:281-303 + operations.cc:1815-1842).
+    from horovod_tpu.runtime import engine_or_none
+
+    eng = engine_or_none()
+    assert eng is not None
+
+    # Mixed shapes/dtypes, values correct.
+    ts = [tf.fill([8], float(rank + 1)), tf.fill([3, 2], float(rank)),
+          tf.constant([rank, rank + 1]), tf.fill([5], float(rank + 2))]
+    outs = hvd.grouped_allreduce(ts, average=False, name="grp")
+    np.testing.assert_allclose(outs[0].numpy(), size * (size + 1) / 2)
+    np.testing.assert_allclose(outs[1].numpy(), size * (size - 1) / 2)
+    s = size * (size - 1) // 2
+    np.testing.assert_array_equal(outs[2].numpy(), [s, s + size])
+    np.testing.assert_allclose(outs[3].numpy(), size * (size + 3) / 2)
+
+    # The batch completes in ~ONE negotiation cycle and same-dtype
+    # tensors fuse into few ring collectives: with per-tensor blocking
+    # calls this would take >= n cycles and n responses.
+    n = 12
+    before = eng.stats()
+    outs = hvd.grouped_allreduce(
+        [tf.fill([4, 4], float(rank + i)) for i in range(n)],
+        average=False, name="grp_cycles")
+    after = eng.stats()
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(
+            o.numpy(), size * i + size * (size - 1) / 2)
+    d_cycles = after["cycles"] - before["cycles"]
+    d_resp = after["responses"] - before["responses"]
+    d_tens = after["tensors"] - before["tensors"]
+    assert d_tens == n, (before, after)
+    assert d_cycles <= 3, f"batch took {d_cycles} negotiation cycles"
+    assert d_resp <= 3, f"no fusion: {d_resp} responses for {n} tensors"
+
+    # Differentiable: the cotangent batch rides the same grouped path.
+    vs = [tf.Variable(tf.ones([3]) * (rank + 1)) for _ in range(3)]
+    with tf.GradientTape() as t:
+        reds = hvd.grouped_allreduce(vs, average=False, name="grp_g")
+        y = tf.add_n([tf.reduce_sum(o) for o in reds])
+    grads = t.gradient(y, vs)
+    for g in grads:
+        np.testing.assert_allclose(g.numpy(), float(size))
+
+    # DistributedGradientTape rides the grouped hot path too.
+    vs2 = [tf.Variable(tf.ones([2, 2]) * (i + 1)) for i in range(6)]
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.add_n([tf.reduce_sum(v * v) for v in vs2])
+    before = eng.stats()
+    grads = tape.gradient(loss, vs2)
+    after = eng.stats()
+    assert after["tensors"] - before["tensors"] == 6, (before, after)
+    assert after["cycles"] - before["cycles"] <= 3, (before, after)
+    for i, g in enumerate(grads):
+        np.testing.assert_allclose(g.numpy(), 2.0 * (i + 1))
+
+
 def scenario_errors(rank, size):
     # Cross-rank shape mismatch must raise a descriptive error on EVERY
     # rank, not hang or corrupt (test_horovod_allreduce_error).
@@ -245,6 +305,7 @@ def scenario_v1_sparse(rank, size):
 SCENARIOS = {
     "ops": scenario_ops,
     "grads": scenario_grads,
+    "grouped": scenario_grouped,
     "errors": scenario_errors,
     "sparse": scenario_sparse,
     "keras_loop": scenario_keras_loop,
