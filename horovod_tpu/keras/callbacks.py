@@ -78,6 +78,11 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
             return np.int32(0)
 
         def train_step_with_broadcast(*args, **kwargs):
+            if cb._weights_done and cb._opt_done:
+                # Stale wrapper (fit raised before either unhook path
+                # ran, then a new fit retraced): trace straight through
+                # to the original step, zero steady-state overhead.
+                return orig_train_step(*args, **kwargs)
             data = args[0] if args else kwargs.get("data")
             build = getattr(model, "_symbolic_build", None)
             if callable(build) and data is not None:

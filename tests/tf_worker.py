@@ -135,6 +135,15 @@ def scenario_grouped(rank, size):
     for g in grads:
         np.testing.assert_allclose(g.numpy(), float(size))
 
+    # None grads (unconnected variables) pass through the grouped path
+    # without consuming a collective.
+    va, vb = tf.Variable(tf.ones([2])), tf.Variable(tf.ones([2]))
+    with hvd.DistributedGradientTape(tf.GradientTape()) as t_none:
+        loss_n = tf.reduce_sum(va * 2.0)  # vb unused
+    ga, gb = t_none.gradient(loss_n, [va, vb])
+    assert gb is None, gb
+    np.testing.assert_allclose(ga.numpy(), 2.0)
+
     # DistributedGradientTape rides the grouped hot path too.
     vs2 = [tf.Variable(tf.ones([2, 2]) * (i + 1)) for i in range(6)]
     with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
