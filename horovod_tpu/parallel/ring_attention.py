@@ -112,11 +112,20 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     and hops merge by log-sum-exp (see :func:`_ring_attention_flash`);
     otherwise the XLA online-softmax path below runs.
     """
-    from horovod_tpu.ops.flash_attention import flash_lse_supported
+    from horovod_tpu.ops.flash_attention import (_note_fallback,
+                                                 flash_lse_supported)
 
     if flash_lse_supported(q.shape[1], q.shape[3]) \
             and k.shape[1] == q.shape[1]:
         return _ring_attention_flash(q, k, v, axis_name, causal)
+    if not flash_lse_supported(q.shape[1], q.shape[3]):
+        # The lse-returning kernel has a strict no-shim contract; count
+        # the XLA-path choice so losing the per-hop kernel is visible
+        # (ops.flash_attention.fallback_count telemetry).
+        _note_fallback(
+            f"ring attention hop uses the XLA online-softmax path: "
+            f"S_loc {q.shape[1]} / head dim {q.shape[3]} off the "
+            f"lse-kernel tiling")
 
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -204,8 +213,8 @@ def ulysses_attention(q, k, v, *, axis_name: str = "seq",
     # The local attention runs over the FULL sequence — exactly where the
     # Pallas flash kernel earns its keep (the dense path materializes
     # [B, H, S, S] scores).  shard_map bodies are Manual-mesh, so the
-    # kernel lowers legally here; unsupported shapes fall back to the
-    # dense path inside flash_attention with a counted warning.
+    # kernel lowers legally here; off-tile head dims are zero-padded to
+    # the kernel inside flash_attention (no dense path).
     from horovod_tpu.ops.flash_attention import flash_attention
 
     out = flash_attention(qh, kh, vh, causal=causal)

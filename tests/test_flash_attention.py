@@ -119,24 +119,51 @@ def test_flash_padded_tail_bidirectional_no_mask():
                                atol=2e-5, rtol=2e-5)
 
 
-def test_flash_dense_fallback_warns_once_and_counts():
-    """D off the MXU tiling → dense fallback, one RuntimeWarning per
-    reason, every fallback counted."""
-    import warnings
-
+def test_flash_small_head_dim_pads_to_kernel():
+    """D off the MXU tiling (32) is zero-padded to 64 and sliced back —
+    still the kernel with its O(S) memory contract, NOT the dense
+    fallback — with the true 1/sqrt(32) softmax scale preserved by the
+    q pre-scaling, and gradients flowing back through the pad."""
     from horovod_tpu.ops import flash_attention as fa
 
     q, k, v = _qkv(S=128, D=32)
     before = fa.fallback_count()
-    fa._fallbacks.pop("head dim 32 is not a multiple of 64", None)
-    with warnings.catch_warnings(record=True) as caught:
-        warnings.simplefilter("always")
-        flash_attention(q, k, v)
-        flash_attention(q, k, v)
-    msgs = [w for w in caught if issubclass(w.category, RuntimeWarning)
-            and "dense path" in str(w.message)]
-    assert len(msgs) == 1
-    assert fa.fallback_count() >= before + 2
+    expected = causal_attention(q, k, v)
+    got = flash_attention(q, k, v)
+    assert fa.fallback_count() == before, "dense fallback fired"
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    def flash_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    dg = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    fg = jax.jit(jax.grad(flash_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(dg, fg, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-4,
+            err_msg=f"d{name} mismatch (padded D)")
+
+
+def test_flash_small_head_dim_masked_and_gqa():
+    """The D-padding shim composes with key-padding masks, GQA, and
+    off-tile S (both pads at once)."""
+    from horovod_tpu.models.bert import dot_product_attention
+
+    q, k, v = _qkv(S=100, H=8, Hkv=2, D=48)
+    mask = np.ones((2, 100), bool)
+    mask[:, 77:] = False
+    kr = jnp.repeat(k, 4, axis=2)
+    vr = jnp.repeat(v, 4, axis=2)
+    expected = dot_product_attention(q, kr, vr,
+                                     mask=jnp.asarray(mask)[:, None, None, :])
+    got = flash_attention(q, k, v, causal=False,
+                          key_padding_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
 
 
 def test_llama_with_flash_attention():
