@@ -20,11 +20,12 @@ Design (FlashAttention-2 style, causal):
   intra-block triangle.
 
 ``flash_attention`` is a drop-in for the model zoo's ``attention_fn``
-seam ([B, S, H, D] layout, GQA via KV-head repetition).  Falls back to
-the XLA dense path when shapes don't fit the kernel's constraints
-(sequence not a multiple of the block, tiny head dims) so models work
-unchanged on any backend; ``interpret=True`` is used automatically off-TPU
-so tests exercise the same kernel logic on CPU.
+seam ([B, S, H, D] layout, GQA via KV-head repetition).  Shapes off the
+kernel's tiling are zero-padded onto it (sequence to the next 128,
+head dim to the next 64 with the softmax scale folded into q) and
+sliced back, so models keep the kernel — and its O(S) memory contract —
+unchanged on any shape; ``interpret=True`` is used automatically
+off-TPU so tests exercise the same kernel logic on CPU.
 
 Measured on one v5e (bf16, B=4 H=16 D=128, vs XLA's fused dense
 attention): S=4096 1.8x faster (31 TF/s), S=8192 3.2x (66 TF/s, ~59% of
@@ -60,10 +61,12 @@ _fallbacks_lock = threading.Lock()
 
 
 def fallback_count() -> int:
-    """Number of times flash_attention has fallen back to the XLA dense
-    path at trace time, summed over every reason and call site in this
-    process (the counter is process-global, incremented once per traced
-    fallback, not per kernel execution)."""
+    """Number of times a composing caller chose a non-kernel attention
+    path at trace time (``flash_attention`` itself always pads onto the
+    kernel; e.g. ring attention's XLA online-softmax hop counts here),
+    summed over every reason and call site in this process (the counter
+    is process-global, incremented once per traced fallback, not per
+    kernel execution)."""
     with _fallbacks_lock:
         return sum(_fallbacks.values())
 
@@ -515,6 +518,20 @@ def _flash_lse_bwd(causal, sm_scale, res, cts):
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _pad_head_dim(q, k, v):
+    """Zero-pad D to the next MXU tile (64) and fold the TRUE softmax
+    scale into q: with zero-padded dims the scores are unchanged, and
+    (q * sqrt(Dp)/sqrt(D)) under the kernel's 1/sqrt(Dp) scale equals q
+    under 1/sqrt(D).  Autodiff slices the grads back through the pad
+    (grad-of-pad = slice).  Returns padded (q, k, v)."""
+    d = q.shape[-1]
+    dp = -(-d // 64) * 64
+    pad = ((0, 0), (0, 0), (0, 0), (0, dp - d))
+    qp = jnp.pad(q, pad) * jnp.asarray(
+        math.sqrt(dp) / math.sqrt(d), q.dtype)
+    return qp, jnp.pad(k, pad), jnp.pad(v, pad)
+
+
 def flash_attention_lse(q, k, v, *, causal: bool = True):
     """Flash attention returning ``(out [B,S,H,D], lse [B,H,S] fp32)``.
 
@@ -524,16 +541,25 @@ def flash_attention_lse(q, k, v, *, causal: bool = True):
     and AD flows through both outputs (the lse cotangent folds into the
     backward kernels' delta sideband — see ``_bwd_impl``).
 
-    Kernel-only surface: requires D % 64 == 0 and S % 128 == 0 (no
-    dense fallback, no padding — callers check ``flash_lse_supported``
-    and keep their own fallback, since a silent dense path would defeat
-    the memory contract the caller is composing for).
+    Kernel-only surface: requires S % 128 == 0 (no dense fallback, no
+    sequence padding — a blockwise caller owns the sequence layout, so
+    callers check ``flash_lse_supported`` and keep their own fallback;
+    a silent dense path would defeat the memory contract the caller is
+    composing for).  Off-tile head dims ARE handled: D % 64 != 0 is
+    zero-padded to the next MXU tile and sliced back (zero dims change
+    neither the scores nor the lse; the true 1/sqrt(D) scale is folded
+    into q), so ring attention keeps its per-hop kernel for small-head
+    models.
     """
     B, S, Hq, D = q.shape
     if not flash_lse_supported(S, D):
         raise ValueError(
-            f"flash_attention_lse requires D % 64 == 0 and S % 128 == 0, "
+            f"flash_attention_lse requires S % 128 == 0, "
             f"got S={S}, D={D}; gate on flash_lse_supported()")
+    if D % 64 != 0:
+        qp, kp, vp = _pad_head_dim(q, k, v)
+        out, lse = flash_attention_lse(qp, kp, vp, causal=causal)
+        return out[..., :D], lse
     sm_scale = 1.0 / math.sqrt(D)
     qt, kt, vt = _flat_layout(q, k, v)
     out, lse = _flash_lse(qt, kt, vt, causal, sm_scale)
@@ -542,8 +568,10 @@ def flash_attention_lse(q, k, v, *, causal: bool = True):
 
 
 def flash_lse_supported(S: int, D: int) -> bool:
-    """Shapes the lse-returning kernel path accepts (no padding shim)."""
-    return D % 64 == 0 and S % 128 == 0 and _pick_block(S, BLOCK_Q) > 0
+    """Shapes the lse-returning kernel path accepts (off-tile D is
+    padded internally; S stays strict — the blockwise caller owns the
+    sequence layout)."""
+    return S % 128 == 0 and _pick_block(S, BLOCK_Q) > 0
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -683,17 +711,9 @@ def flash_attention(q, k, v, *, causal: bool = True,
                 "segment_ids and key_padding_mask are mutually exclusive "
                 "(mark padding as its own trailing segment instead)")
     if not _supported(S, D):
-        # Zero-pad D to the MXU tile and fold the TRUE softmax scale
-        # into q: with zero-padded dims the scores are unchanged, and
-        # (q * sqrt(Dp)/sqrt(D)) under the kernel's 1/sqrt(Dp) scale
-        # equals q under 1/sqrt(D).  Autodiff slices the grads back
-        # through the pad (grad-of-pad = slice).
-        dp = -(-D // 64) * 64
-        pad = ((0, 0), (0, 0), (0, 0), (0, dp - D))
-        qp = jnp.pad(q, pad) * jnp.asarray(
-            math.sqrt(dp) / math.sqrt(D), q.dtype)
+        qp, kp, vp = _pad_head_dim(q, k, v)  # see _pad_head_dim
         out = flash_attention(
-            qp, jnp.pad(k, pad), jnp.pad(v, pad), causal=causal,
+            qp, kp, vp, causal=causal,
             key_padding_mask=key_padding_mask, segment_ids=segment_ids)
         return out[..., :D]
     if S % 128 != 0:

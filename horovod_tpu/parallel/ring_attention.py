@@ -107,10 +107,11 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     H % Hkv == 0 (GQA).  Sequence order is the natural shard order: shard
     ``i`` holds positions [i*S_loc, (i+1)*S_loc).  Returns [B, S_loc, H, D].
 
-    When the local shard fits the flash kernel (D % 64 == 0, S_loc a
-    block multiple), each hop's block attention runs the Pallas kernel
-    and hops merge by log-sum-exp (see :func:`_ring_attention_flash`);
-    otherwise the XLA online-softmax path below runs.
+    When the local shard fits the flash kernel (S_loc a block multiple;
+    off-tile head dims are padded inside the kernel wrapper), each hop's
+    block attention runs the Pallas kernel and hops merge by log-sum-exp
+    (see :func:`_ring_attention_flash`); otherwise the XLA
+    online-softmax path below runs.
     """
     from horovod_tpu.ops.flash_attention import (_note_fallback,
                                                  flash_lse_supported)
@@ -118,14 +119,19 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", causal: bool = True):
     if flash_lse_supported(q.shape[1], q.shape[3]) \
             and k.shape[1] == q.shape[1]:
         return _ring_attention_flash(q, k, v, axis_name, causal)
+    # The lse-returning kernel owns no sequence-padding shim; count the
+    # XLA-path choice so losing the per-hop kernel is visible
+    # (ops.flash_attention.fallback_count telemetry) whichever condition
+    # failed.
     if not flash_lse_supported(q.shape[1], q.shape[3]):
-        # The lse-returning kernel has a strict no-shim contract; count
-        # the XLA-path choice so losing the per-hop kernel is visible
-        # (ops.flash_attention.fallback_count telemetry).
         _note_fallback(
             f"ring attention hop uses the XLA online-softmax path: "
-            f"S_loc {q.shape[1]} / head dim {q.shape[3]} off the "
-            f"lse-kernel tiling")
+            f"local shard length {q.shape[1]} is off the lse-kernel "
+            f"tiling (needs a multiple of 128)")
+    else:
+        _note_fallback(
+            f"ring attention hop uses the XLA online-softmax path: KV "
+            f"shard length {k.shape[1]} != Q shard length {q.shape[1]}")
 
     axis_size = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)

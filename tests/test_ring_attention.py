@@ -206,6 +206,68 @@ def test_flash_ring_matches_dense(n_devices):
             err_msg=f"d{name} mismatch (flash ring)")
 
 
+def test_ring_xla_hop_fallback_counted(n_devices):
+    """Losing the per-hop kernel (off-tile S_loc) must be VISIBLE:
+    fallback_count moves and a single RuntimeWarning fires per reason
+    (the telemetry contract the kernel-path tests assert the absence
+    of)."""
+    import warnings
+
+    from horovod_tpu.ops import flash_attention as fa
+
+    mesh = hvd.build_mesh({"seq": 2}, devices=jax.devices()[:2])
+    q, k, v = _rand_qkv(B=1, S=128, H=2, Hkv=2, D=64, seed=14)  # S_loc=64
+    fn = _shard_over_seq(
+        functools.partial(ring_attention, axis_name="seq"), mesh)
+    reason = "ring attention hop uses the XLA online-softmax path"
+    with fa._fallbacks_lock:
+        for r in [r for r in fa._fallbacks if reason in r]:
+            del fa._fallbacks[r]
+    before = fa.fallback_count()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got = fn(q, k, v)
+    assert fa.fallback_count() > before, "XLA hop not counted"
+    msgs = [w for w in caught if reason in str(w.message)]
+    assert len(msgs) == 1, [str(w.message) for w in caught]
+    expected = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_ring_small_head_dim_keeps_kernel(n_devices):
+    """Off-tile head dims (D=32) stay on the per-hop Pallas kernel via
+    the lse wrapper's D-padding (zero dims change neither scores nor
+    lse): no fallback counted, values and grads match dense."""
+    from horovod_tpu.ops import flash_attention as fa
+
+    mesh = hvd.build_mesh({"seq": 2}, devices=jax.devices()[:2])
+    q, k, v = _rand_qkv(B=1, S=256, H=4, Hkv=2, D=32, seed=13)
+    fn = _shard_over_seq(
+        functools.partial(ring_attention, axis_name="seq"), mesh)
+    jaxpr = jax.make_jaxpr(fn)(q, k, v)
+    assert "pallas_call" in str(jaxpr)
+    before = fa.fallback_count()
+    got = fn(q, k, v)
+    assert fa.fallback_count() == before, "XLA hop fallback fired"
+    expected = causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-4, rtol=2e-4)
+
+    def sharded_loss(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(causal_attention(q, k, v).astype(jnp.float32) ** 2)
+
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.jit(jax.grad(sharded_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gd, gf, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=2e-3, rtol=2e-3,
+            err_msg=f"d{name} mismatch (flash ring, padded D)")
+
+
 def test_flash_ring_noncausal_matches_dense(n_devices):
     from horovod_tpu.models.bert import dot_product_attention
 
