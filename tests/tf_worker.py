@@ -144,6 +144,23 @@ def scenario_grouped(rank, size):
     assert gb is None, gb
     np.testing.assert_allclose(ga.numpy(), 2.0)
 
+    # Compression composes with the grouped batch: fp16 on the wire,
+    # decompressed and averaged back in the original dtype.
+    outs = hvd.grouped_allreduce(
+        [tf.fill([64], float(rank + 1)), tf.fill([32], 2.0 * rank)],
+        average=True, compression=hvd.Compression.fp16, name="grp_fp16")
+    np.testing.assert_allclose(outs[0].numpy(), (size + 1) / 2, rtol=1e-3)
+    np.testing.assert_allclose(outs[1].numpy(), float(size - 1), rtol=1e-3)
+    assert outs[0].dtype == tf.float32
+
+    with hvd.DistributedGradientTape(
+            tf.GradientTape(), compression=hvd.Compression.fp16) as t_c:
+        vc = tf.Variable(tf.ones([8]) * (rank + 1))
+        t_c.watch(vc)
+        loss_c = tf.reduce_sum(hvd.allreduce(vc, average=False) * 3.0)
+    (gc,) = t_c.gradient(loss_c, [vc])
+    np.testing.assert_allclose(gc.numpy(), 3.0 * size, rtol=1e-3)
+
     # DistributedGradientTape rides the grouped hot path too.
     vs2 = [tf.Variable(tf.ones([2, 2]) * (i + 1)) for i in range(6)]
     with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
