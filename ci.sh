@@ -22,8 +22,19 @@ make -C horovod_tpu/cpp
 echo "== test suite (8-device virtual CPU mesh) =="
 # conftest.py forces the CPU platform in-process; PALLAS_AXON_POOL_IPS=
 # keeps the image's sitecustomize from registering the TPU plugin so CI
-# never touches (or requires) real hardware.
-PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}"
+# never touches (or requires) real hardware.  Fault-injection tests run
+# in their own hard-timeout gate below.
+# Caller args go BEFORE the marker filter so a user-passed -m cannot
+# override it — the fault tests must only ever run under the hard
+# timeout below (a reintroduced hang would otherwise eat the CI budget).
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault"
+
+echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
+# These tests previously WOULD HANG when a rank died mid-collective; the
+# outer `timeout` makes a regression that reintroduces a hang fail fast
+# (124) instead of eating the whole CI budget.
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
+    python -m pytest tests/ -q -m fault
 
 echo "== multichip sharding dry run =="
 PALLAS_AXON_POOL_IPS= python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8) OK')"

@@ -15,6 +15,7 @@ Frontends (mirroring ``horovod.tensorflow`` / ``horovod.torch`` /
 SURVEY.md §7 steps 5-6.)
 """
 
+from horovod_tpu import elastic
 from horovod_tpu.common import (
     init,
     is_initialized,
@@ -29,6 +30,7 @@ from horovod_tpu.version import __version__
 
 __all__ = [
     "__version__",
+    "elastic",
     "init",
     "shutdown",
     "is_initialized",

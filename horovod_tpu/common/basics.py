@@ -243,9 +243,19 @@ class HorovodBasics:
 
                 # A retried init() after a failure elsewhere finds the JAX
                 # runtime already up — that is fine.  Ask the runtime's own
-                # public API rather than parsing exception text (which is
-                # brittle across JAX versions).
-                if not jax.distributed.is_initialized():
+                # API rather than parsing exception text (which is brittle
+                # across JAX versions); jax < 0.5 has no public
+                # is_initialized, so fall back to the distributed client
+                # singleton it tracks internally.
+                is_init = getattr(jax.distributed, "is_initialized", None)
+                if callable(is_init):
+                    already = is_init()
+                else:
+                    from jax._src import distributed as _jax_dist
+
+                    already = getattr(_jax_dist.global_state, "client",
+                                      None) is not None
+                if not already:
                     jax.distributed.initialize(
                         coordinator_address=jaddr,
                         num_processes=size,
@@ -287,6 +297,13 @@ class HorovodBasics:
                 return
             if self._lib is not None:
                 self._lib.horovod_shutdown()
+                # A later init() restarts the native core with an empty
+                # tensor table; the Python wrapper's auto-name counters
+                # must restart with it or unnamed collectives never
+                # rendezvous with relaunched peers (elastic recovery).
+                from horovod_tpu.runtime.engine import reset_engine_naming
+
+                reset_engine_naming()
             self._initialized = False
 
     # -- queries -----------------------------------------------------------

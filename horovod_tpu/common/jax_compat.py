@@ -1,0 +1,45 @@
+"""Compatibility shims for the installed JAX version.
+
+``jax.shard_map`` only exists as a top-level API in newer JAX; on the
+0.4.x line it lives in ``jax.experimental.shard_map`` and spells the
+replication-checking knob ``check_rep`` instead of ``check_vma``.  The
+seed assumed the new spelling, which broke every jit-path test on this
+image's jax 0.4.37.  Importing this module gives library code one
+``shard_map`` symbol that works on both, and (when needed) aliases it
+onto the ``jax`` namespace so existing ``jax.shard_map(...)`` call sites
+keep working.
+
+Kept in ``common`` (imported lazily by jax-facing modules) so the
+jax-free surfaces — torch/tf frontends, the native-engine workers, the
+elastic module — never pull jax in.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "axis_size"]
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              **kw)
+
+    # Alias for call sites written against the new spelling.
+    jax.shard_map = shard_map
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        # psum of the literal 1 is special-cased to a compile-time
+        # constant equal to the (possibly tuple) axis size — the
+        # long-standing idiom lax.axis_size formalized.
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size

@@ -80,6 +80,18 @@ int64_t horovod_tensors_executed() {
   return Engine::Get().tensors_executed();
 }
 
+// Why the engine aborted, copied into buf (truncated to buflen-1); empty
+// while the engine is healthy or after a clean shutdown.  Lets callers
+// attach the culprit rank to enqueues attempted AFTER the abort, whose
+// handles never existed.
+void horovod_abort_reason(char* buf, int buflen) {
+  std::string msg = Engine::Get().AbortReason();
+  if (buflen <= 0) return;
+  size_t n = std::min(msg.size(), static_cast<size_t>(buflen - 1));
+  memcpy(buf, msg.data(), n);
+  buf[n] = '\0';
+}
+
 int horovod_poll(int64_t handle) { return Engine::Get().Poll(handle); }
 int horovod_wait(int64_t handle) { return Engine::Get().Wait(handle); }
 

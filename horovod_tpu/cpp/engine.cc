@@ -1,13 +1,17 @@
 #include "engine.h"
 
+#include <unistd.h>
+
 #include <algorithm>
 #include <cassert>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <sstream>
 
 #if defined(__x86_64__)
+#include <cpuid.h>
 #include <immintrin.h>
 #endif
 
@@ -140,8 +144,22 @@ static void HalfSumF16C(uint16_t* d, const uint16_t* s, int64_t n) {
 }
 
 static bool HasF16C() {
-  static const bool has = __builtin_cpu_supports("f16c") &&
-                          __builtin_cpu_supports("avx");
+  // Raw CPUID instead of __builtin_cpu_supports("f16c"): GCC only learned
+  // the "f16c" feature name in GCC 11, and the builtin is a compile ERROR
+  // (not a false) on older compilers — which silently broke the whole
+  // native-engine build on GCC 10 images.
+  static const bool has = [] {
+    unsigned int eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+    if ((ecx & bit_F16C) == 0 || (ecx & bit_AVX) == 0) return false;
+    // CPUID only reports CPU capability; the OS must also have enabled
+    // XSAVE and YMM state (what __builtin_cpu_supports checked for us),
+    // or the first VEX instruction SIGILLs.
+    if ((ecx & bit_OSXSAVE) == 0) return false;
+    uint32_t xlo, xhi;
+    __asm__ volatile("xgetbv" : "=a"(xlo), "=d"(xhi) : "c"(0));
+    return (xlo & 0x6) == 0x6;  // XMM and YMM state enabled
+  }();
   return has;
 }
 #endif
@@ -251,11 +269,67 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   int control_patience_sec = static_cast<int>(EnvInt64(
       "HOROVOD_CONTROL_PATIENCE_SEC",
       std::max<int64_t>(600, static_cast<int64_t>(size_) * 30)));
+  // HOROVOD_FAULT_TIMEOUT_SEC: a hard failure-detection bound.  A hung
+  // (not just dead) peer is only detectable by the absence of progress, so
+  // cap BOTH progress bounds — the per-transfer socket timeout and the
+  // control-plane patience — at a THIRD of the fault timeout: the
+  // coordinator burns its patience detecting the culprit (1 round =
+  // fault/3), and a worker's longer wait (2x+1 = 3 rounds, see
+  // worker_patience_rounds_) still totals <= the fault timeout even in
+  // the worst case where the COORDINATOR is the hung rank and no abort
+  // broadcast is coming.
+  fault_timeout_sec_ =
+      static_cast<int>(EnvInt64("HOROVOD_FAULT_TIMEOUT_SEC", 0));
+  if (fault_timeout_sec_ > 0) {
+    int third = std::max(1, fault_timeout_sec_ / 3);
+    if (socket_timeout_sec_ <= 0 || socket_timeout_sec_ > third) {
+      socket_timeout_sec_ = third;
+    }
+    control_patience_sec = std::min(control_patience_sec, third);
+  }
   control_patience_rounds_ =
       socket_timeout_sec_ > 0
           ? std::max(1, control_patience_sec / socket_timeout_sec_)
           : 0;  // timeout disabled: blocking reads, rounds never consulted
+  // Workers out-wait the coordinator (see engine.h) so the abort verdict
+  // naming the culprit wins the race against their own generic timeout.
+  worker_patience_rounds_ =
+      control_patience_rounds_ > 0 ? control_patience_rounds_ * 2 + 1 : 0;
   abort_reason_.clear();
+
+  // Deterministic fault injection for the multiproc fault tests:
+  // HOROVOD_FAULT_INJECT=rank:step:kind (kinds exit|hang|drop-conn).
+  // One-shot per PROCESS (fault_fired_ survives re-Init): an elastic
+  // recovery re-initializes the engine in the same process with the env
+  // var still set, and must not re-fire the fault on every incarnation.
+  fault_kind_ = FaultKind::NONE;
+  fault_step_ = -1;
+  enqueue_count_.store(0);
+  fault_hang_.store(false);
+  fault_drop_.store(false);
+  if (const char* spec = std::getenv("HOROVOD_FAULT_INJECT");
+      !fault_fired_ && spec != nullptr && spec[0] != '\0') {
+    int frank = -1;
+    long long fstep = -1;
+    char fkind[16] = {0};
+    if (std::sscanf(spec, "%d:%lld:%15s", &frank, &fstep, fkind) == 3 &&
+        frank == rank_) {
+      fault_step_ = fstep;
+      if (std::strcmp(fkind, "exit") == 0) {
+        fault_kind_ = FaultKind::EXIT;
+      } else if (std::strcmp(fkind, "hang") == 0) {
+        fault_kind_ = FaultKind::HANG;
+      } else if (std::strcmp(fkind, "drop-conn") == 0) {
+        fault_kind_ = FaultKind::DROP_CONN;
+      } else {
+        std::fprintf(stderr,
+                     "horovod_tpu: unknown HOROVOD_FAULT_INJECT kind '%s' "
+                     "(want exit|hang|drop-conn); ignored\n",
+                     fkind);
+        fault_step_ = -1;
+      }
+    }
+  }
   const char* timeline_path = std::getenv("HOROVOD_TIMELINE");
   if (timeline_path != nullptr && timeline_path[0] != '\0' && rank_ == 0) {
     timeline_.Initialize(timeline_path);
@@ -550,10 +624,15 @@ void Engine::Shutdown() {
 
 // message_table_ is background-thread-only by design (no mu_); this makes
 // the invariant self-checking at every access site instead of
-// comment-enforced.  Cheap enough to keep on in release builds.
+// comment-enforced.  Deliberately NOT assert(): downstream builds override
+// CXXFLAGS (?=) with -DNDEBUG and would silently compile the check out.
 void Engine::AssertBackgroundThread() const {
-  assert(std::this_thread::get_id() == bg_thread_id_.load() &&
-         "message_table_ accessed off the background thread");
+  if (std::this_thread::get_id() != bg_thread_id_.load()) {
+    std::fprintf(stderr,
+                 "horovod_tpu: FATAL: message_table_ accessed off the "
+                 "background thread\n");
+    std::abort();
+  }
 }
 
 void Engine::BackgroundLoop() {
@@ -577,11 +656,39 @@ void Engine::BackgroundLoop() {
   for (auto& e : leftovers) {
     FinishEntry(e, Status::Aborted(reason));
   }
+  // Drop half-negotiated state so a re-Init after an abort (the elastic
+  // recovery path) starts from an empty table instead of poisoning the new
+  // world's readiness counts with the dead world's pending entries.
+  // Thread-correct: this is still the background thread.
+  message_table_.clear();
   // Close every connection so peers blocked in recv see EOF immediately and
   // the failure propagates around the ring instead of stranding them until
   // their own timeout.
   CloseSockets();
   shut_down_.store(true);
+  // Second drain, after the store: an Enqueue racing the first drain can
+  // have inserted between it and the store (its pre-insert liveness check
+  // passed).  Enqueue checks shut_down_ under mu_, so any insert not
+  // caught here observed the store and was rejected — no waiter can be
+  // stranded on a never-finished entry.
+  std::vector<TensorTableEntry> stragglers;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : tensor_table_) stragglers.push_back(std::move(kv.second));
+    tensor_table_.clear();
+    message_queue_.clear();
+  }
+  for (auto& e : stragglers) {
+    FinishEntry(e, Status::Aborted(reason));
+  }
+}
+
+std::string Engine::AbortReason() const {
+  // Publication order: BackgroundLoop writes abort_reason_, then
+  // release-stores shut_down_; acquiring shut_down_ here makes the string
+  // read race-free from API threads.
+  if (!shut_down_.load()) return std::string();
+  return abort_reason_;
 }
 
 void Engine::CloseSockets() {
@@ -623,7 +730,38 @@ std::string Engine::TransportError(const std::string& op,
          "': " + detail;
 }
 
+void Engine::BroadcastAbort(int culprit, const std::string& message) {
+  abort_reason_ = message;
+  std::fprintf(stderr, "horovod_tpu coordinator: %s\n", message.c_str());
+  ResponseList abort_list;
+  abort_list.abort = true;
+  abort_list.abort_rank = culprit;
+  abort_list.abort_message = message;
+  Writer w;
+  SerializeResponseList(abort_list, &w);
+  for (int r = 1; r < size_; ++r) {
+    if (r == culprit || !worker_conns_[r].valid()) continue;
+    // Best effort: a worker that died alongside the culprit just fails the
+    // send; everyone reachable learns the culprit in one frame instead of
+    // discovering the death via their own transport timeouts.
+    worker_conns_[r].SendFrame(w.bytes());
+  }
+}
+
 bool Engine::RunLoopOnce() {
+  if (fault_hang_.load()) {
+    // Injected wedge: stay alive but stop cycling.  Control frames cease;
+    // peers must detect the hang via HOROVOD_FAULT_TIMEOUT_SEC /
+    // HOROVOD_CONTROL_PATIENCE_SEC, exactly like a real stuck process.
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    return true;
+  }
+  if (fault_drop_.load()) {
+    abort_reason_ =
+        "fault injection: dropped all connections (HOROVOD_FAULT_INJECT)";
+    CloseSockets();  // abrupt: no shutdown handshake, peers see raw EOF
+    return false;
+  }
   std::this_thread::sleep_for(std::chrono::milliseconds(cycle_time_ms_));
 
   RequestList my_list;
@@ -672,20 +810,17 @@ bool Engine::RunLoopOnce() {
       std::string who = "control frame from rank " + std::to_string(r);
       if (!worker_conns_[r].RecvFrame(&frame, control_patience_rounds_,
                                       who.c_str())) {
-        abort_reason_ = "coordinator lost connection to rank " +
-                        std::to_string(r) +
-                        " — that process likely crashed or hung; check its "
-                        "logs.";
-        std::fprintf(stderr, "horovod_tpu coordinator: %s\n",
-                     abort_reason_.c_str());
+        BroadcastAbort(
+            r, "coordinator lost connection to rank " + std::to_string(r) +
+                   " — that process crashed, hung, or dropped its "
+                   "connection; check its logs. Aborting all ranks.");
         return false;
       }
       Reader reader(frame.data(), frame.size());
       if (!ParseRequestList(&reader, &lists[r])) {
-        abort_reason_ = "coordinator received a corrupt control frame from "
-                        "rank " + std::to_string(r) + ".";
-        std::fprintf(stderr, "horovod_tpu coordinator: %s\n",
-                     abort_reason_.c_str());
+        BroadcastAbort(
+            r, "coordinator received a corrupt control frame from rank " +
+                   std::to_string(r) + ". Aborting all ranks.");
         return false;
       }
     }
@@ -694,11 +829,10 @@ bool Engine::RunLoopOnce() {
     SerializeResponseList(response_list, &w);
     for (int r = 1; r < size_; ++r) {
       if (!worker_conns_[r].SendFrame(w.bytes())) {
-        abort_reason_ = "coordinator could not reach rank " +
-                        std::to_string(r) +
-                        " — that process likely crashed; check its logs.";
-        std::fprintf(stderr, "horovod_tpu coordinator: %s\n",
-                     abort_reason_.c_str());
+        BroadcastAbort(
+            r, "coordinator could not reach rank " + std::to_string(r) +
+                   " — that process likely crashed; check its logs. "
+                   "Aborting all ranks.");
         return false;
       }
     }
@@ -709,23 +843,34 @@ bool Engine::RunLoopOnce() {
   }
 
   // Worker: ship requests up, execute the agreed response list.
+  const std::string lost_coordinator =
+      "lost connection to the coordinator (rank 0) — it likely crashed or "
+      "another rank failed; check rank 0's logs.";
   Writer w;
   SerializeRequestList(my_list, &w);
   if (!coordinator_conn_.SendFrame(w.bytes())) {
-    abort_reason_ = "lost connection to the coordinator (rank 0) — it "
-                    "likely crashed or another rank failed; check rank 0's "
-                    "logs.";
+    // The coordinator may have broadcast an abort (naming the culprit
+    // rank) just before tearing down; that frame survives in our receive
+    // buffer even though the send direction is dead.  Salvage it so the
+    // error names the rank that actually failed, not just "rank 0 gone".
+    std::vector<uint8_t> frame;
+    ResponseList rl;
+    if (coordinator_conn_.RecvFrame(&frame)) {
+      Reader r(frame.data(), frame.size());
+      if (ParseResponseList(&r, &rl) && rl.abort) {
+        abort_reason_ = rl.abort_message;
+      }
+    }
+    if (abort_reason_.empty()) abort_reason_ = lost_coordinator;
     std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
                  abort_reason_.c_str());
     return false;
   }
   std::vector<uint8_t> frame;
-  if (!coordinator_conn_.RecvFrame(&frame, control_patience_rounds_,
+  if (!coordinator_conn_.RecvFrame(&frame, worker_patience_rounds_,
                                    "response frame from the coordinator "
                                    "(rank 0)")) {
-    abort_reason_ = "lost connection to the coordinator (rank 0) — it "
-                    "likely crashed or another rank failed; check rank 0's "
-                    "logs.";
+    abort_reason_ = lost_coordinator;
     std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
                  abort_reason_.c_str());
     return false;
@@ -735,6 +880,16 @@ bool Engine::RunLoopOnce() {
   if (!ParseResponseList(&reader, &response_list)) {
     abort_reason_ = "corrupt control frame from the coordinator.";
     std::fprintf(stderr, "horovod_tpu rank %d: bad response frame\n", rank_);
+    return false;
+  }
+  if (response_list.abort) {
+    // Coordinator-initiated collective abort: another rank failed.
+    abort_reason_ = response_list.abort_message.empty()
+        ? ("coordinator aborted the job: rank " +
+           std::to_string(response_list.abort_rank) + " failed")
+        : response_list.abort_message;
+    std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
+                 abort_reason_.c_str());
     return false;
   }
   if (!response_list.responses.empty()) exec_cycles_.fetch_add(1);
@@ -1593,10 +1748,46 @@ void Engine::CheckForStalledTensors() {
 // Public enqueue / handle API
 // ---------------------------------------------------------------------------
 
+// Fires the armed HOROVOD_FAULT_INJECT action when this rank's enqueue
+// counter reaches the configured step.  Runs in the enqueueing (API)
+// thread; HANG/DROP_CONN only set flags the background loop acts on, so
+// every effect lands at a deterministic point regardless of cycle timing.
+void Engine::MaybeInjectFault() {
+  if (fault_kind_ == FaultKind::NONE) return;
+  int64_t idx = enqueue_count_.fetch_add(1);
+  if (idx != fault_step_) return;
+  fault_fired_ = true;  // once per process, not per engine incarnation
+  switch (fault_kind_) {
+    case FaultKind::EXIT:
+      std::fprintf(stderr,
+                   "horovod_tpu rank %d: fault injection: exiting at "
+                   "enqueue %lld\n",
+                   rank_, static_cast<long long>(idx));
+      _exit(41);
+    case FaultKind::HANG:
+      std::fprintf(stderr,
+                   "horovod_tpu rank %d: fault injection: freezing the "
+                   "background loop at enqueue %lld\n",
+                   rank_, static_cast<long long>(idx));
+      fault_hang_.store(true);
+      break;
+    case FaultKind::DROP_CONN:
+      std::fprintf(stderr,
+                   "horovod_tpu rank %d: fault injection: dropping all "
+                   "connections at enqueue %lld\n",
+                   rank_, static_cast<long long>(idx));
+      fault_drop_.store(true);
+      break;
+    case FaultKind::NONE:
+      break;
+  }
+}
+
 int64_t Engine::Enqueue(RequestType type, const std::string& name,
                         DataType dtype, const std::vector<int64_t>& shape,
                         void* data, int root_rank, ReduceOp red_op,
                         bool probe) {
+  MaybeInjectFault();
   if (!initialized_.load() || shutdown_requested_.load() ||
       shut_down_.load()) {
     return -2;
@@ -1629,6 +1820,15 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
 
   {
     std::lock_guard<std::mutex> lk(mu_);
+    // Re-check liveness under mu_: the background loop's teardown drains
+    // the table, stores shut_down_, then drains again — so an insert that
+    // slipped past the entry check either lands before the second drain
+    // (and is failed by it) or observes shut_down_ here and is rejected.
+    if (shut_down_.load()) {
+      std::lock_guard<std::mutex> hlk(handle_mu_);
+      handles_.erase(handle);
+      return -2;
+    }
     if (tensor_table_.count(name) != 0) {
       std::lock_guard<std::mutex> hlk(handle_mu_);
       handles_.erase(handle);

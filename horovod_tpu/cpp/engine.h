@@ -104,6 +104,12 @@ class Engine {
   int64_t responses_executed() const { return responses_executed_.load(); }
   int64_t tensors_executed() const { return tensors_executed_.load(); }
 
+  // Why the engine aborted ("" while healthy or after a clean shutdown).
+  // Safe to call from any thread: the background thread publishes
+  // abort_reason_ before its shut_down_ release-store, and this reads it
+  // only after observing shut_down_.
+  std::string AbortReason() const;
+
   int Poll(int64_t handle);                  // 0 pending, 1 ok, -1 error
   int Wait(int64_t handle);                  // blocks; returns Poll result
   std::string ErrorMessage(int64_t handle);
@@ -117,6 +123,10 @@ class Engine {
   Engine() = default;
   void BackgroundLoop();
   bool RunLoopOnce();                        // returns false on shutdown
+  // Coordinator-only: tell every still-reachable worker that `culprit`
+  // failed, so survivors abort promptly instead of waiting out their own
+  // transport timeouts; sets abort_reason_ to `message`.
+  void BroadcastAbort(int culprit, const std::string& message);
   ResponseList CoordinatorStep(std::vector<RequestList>& lists);
   Response BuildResponse(const std::string& name);
   void FuseResponses(std::vector<Response>& responses);
@@ -162,6 +172,38 @@ class Engine {
   // Idle-round allowance for control-plane frames, derived from
   // HOROVOD_CONTROL_PATIENCE_SEC (absolute, world-size independent).
   int control_patience_rounds_ = 5;
+  // Worker-side allowance while waiting on the coordinator's response
+  // frame: strictly MORE than the coordinator's, because the coordinator
+  // is the failure detector — when another rank wedges, the coordinator
+  // must exhaust its own patience and broadcast the abort (naming the
+  // culprit) BEFORE an idle worker gives up and can only self-diagnose a
+  // generic "lost the coordinator".
+  int worker_patience_rounds_ = 11;
+  // HOROVOD_FAULT_TIMEOUT_SEC (0 = off): hard bound on the time between a
+  // rank dying/hanging and every survivor's HorovodInternalError.  When
+  // set it caps both the per-transfer socket timeout and the control-plane
+  // patience, so detection never waits out the (much longer) production
+  // defaults.
+  int fault_timeout_sec_ = 0;
+
+  // -- deterministic fault injection (HOROVOD_FAULT_INJECT=rank:step:kind;
+  //    kinds: exit | hang | drop-conn).  Armed at Init when rank matches;
+  //    fires on the `step`-th Enqueue on this rank (0-based, counting every
+  //    collective).  `exit` dies in the enqueueing thread; `hang` freezes
+  //    the background loop (control frames stop, the process stays alive);
+  //    `drop-conn` makes the background loop close every connection and
+  //    abort locally without any shutdown handshake. --
+  enum class FaultKind { NONE, EXIT, HANG, DROP_CONN };
+  FaultKind fault_kind_ = FaultKind::NONE;
+  int64_t fault_step_ = -1;
+  // Survives re-Init: an injected fault fires once per process, so an
+  // in-process elastic recovery (shutdown + init with the env var still
+  // set) does not re-fire it on every incarnation.
+  bool fault_fired_ = false;
+  std::atomic<int64_t> enqueue_count_{0};
+  std::atomic<bool> fault_hang_{false};
+  std::atomic<bool> fault_drop_{false};
+  void MaybeInjectFault();
 
   // Why the background loop aborted (set by the background thread before
   // RunLoopOnce returns false on a transport failure, read by it right

@@ -71,12 +71,18 @@ static bool ParseResponse(Reader* r, Response* s) {
 
 void SerializeResponseList(const ResponseList& list, Writer* w) {
   w->u8(list.shutdown ? 1 : 0);
+  w->u8(list.abort ? 1 : 0);
+  w->i32(list.abort_rank);
+  w->str(list.abort_message);
   w->u32(static_cast<uint32_t>(list.responses.size()));
   for (const auto& s : list.responses) SerializeResponse(s, w);
 }
 
 bool ParseResponseList(Reader* r, ResponseList* out) {
   out->shutdown = r->u8() != 0;
+  out->abort = r->u8() != 0;
+  out->abort_rank = r->i32();
+  out->abort_message = r->str();
   uint32_t n = r->u32();
   out->responses.resize(n);
   for (uint32_t i = 0; i < n; ++i) {

@@ -109,6 +109,15 @@ struct Response {
 struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
+  // Fault-tolerance abort broadcast: when the coordinator loses a rank
+  // (EOF, keepalive, or HOROVOD_FAULT_TIMEOUT_SEC exceeded) it ships this
+  // instead of a normal cycle so every SURVIVING rank fails its in-flight
+  // and queued collectives promptly with a message naming the culprit,
+  // rather than each rank discovering the death via its own transport
+  // timeout one collective at a time.
+  bool abort = false;
+  int32_t abort_rank = -1;      // the rank the coordinator lost
+  std::string abort_message;
 };
 
 // Flat byte-buffer serialization (host byte order; in-cluster only).

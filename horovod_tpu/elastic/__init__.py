@@ -1,0 +1,27 @@
+"""Elastic fault-tolerant training (TPU-native Elastic Horovod analogue).
+
+The engine's failure detector turns a dead, hung, or disconnected rank
+into a prompt :class:`~horovod_tpu.runtime.engine.HorovodInternalError`
+on every surviving rank (naming the culprit).  This package supplies the
+recovery half:
+
+* :class:`ElasticState` — commit/restore snapshots of params, optimizer
+  state, and step counters as host-side numpy copies, plus ``sync()`` to
+  broadcast the committed state from rank 0 so a relaunched worker joins
+  at the survivors' rollback point.
+* :func:`run_elastic` — a driver that runs ``train_fn(state)``, and on a
+  collective failure re-initializes the runtime, rolls back to the last
+  commit, and retries with capped exponential backoff
+  (``HOROVOD_ELASTIC_MAX_RETRIES`` / ``HOROVOD_ELASTIC_BACKOFF_SEC``).
+
+Deliberately jax-free (numpy + the native engine only) so the torch
+frontend and multi-process tests can use it standalone; jax array leaves
+are accepted and come back as numpy (jax ops coerce them transparently).
+
+See docs/elastic.md for the failure model and semantics.
+"""
+
+from horovod_tpu.elastic.driver import run_elastic
+from horovod_tpu.elastic.state import ElasticState
+
+__all__ = ["ElasticState", "run_elastic"]

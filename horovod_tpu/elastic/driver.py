@@ -1,0 +1,94 @@
+"""The elastic retry driver: catch → re-init → rollback → resume.
+
+``run_elastic(train_fn, state)`` is the TPU-native analogue of Elastic
+Horovod's ``@hvd.elastic.run`` wrapper: the training function runs until
+it either finishes or a rank failure surfaces as
+:class:`HorovodInternalError`; on failure the driver tears the engine
+down, waits out a capped exponential backoff, re-rendezvouses (the
+launcher's ``--restart-on-failure`` supervisor replaces dead workers in
+the meantime), rolls the state back to its last commit, and re-enters
+``train_fn``.  ``state.sync()`` at every (re-)entry makes rank 0's
+committed state authoritative, so relaunched workers join at the
+survivors' rollback point instead of step 0.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+from horovod_tpu.common.basics import basics
+from horovod_tpu.elastic.state import ElasticState
+from horovod_tpu.runtime.engine import HorovodInternalError
+
+__all__ = ["run_elastic"]
+
+#: Ceiling on any single backoff sleep, however many doublings happened.
+_BACKOFF_CAP_SEC = 30.0
+
+
+def _env_num(name: str, default, cast):
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    return cast(value)
+
+
+def run_elastic(train_fn: Callable[[ElasticState], object],
+                state: ElasticState, *,
+                max_retries: Optional[int] = None,
+                backoff_sec: Optional[float] = None):
+    """Run ``train_fn(state)`` with checkpoint-rollback recovery.
+
+    ``train_fn`` should loop on ``state`` (e.g. ``while state.step < N``),
+    call ``state.commit()`` at durable points, and simply let
+    ``HorovodInternalError`` propagate — the driver owns recovery.  Its
+    return value is returned when it completes.
+
+    Retries are bounded by ``max_retries`` (default
+    ``HOROVOD_ELASTIC_MAX_RETRIES``, 3); the budget RESETS whenever a
+    commit landed since the previous failure, so a long run survives many
+    spaced-out failures while a crash loop still terminates.  Backoff
+    starts at ``backoff_sec`` (default ``HOROVOD_ELASTIC_BACKOFF_SEC``,
+    1.0) and doubles per consecutive failure, capped at 30 s.
+    """
+    if max_retries is None:
+        max_retries = _env_num("HOROVOD_ELASTIC_MAX_RETRIES", 3, int)
+    if backoff_sec is None:
+        backoff_sec = _env_num("HOROVOD_ELASTIC_BACKOFF_SEC", 1.0, float)
+
+    retries = 0
+    while True:
+        commits_at_entry = None
+        try:
+            if not basics.is_initialized():
+                basics.init()
+            state.sync()
+            commits_at_entry = state.commit_count
+            return train_fn(state)
+        except HorovodInternalError as e:
+            if commits_at_entry is not None \
+                    and state.commit_count > commits_at_entry:
+                retries = 0  # made durable progress since the last failure
+            retries += 1
+            if retries > max_retries:
+                print(
+                    "horovod_tpu elastic: giving up after "
+                    f"{max_retries} consecutive retries: {e}",
+                    file=sys.stderr, flush=True)
+                raise
+            delay = min(backoff_sec * (2 ** (retries - 1)),
+                        _BACKOFF_CAP_SEC)
+            print(
+                f"horovod_tpu elastic: collective failure ({e}); "
+                f"rolling back to the last commit and retrying in "
+                f"{delay:.1f}s (attempt {retries}/{max_retries})",
+                file=sys.stderr, flush=True)
+            try:
+                basics.shutdown()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+            state.restore()
+            time.sleep(delay)

@@ -1,0 +1,164 @@
+"""Commit/restore/sync of training state for elastic recovery.
+
+Role parity with Elastic Horovod's ``hvd.elastic.State`` (commit /
+restore / sync): the state object owns named slots (params, optimizer
+state, step counter, ...), snapshots them to host numpy on ``commit()``,
+rolls back on ``restore()``, and ``sync()`` broadcasts the current values
+from a root so every rank — including a freshly relaunched worker —
+proceeds from identical state.
+
+Slots hold pytrees: arbitrarily nested dict / list / tuple (incl.
+namedtuples, so raw optax states work) with array-like or scalar leaves.
+Leaves are traversed in sorted-key order so cross-rank collective names
+rendezvous deterministically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu.runtime import engine_or_none
+
+__all__ = ["ElasticState"]
+
+
+def _host_copy(obj):
+    """Deep copy a pytree with every array leaf as a host numpy copy."""
+    if isinstance(obj, dict):
+        return {k: _host_copy(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        vals = [_host_copy(v) for v in obj]
+        if hasattr(obj, "_fields"):  # namedtuple (e.g. optax state)
+            return type(obj)(*vals)
+        return tuple(vals)
+    if isinstance(obj, list):
+        return [_host_copy(v) for v in obj]
+    if obj is None or isinstance(obj, (bool, int, float, complex, str,
+                                       bytes)):
+        return obj
+    # Array-like (numpy, jax, torch-on-cpu via __array__): materialize on
+    # host, detached from any device buffer the engine might clobber.
+    return np.array(np.asarray(obj), copy=True)
+
+
+def _walk(obj, path, visit):
+    """Rebuild a pytree, calling ``visit(path, leaf)`` on every tensor
+    leaf (non-tensor leaves pass through untouched).  Dict keys traverse
+    in sorted order so cross-rank collective names rendezvous."""
+    if isinstance(obj, dict):
+        return {k: _walk(obj[k], f"{path}.{k}", visit)
+                for k in sorted(obj, key=str)}
+    if isinstance(obj, tuple):
+        vals = [_walk(v, f"{path}.{i}", visit) for i, v in enumerate(obj)]
+        if hasattr(obj, "_fields"):
+            return type(obj)(*vals)
+        return tuple(vals)
+    if isinstance(obj, list):
+        return [_walk(v, f"{path}.{i}", visit) for i, v in enumerate(obj)]
+    if obj is None or isinstance(obj, (str, bytes)):
+        return obj
+    if np.asarray(obj).dtype == object:
+        return obj  # not a tensor leaf; nothing to broadcast
+    return visit(path, obj)
+
+
+class ElasticState:
+    """Named training-state slots with commit/rollback semantics.
+
+    >>> state = ElasticState(params=params, opt=opt_state, step=0)
+    >>> state.step += 1; state.params = new_params
+    >>> state.commit()          # durable point: rollback target
+    >>> state.restore()         # back to the last commit
+    >>> state.sync()            # adopt rank 0's values everywhere
+
+    The constructor takes the initial snapshot, so ``restore()`` is always
+    well-defined.  Slots are plain attributes between calls; only the
+    names given at construction are tracked.
+    """
+
+    def __init__(self, **slots):
+        if not slots:
+            raise ValueError("ElasticState needs at least one named slot")
+        self._keys = sorted(slots)
+        for k, v in slots.items():
+            setattr(self, k, v)
+        self._commit_count = 0
+        self._snapshot: dict = {}
+        self.commit()
+
+    @property
+    def commit_count(self) -> int:
+        """Monotonic count of commits (incl. the constructor's and each
+        ``sync()``'s) — :func:`run_elastic` uses it to detect progress
+        between failures and reset its retry budget."""
+        return self._commit_count
+
+    def commit(self) -> None:
+        """Snapshot every slot to host numpy; the new rollback target."""
+        self._snapshot = {k: _host_copy(getattr(self, k))
+                          for k in self._keys}
+        self._commit_count += 1
+
+    def restore(self) -> None:
+        """Roll every slot back to the last commit (copies, so later
+        mutation cannot corrupt the snapshot)."""
+        for k in self._keys:
+            setattr(self, k, _host_copy(self._snapshot[k]))
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Broadcast every slot from ``root_rank`` and commit the result.
+
+        Collective: all ranks must call it at the same point.  After a
+        failure, survivors ``restore()`` then ``sync()`` while a
+        relaunched worker syncs its fresh state — everyone leaves with
+        rank 0's committed values (including step counters).
+        """
+        eng = engine_or_none()
+        if eng is not None:
+            # Enqueue EVERY leaf broadcast before synchronizing any (the
+            # engine's batched idiom, cf. eager.grouped_allreduce): the
+            # coordinator negotiates the whole batch in ~one cycle
+            # instead of paying one blocking round-trip per leaf.
+            handles = []
+
+            def enqueue(path, leaf):
+                arr = np.asarray(leaf)
+                buf = np.ascontiguousarray(
+                    arr.reshape(1) if arr.ndim == 0 else arr).copy()
+                handles.append(eng.enqueue_broadcast(
+                    buf, root_rank, name=f"elastic.sync.{path}"))
+                return leaf
+
+            for k in self._keys:
+                _walk(getattr(self, k), k, enqueue)
+            # Drain every handle even when one fails (same hygiene as
+            # grouped_allreduce: a half-drained batch would poison the
+            # retry after a mid-sync abort with duplicate-name errors).
+            outs, first_err = [], None
+            for h in handles:
+                try:
+                    outs.append(eng.synchronize(h))
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    if first_err is None:
+                        first_err = e
+                    outs.append(None)
+            if first_err is not None:
+                raise first_err
+            results = iter(outs)
+
+            def adopt(path, leaf):
+                out = next(results)
+                if np.asarray(leaf).ndim == 0:
+                    val = out.reshape(())[()]
+                    if isinstance(leaf, bool):
+                        return bool(val)
+                    if isinstance(leaf, int):
+                        return int(val)
+                    if isinstance(leaf, float):
+                        return float(val)
+                    return val
+                return out
+
+            for k in self._keys:
+                setattr(self, k, _walk(getattr(self, k), k, adopt))
+        self.commit()

@@ -34,6 +34,8 @@ from typing import Callable, Optional
 import jax
 from jax.sharding import Mesh, PartitionSpec
 
+from horovod_tpu.common.jax_compat import shard_map
+
 from horovod_tpu.common import (
     init,
     is_initialized,
@@ -376,10 +378,11 @@ def make_train_step(loss_fn: Callable, optimizer, mesh: Optional[Mesh] = None,
     # check_vma=False because this step implements the Horovod pattern —
     # an EXPLICIT grad psum in DistributedOptimizer.update — whereas
     # VMA-aware AD would itself psum the cotangents of the replicated
-    # params (double-reduction).  Composing a loss_fn that uses
-    # pipeline_apply with this builder is guarded: pipeline_apply raises
-    # at trace time when VMA checking is off (parallel/pipeline.py).
-    step = jax.shard_map(
+    # params (double-reduction).  pipeline_apply composes with this
+    # builder: its broadcast-from-last-stage pins its own vjp, so it
+    # differentiates identically with VMA checking on or off
+    # (parallel/pipeline.py).
+    step = shard_map(
         _sharded_step_aux if has_aux else _sharded_step,
         mesh=mesh,
         in_specs=(replicated,) * n_state + (batch_spec,),

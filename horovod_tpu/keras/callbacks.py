@@ -67,10 +67,20 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
         # Batch 0's forward then runs on equalized weights on every rank,
         # matching the reference's strictly-before-training broadcast
         # (callbacks_impl.py:20-30).
+        #
+        # XLA caveat: tf.py_function cannot lower under XLA, and Keras 3
+        # resolves jit_compile='auto' to True whenever TF sees a non-CPU
+        # device — embedding the hook would fail fit() at batch 0.  With
+        # jit_compile on we instead run step 0 EAGERLY (run_eagerly wins
+        # over jit_compile in the Keras trainer): the build + broadcast
+        # happen as plain Python before the step body, and the unhook
+        # restores the jitted path for every later step (one retrace).
         import tensorflow as tf
 
         model, cb = self.model, self
         orig_train_step = model.train_step
+        jit = bool(getattr(model, "jit_compile", False))
+        orig_run_eagerly = bool(getattr(model, "run_eagerly", False))
 
         def _host_broadcast():
             if not (cb._weights_done and cb._opt_done):
@@ -87,11 +97,17 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
             build = getattr(model, "_symbolic_build", None)
             if callable(build) and data is not None:
                 build(data_batch=data)
+            if jit:
+                # Eager first step: broadcast directly, no py_function.
+                _host_broadcast()
+                return orig_train_step(*args, **kwargs)
             done = tf.py_function(_host_broadcast, [], Tout=tf.int32)
             with tf.control_dependencies([done]):
                 return orig_train_step(*args, **kwargs)
 
         model.train_step = train_step_with_broadcast
+        if jit:
+            model.run_eagerly = True
         # fit() already captured the unwrapped train_step into its
         # train_function (make_train_function runs before
         # on_train_begin); rebuild so the wrapper is the one traced.
@@ -101,6 +117,8 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
 
         def _unhook():
             model.train_step = orig_train_step
+            if jit:
+                model.run_eagerly = orig_run_eagerly
             if getattr(model, "train_function", None) is not None:
                 model.make_train_function(force=True)
 

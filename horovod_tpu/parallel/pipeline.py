@@ -17,13 +17,14 @@ No reference equivalent (Horovod 0.15.1 is data-parallel only, SURVEY.md
   semantics: all microbatch activations live until backward; wrap
   ``stage_fn`` in ``jax.checkpoint`` to trade FLOPs for memory).
 
-IMPORTANT: ``pipeline_apply`` requires ``shard_map(..., check_vma=True)``
-(the default) and raises at trace time otherwise.  The final
-broadcast-from-last-stage is a masked psum; with ``check_vma=False`` its
-transpose conservatively sums the replicated cotangents and every stage
-gradient comes out multiplied by the stage count.  VMA-aware shard_map
-tracks the output as replicated and transposes correctly (verified against
-sequential-execution gradients in tests/test_pipeline.py).
+The final broadcast-from-last-stage pins its own vjp
+(``_broadcast_from_last``): relying on AD's psum transpose there is
+version-sensitive — the check_rep jax line conservatively sums the
+replicated cotangents (inflating every stage gradient by the stage
+count), the VMA line transposes correctly — so the rule is written by
+hand and ``pipeline_apply`` differentiates identically under
+``check_vma=True`` AND ``check_vma=False`` on both lines (verified
+against sequential-execution gradients in tests/test_pipeline.py).
 """
 
 from __future__ import annotations
@@ -33,6 +34,8 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from horovod_tpu.common.jax_compat import shard_map
 
 from horovod_tpu.ops.losses import softmax_cross_entropy
 
@@ -55,6 +58,33 @@ def unstack_pytree(tree, n: int):
     return [jax.tree.map(lambda x: x[i], tree) for i in range(n)]
 
 
+# Broadcast-from-last-stage with an EXPLICIT vjp.  The forward is the
+# masked psum; the correct cotangent is simply the (replicated) output
+# cotangent delivered to the last stage and zero elsewhere.  Relying on
+# AD's psum transpose here is version-sensitive — jax's shard_map AD
+# changed the replicated-cotangent convention between the check_rep line
+# (0.4.x: transpose sums the replicas, inflating every stage gradient by
+# the stage count) and the VMA line — so the rule is pinned by hand.
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _broadcast_from_last(outputs, mask, axis_name):
+    return lax.psum(outputs * mask, axis_name)
+
+
+def _broadcast_from_last_fwd(outputs, mask, axis_name):
+    return _broadcast_from_last(outputs, mask, axis_name), mask
+
+
+def _broadcast_from_last_bwd(axis_name, mask, g):
+    return (g * mask, jnp.zeros_like(mask))
+
+
+_broadcast_from_last.defvjp(_broadcast_from_last_fwd,
+                            _broadcast_from_last_bwd)
+
+
 def pipeline_apply(stage_fn: Callable, stage_params, x, *,
                    axis_name: str = "pipe", n_microbatches: int):
     """Run ``x`` through the pipeline.  Call inside ``shard_map`` with
@@ -68,16 +98,6 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
     """
     n_stages = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    # Guard against the silent-wrong-gradients mode documented above: with
-    # check_vma=False the axis_index aval does not track its varying axis,
-    # so this is a reliable trace-time probe of the enclosing shard_map.
-    if axis_name not in jax.typeof(idx).vma:
-        raise ValueError(
-            "pipeline_apply must run under shard_map(..., check_vma=True): "
-            "with VMA checking off, the transpose of the final "
-            "broadcast-from-last-stage psum sums replicated cotangents and "
-            "every stage gradient comes out multiplied by the stage count."
-        )
     M = n_microbatches
     B = x.shape[0]
     if B % M != 0:
@@ -104,9 +124,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
 
     # Everyone receives the final result (masked psum = broadcast from the
     # last stage) so loss/metrics can be computed replicated.
-    outputs = lax.psum(
-        jnp.where(idx == n_stages - 1, outputs, jnp.zeros_like(outputs)),
-        axis_name)
+    mask = (idx == n_stages - 1).astype(outputs.dtype)
+    outputs = _broadcast_from_last(outputs, mask, axis_name)
     return outputs.reshape((B,) + x.shape[1:])
 
 
@@ -199,13 +218,25 @@ def make_pipelined_llama_train_step(cfg, optimizer, mesh, *,
         denom = inputs.shape[0] * n_data * inputs.shape[1]
         loss_sum, grads = jax.value_and_grad(
             _local_loss, argnums=(0, 1))(stages, rest, inputs, targets)
-        # Under check_vma=True, AD already psums the cotangents of the
-        # data-INVARIANT params over the data axes (transpose of the
-        # implicit pbroadcast) — an explicit grad psum here would
-        # double-count.  Only the (data-varying) loss scalar needs one.
+        g_stages, g_rest = grads
+        # Horovod pattern (check_vma=False + explicit grad psums — same
+        # discipline as make_train_step and the seq builder, and identical
+        # on both jax AD lines, where VMA-aware AD would instead insert
+        # these reductions itself): each shard holds partial cotangents.
+        # tok_emb feeds the pipeline INPUT, so its cotangent lives only on
+        # the stage-0 shard — collect it with a psum over pipe.  norm_f /
+        # lm_head act on the replicated broadcast OUTPUT, so every pipe
+        # shard already holds their full cotangent — no pipe reduction.
+        # Everything then reduces over the data axes it is invariant to.
+        g_rest = dict(g_rest)
+        g_rest["tok_emb"] = jax.tree.map(
+            lambda a: lax.psum(a, pipe_axis), g_rest["tok_emb"])
         if batch_axes:
             loss_sum = lax.psum(loss_sum, batch_axes)
-        g_stages, g_rest = grads
+            g_stages = jax.tree.map(lambda a: lax.psum(a, batch_axes),
+                                    g_stages)
+            g_rest = jax.tree.map(lambda a: lax.psum(a, batch_axes),
+                                  g_rest)
         g_stages = jax.tree.map(lambda a: a[None] / denom, g_stages)
         g_rest = jax.tree.map(lambda a: a / denom, g_rest)
         return loss_sum / denom, {"stages": g_stages, "rest": g_rest}
@@ -214,7 +245,7 @@ def make_pipelined_llama_train_step(cfg, optimizer, mesh, *,
     batch_spec = P(tuple(batch_axes) if batch_axes else None)
 
     def step(params, opt_state, inputs, targets):
-        loss, grads = jax.shard_map(
+        loss, grads = shard_map(
             _grads, mesh=mesh,
             in_specs=(
                 jax.tree.map(lambda _: stage_specs, params["stages"]),
@@ -225,7 +256,7 @@ def make_pipelined_llama_train_step(cfg, optimizer, mesh, *,
                 {"stages": jax.tree.map(lambda _: stage_specs,
                                         params["stages"]),
                  "rest": jax.tree.map(lambda _: P(), params["rest"])}),
-            check_vma=True,
+            check_vma=False,
         )(params["stages"], params["rest"], inputs, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -10,6 +10,14 @@ first failure (terminating the rest, like mpirun's default behavior).
 Multi-host: run the launcher once per host with ``--hosts-total`` /
 ``--host-index`` / ``--coordinator host0:port`` so ranks are globally
 numbered and all processes rendezvous at host 0.
+
+Fault tolerance: ``--restart-on-failure N`` switches the launcher into a
+supervisor that relaunches a dead worker (same rank, same env) up to N
+times total instead of tearing the job down — pair it with workers built
+on :func:`horovod_tpu.elastic.run_elastic`, whose surviving ranks roll
+back to their last commit and re-rendezvous with the replacement.  A
+relaunched worker's env is scrubbed of ``HOROVOD_FAULT_INJECT`` so an
+injected fault fires once, not on every incarnation.
 """
 
 from __future__ import annotations
@@ -50,6 +58,12 @@ def main(argv=None) -> int:
     parser.add_argument("--procs-per-host", type=int, default=None,
                         help="ranks per host (default: -np)")
     parser.add_argument("--hosts-total", type=int, default=1)
+    parser.add_argument("--restart-on-failure", type=int, default=0,
+                        metavar="N",
+                        help="supervisor mode: relaunch a worker that "
+                             "exits non-zero (same rank/env), up to N "
+                             "relaunches total, instead of terminating "
+                             "the job (pair with horovod_tpu.elastic)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run (prefix with --)")
     args = parser.parse_args(argv)
@@ -64,9 +78,9 @@ def main(argv=None) -> int:
     world = pph * args.hosts_total
     coordinator = args.coordinator or f"127.0.0.1:{_free_port()}"
 
-    procs: list[subprocess.Popen] = []
     threads = []
-    for local_rank in range(args.num_proc):
+
+    def spawn(local_rank: int, scrub_fault_inject: bool = False):
         rank = args.host_index * pph + local_rank
         env = dict(os.environ)
         env.update({
@@ -76,14 +90,23 @@ def main(argv=None) -> int:
             "HOROVOD_LOCAL_SIZE": str(pph),
             "HOROVOD_COORDINATOR": coordinator,
         })
+        if scrub_fault_inject:
+            # A relaunched incarnation must not re-fire the injected
+            # fault at the same step, or the job would never converge.
+            env.pop("HOROVOD_FAULT_INJECT", None)
         p = subprocess.Popen(command, env=env, stdout=subprocess.PIPE,
                              stderr=subprocess.STDOUT)
-        procs.append(p)
         t = threading.Thread(target=_stream, args=(str(rank), p.stdout,
                                                    sys.stdout.buffer),
                              daemon=True)
         t.start()
         threads.append(t)
+        return p
+
+    procs: list[subprocess.Popen] = [
+        spawn(local_rank) for local_rank in range(args.num_proc)
+    ]
+    restarts_left = max(0, args.restart_on_failure)
 
     rc = 0
     try:
@@ -93,13 +116,22 @@ def main(argv=None) -> int:
                 code = procs[i].poll()
                 if code is None:
                     continue
+                # Report the global rank, matching the stream prefixes
+                # (local index i != rank when --host-index > 0).
+                rank = args.host_index * pph + i
+                if code != 0 and restarts_left > 0:
+                    restarts_left -= 1
+                    sys.stderr.write(
+                        f"rank {rank} exited with code {code}; "
+                        f"relaunching ({restarts_left} restarts left)\n")
+                    sys.stderr.flush()
+                    procs[i] = spawn(i, scrub_fault_inject=True)
+                    continue
                 remaining.discard(i)
                 if code != 0 and rc == 0:
                     rc = code
-                    # Report the global rank, matching the stream prefixes
-                    # (local index i != rank when --host-index > 0).
                     sys.stderr.write(
-                        f"rank {args.host_index * pph + i} exited with "
+                        f"rank {rank} exited with "
                         f"code {code}; terminating remaining ranks\n")
                     for j in remaining:
                         procs[j].terminate()

@@ -96,7 +96,19 @@ def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
             red_op=_WIRE_OPS[op])
         for i, h in enumerate(hosts)
     ]
-    outs = [eng.synchronize(h) for h in handles]
+    # Drain EVERY handle even when one fails: abandoning the rest would
+    # leak their buffers and leave names "in flight", so a retry of the
+    # same batch after an elastic recovery would die on duplicate names.
+    outs, first_err = [], None
+    for h in handles:
+        try:
+            outs.append(eng.synchronize(h))
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            if first_err is None:
+                first_err = e
+            outs.append(None)
+    if first_err is not None:
+        raise first_err
     results = []
     for out, ctx in zip(outs, ctxs):
         if op is Average:

@@ -136,6 +136,13 @@ class NativeEngine:
                 fn.restype = ctypes.c_int64
         except AttributeError:
             pass  # stale .so: stats() raises the rebuild hint instead
+        try:
+            lib.horovod_abort_reason.argtypes = [
+                ctypes.c_char_p, ctypes.c_int,
+            ]
+            lib.horovod_abort_reason.restype = None
+        except AttributeError:
+            pass  # stale .so: abort_reason() degrades to ""
 
     # -- naming (auto names must be identical across ranks, which holds when
     #    ranks enqueue in the same program order — same contract as the
@@ -148,6 +155,38 @@ class NativeEngine:
             idx = self._name_counters.get(kind, 0)
             self._name_counters[kind] = idx + 1
         return f"{kind}.noname.{idx}"
+
+    def reset_naming(self) -> None:
+        """Reset the auto-name counters and drop stale in-flight buffer
+        refs.  Called on shutdown (basics.shutdown) so a restarted
+        engine's UNNAMED collectives count from zero again and rendezvous
+        with freshly relaunched peers — otherwise an elastic recovery
+        leaves survivors at 'allreduce.noname.N' while the replacement
+        worker starts at '.noname.0' and nothing ever matches."""
+        with self._name_lock:
+            self._name_counters.clear()
+        with self._inflight_lock:
+            self._inflight.clear()
+
+    # -- fault state --
+
+    def abort_reason(self) -> str:
+        """Why the engine aborted ("" while healthy / after clean
+        shutdown) — e.g. which rank died, as diagnosed by the coordinator's
+        failure detector."""
+        if getattr(self._lib, "horovod_abort_reason", None) is None:
+            return ""
+        buf = ctypes.create_string_buffer(4096)
+        self._lib.horovod_abort_reason(buf, len(buf))
+        return buf.value.decode(errors="replace")
+
+    def _not_running_error(self) -> HorovodInternalError:
+        reason = self.abort_reason()
+        if reason:
+            return HorovodInternalError(f"engine aborted: {reason}")
+        return HorovodInternalError(
+            "engine is not running (init not called or already shut down)"
+        )
 
     # -- async enqueue API --
 
@@ -165,9 +204,7 @@ class NativeEngine:
                 "(duplicate name)"
             )
         if handle < 0:
-            raise HorovodInternalError(
-                "engine is not running (init not called or already shut down)"
-            )
+            raise self._not_running_error()
         with self._inflight_lock:
             self._inflight[handle] = arr
         return handle
@@ -200,8 +237,7 @@ class NativeEngine:
                 f"a collective named {name!r} is already in flight "
                 "(duplicate name)")
         if handle < 0:
-            raise HorovodInternalError(
-                "engine is not running (init not called or already shut down)")
+            raise self._not_running_error()
         with self._inflight_lock:
             self._inflight[handle] = arr
         return handle
@@ -345,3 +381,12 @@ def get_engine() -> NativeEngine:
                 )
             _engine = NativeEngine(lib)
         return _engine
+
+
+def reset_engine_naming() -> None:
+    """Reset the cached engine's auto-name counters (no-op when no engine
+    was created).  Invoked by basics.shutdown() as part of the restart
+    story — see NativeEngine.reset_naming."""
+    with _engine_lock:
+        if _engine is not None:
+            _engine.reset_naming()

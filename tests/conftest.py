@@ -26,7 +26,21 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# jax 0.4.x spells shard_map jax.experimental.shard_map (check_rep, not
+# check_vma); this import aliases the new spelling onto the jax namespace
+# so test files' jax.shard_map(...) calls work on both lines.
+import horovod_tpu.common.jax_compat  # noqa: E402,F401
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 gate")
+    config.addinivalue_line(
+        "markers",
+        "fault: fault-injection multiproc tests; ci.sh reruns them under a "
+        "hard timeout so a reintroduced hang fails fast")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,67 @@
+"""Elastic-training worker: deterministic SGD under fault injection.
+
+Launched by tests/test_fault_tolerance.py via the supervised launcher
+(``python -m horovod_tpu.run --restart-on-failure N``).  Minimizes
+``mean((w - t_r)^2)`` with the per-rank gradients averaged through the
+native engine, committing every step; losing a rank mid-run must —
+after the supervisor relaunches it and :func:`run_elastic` rolls the
+survivors back — converge to exactly the closed-form (= uninterrupted)
+result, because each step is a pure function of the committed
+``(w, step)`` and the ring reduction order is deterministic.
+
+Deliberately jax-free (numpy + the native engine), like native_worker.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.elastic import ElasticState, run_elastic  # noqa: E402
+from horovod_tpu.runtime import engine_or_none  # noqa: E402
+
+TOTAL_STEPS = 30
+LR = 0.05
+DIM = 8
+
+
+def rank_target(rank: int) -> np.ndarray:
+    return np.linspace(rank + 1.0, rank + 2.0, DIM)
+
+
+def train(state: ElasticState):
+    eng = engine_or_none()
+    while state.step < TOTAL_STEPS:
+        grad = 2.0 * (state.w - rank_target(basics.rank()))
+        if eng is not None:
+            # Deliberately UNNAMED: exercises the auto-name counter reset
+            # on shutdown — without it, survivors resume at
+            # 'allreduce.noname.N' while a relaunched worker counts from
+            # zero and the post-recovery collectives never rendezvous.
+            grad = eng.allreduce(grad, average=True)
+        state.w = state.w - LR * grad
+        state.step += 1
+        state.commit()
+
+
+def main():
+    state = ElasticState(w=np.zeros(DIM, dtype=np.float64), step=0)
+    run_elastic(train, state)
+
+    # Closed form for w0 = 0: w_k = tbar * (1 - (1 - 2*lr)^k) with tbar
+    # the cross-rank mean target — what an uninterrupted run computes.
+    size = basics.size()
+    tbar = np.mean([rank_target(r) for r in range(size)], axis=0)
+    expected = tbar * (1.0 - (1.0 - 2.0 * LR) ** TOTAL_STEPS)
+    assert np.allclose(state.w, expected, rtol=0, atol=1e-9), (
+        state.w, expected)
+    loss = float(np.mean((state.w - tbar) ** 2))
+    print(f"ELASTIC_OK rank={basics.rank()} loss={loss:.12e}", flush=True)
+    basics.shutdown()
+
+
+if __name__ == "__main__":
+    main()

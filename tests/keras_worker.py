@@ -216,6 +216,17 @@ def scenario_death(rank, size):
         os._exit(31)  # crash without any shutdown handshake
     try:
         model.fit(X, Y, epochs=4, batch_size=16, verbose=0)
+        # The JAX trainer can defer an io_callback failure past fit()
+        # (async dispatch surfaces it at the next blocking point, which
+        # may be process exit).  The containment property under test is
+        # "no hang + descriptive error", so force the surface with a
+        # host-side probe collective: on a dead engine it raises the
+        # abort reason naming the crashed rank; on a regression back to
+        # the old wedge behavior it hangs and trips the proc timeout.
+        from horovod_tpu.runtime import engine_or_none
+        eng = engine_or_none()
+        if eng is not None:
+            eng.allreduce(np.ones(1, np.float32), name="death_probe")
     except Exception as e:
         # Either the failing collective's own transport error, or — when
         # the background loop already aborted and shut the engine down —

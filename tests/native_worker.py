@@ -309,6 +309,43 @@ def scenario_wedged_peer(rank, size, eng):
     raise AssertionError("expected an abort while a peer is wedged")
 
 
+def scenario_fault_steps(rank, size, eng):
+    # Deterministic fault injection (HOROVOD_FAULT_INJECT=rank:step:kind,
+    # set by the test): every rank runs a fixed allreduce-per-step loop;
+    # the engine itself fires the fault on the injected rank's step-th
+    # enqueue.  EVERY surviving rank must get a HorovodInternalError
+    # naming the culprit rank within the fault timeout — the scenario that
+    # used to wedge the whole world inside a blocking collective.
+    frank, fstep, fkind = os.environ["HOROVOD_FAULT_INJECT"].split(":")
+    frank, fstep = int(frank), int(fstep)
+    if rank == frank and fkind == "hang":
+        # The wedged rank blocks forever inside Wait once its background
+        # loop freezes; let SIGALRM's default action kill it (expected
+        # rc -SIGALRM) — a Python handler would never run while the main
+        # thread is parked in a C call.
+        import signal
+
+        signal.alarm(12)
+    steps = fstep + 5
+    try:
+        for i in range(steps):
+            x = np.full((64,), float(rank + i), dtype=np.float32)
+            out = eng.allreduce(x, name=f"fault.step.{i}")
+            assert np.allclose(out, sum(r + i for r in range(size))), (i, out)
+    except HorovodInternalError as e:
+        msg = str(e)
+        if rank == frank:
+            # drop-conn: our own injected abort.
+            assert "fault injection" in msg, msg
+        else:
+            assert f"rank {frank}" in msg, msg
+        print(f"worker rank={rank} got expected abort: {msg}", flush=True)
+        return
+    raise AssertionError(
+        f"rank {rank}: expected HorovodInternalError after injected "
+        f"{fkind} on rank {frank}")
+
+
 SCENARIOS = {
     "allreduce": scenario_allreduce,
     "fused": scenario_fused,
@@ -327,6 +364,7 @@ SCENARIOS = {
     "restart": scenario_restart,
     "worker_death": scenario_worker_death,
     "wedged_peer": scenario_wedged_peer,
+    "fault_steps": scenario_fault_steps,
     "all": None,
 }
 

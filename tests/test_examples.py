@@ -8,6 +8,11 @@ import sys
 
 import pytest
 
+
+# Example smokes spawn a full training subprocess each (minutes apiece on the CI mesh);
+# too heavy for the bounded tier-1 gate, covered by ci.sh's full run.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXAMPLES = [

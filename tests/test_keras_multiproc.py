@@ -9,6 +9,11 @@ import pytest
 
 from tests.test_native_engine import run_workers
 
+
+# Each scenario spawns N keras+TF worker processes;
+# too heavy for the bounded tier-1 gate, covered by ci.sh's full run.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "keras_worker.py")
 

@@ -38,6 +38,44 @@ def test_launcher_propagates_failure():
     assert b"terminating remaining" in p.stderr or p.returncode == 3
 
 
+def test_launcher_restart_on_failure(tmp_path):
+    """--restart-on-failure relaunches a dead worker with the same rank
+    identity instead of tearing the job down."""
+    mark = tmp_path / "died_once"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    body = (
+        "import os, sys\n"
+        f"mark = {str(mark)!r}\n"
+        "if os.environ['HOROVOD_RANK'] == '1' and not os.path.exists(mark):\n"
+        "    open(mark, 'w').close()\n"
+        "    sys.exit(9)\n"
+        "print('rank', os.environ['HOROVOD_RANK'], 'done')\n")
+    p = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--restart-on-failure", "1", "--", sys.executable, "-c", body],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert p.returncode == 0, p.stdout.decode() + p.stderr.decode()
+    assert b"relaunching (0 restarts left)" in p.stderr, p.stderr.decode()
+    out = p.stdout.decode()
+    assert "[0] rank 0 done" in out and "[1] rank 1 done" in out, out
+
+
+def test_launcher_restart_budget_exhausted_propagates():
+    """Once the restart budget is spent, the next failure terminates the
+    job with the failing exit code (plain-launcher semantics)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    p = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+         "--restart-on-failure", "1", "--", sys.executable, "-c",
+         "import os, sys\n"
+         "sys.exit(7 if os.environ['HOROVOD_RANK'] == '1' else 0)\n"],
+        cwd=REPO, env=env, capture_output=True, timeout=120)
+    assert p.returncode == 7, p.stdout.decode() + p.stderr.decode()
+    assert b"relaunching" in p.stderr, p.stderr.decode()
+
+
 def _run_multihost(body, n_hosts=2, pph=2, rank_fail=None, timeout=180):
     """Two launcher invocations on localhost playing two hosts of one
     world: global ranks = host_index * pph + local_rank, all rendezvous

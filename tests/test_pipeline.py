@@ -128,23 +128,40 @@ def test_pipeline_batch_divisibility_error(n_devices):
         ))(staged, jnp.ones((4, 4)))
 
 
-def test_pipeline_rejects_check_vma_false(n_devices):
-    """Composing pipeline_apply with a VMA-off shard_map (e.g. the standard
-    make_train_step) must fail loudly at trace time, not silently produce
-    stage-count-multiplied gradients."""
-    mesh = hvd.build_mesh({"pipe": 2}, devices=jax.devices()[:2])
-    layers = _make_layers(2, 4)
+def test_pipeline_gradients_correct_without_vma_checking(n_devices):
+    """pipeline_apply composes with VMA-off shard_map (e.g. the standard
+    make_train_step): the broadcast-from-last-stage pins its own vjp, so
+    gradients match the sequential reference instead of coming out
+    stage-count-multiplied — the historical failure mode of relying on
+    the version-sensitive psum transpose."""
+    width, B, L, n_stages, n_micro = 4, 4, 4, 2, 2
+    layers = _make_layers(L, width, seed=3)
+    x = jax.random.normal(jax.random.key(5), (B, width))
+    y = jax.random.normal(jax.random.key(6), (B, width))
     staged = jax.tree.map(
-        lambda a: a.reshape((2, 1) + a.shape[1:]), stack_pytrees(layers))
+        lambda a: a.reshape((n_stages, L // n_stages) + a.shape[1:]),
+        stack_pytrees(layers))
+    mesh = hvd.build_mesh({"pipe": n_stages},
+                          devices=jax.devices()[:n_stages])
 
-    def run(staged_local, x):
+    def seq_loss(staged, x):
+        flat = jax.tree.map(
+            lambda a: a.reshape((L,) + a.shape[2:]), staged)
+        return jnp.mean((_stage_fn(flat, x) - y) ** 2)
+
+    def pipe_loss(staged_local, x):
         sp = jax.tree.map(lambda a: a[0], staged_local)
-        return pipeline_apply(_stage_fn, sp, x, axis_name="pipe",
-                              n_microbatches=2)
+        out = pipeline_apply(_stage_fn, sp, x, axis_name="pipe",
+                             n_microbatches=n_micro)
+        return jnp.mean((out - y) ** 2)
 
-    with pytest.raises(ValueError, match="check_vma=True"):
-        jax.jit(jax.shard_map(
-            run, mesh=mesh,
-            in_specs=(jax.tree.map(lambda _: P("pipe"), staged), P()),
-            out_specs=P(), check_vma=False,
-        ))(staged, jnp.ones((4, 4)))
+    expected = jax.grad(seq_loss)(staged, x)
+    got = jax.jit(jax.shard_map(
+        jax.grad(pipe_loss), mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P("pipe"), staged), P()),
+        out_specs=jax.tree.map(lambda _: P("pipe"), staged),
+        check_vma=False,
+    ))(staged, x)
+    for a, b in zip(jax.tree.leaves(expected), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=1e-5, rtol=1e-5)

@@ -7,13 +7,19 @@ import pytest
 
 from tests.test_native_engine import run_workers
 
+
+# Each scenario spawns N TF worker processes (TF import alone is ~10 s per worker);
+# too heavy for the bounded tier-1 gate, covered by ci.sh's full run.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "tf_worker.py")
 
 
-def run_tf_workers(n, scenario, timeout=240):
+def run_tf_workers(n, scenario, timeout=240, extra_env=None):
     run_workers(n, scenario, timeout=timeout, worker=WORKER,
-                extra_env={"CUDA_VISIBLE_DEVICES": "-1"})
+                extra_env={"CUDA_VISIBLE_DEVICES": "-1",
+                           **(extra_env or {})})
 
 
 @pytest.mark.parametrize("n", [2, 3, 4])
@@ -28,8 +34,10 @@ def test_tf_gradients():
 @pytest.mark.parametrize("n", [2, 4])
 def test_tf_grouped_allreduce_single_cycle(n):
     """The whole gradient batch completes in ~one negotiation cycle with
-    fused responses (reference async+fusion property)."""
-    run_tf_workers(n, "grouped")
+    fused responses (reference async+fusion property).  HOROVOD_CYCLE_TIME
+    is pinned well above the default so the enqueue burst deterministically
+    lands inside one batching window even on a loaded CI host."""
+    run_tf_workers(n, "grouped", extra_env={"HOROVOD_CYCLE_TIME": "25"})
 
 
 def test_tf_mismatch_errors():

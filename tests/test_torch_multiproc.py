@@ -7,6 +7,11 @@ import pytest
 
 from tests.test_native_engine import run_workers as _run_native
 
+
+# Each scenario spawns N torch worker processes;
+# too heavy for the bounded tier-1 gate, covered by ci.sh's full run.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "torch_worker.py")
 
