@@ -10,11 +10,19 @@ design — they track frontend + negotiation + ring-collective overhead,
 so hot-path regressions (e.g. a fusion/batching break) become visible as
 throughput drops.
 
+The TF step loop runs twice per world size — negotiation response cache
+ON (the default) and OFF (``HOROVOD_CACHE_CAPACITY=0``) — and reports
+``control_round_trips_per_step`` alongside step time, so the control
+plane's contribution is separable from the data plane's.
+
 Prints ONE JSON line, e.g.::
 
     {"metric": "engine_data_plane", "torch_img_per_sec": {"2": ..,
      "4": ..}, "tf_img_per_sec": {"2": .., "4": ..},
-     "tf_step_ms": {"2": .., "4": ..}}
+     "tf_step_ms": {"2": .., "4": ..},
+     "tf_step_ms_nocache": {"2": .., "4": ..},
+     "control_round_trips_per_step": {"2": .., "4": ..},
+     "control_round_trips_per_step_nocache": {"2": .., "4": ..}}
 
 ``bench.py`` merges these keys into the bench artifact under an
 ``engine_`` prefix; standalone use: ``python bench_engine.py``.
@@ -72,14 +80,22 @@ def _tf_worker() -> None:
 
     for _ in range(3):
         step()
+    from horovod_tpu.runtime import engine_or_none
+
+    eng = engine_or_none()
     iters = 30
+    before = eng.stats() if eng is not None else {}
     t0 = time.perf_counter()
     for _ in range(iters):
         step()
     dt = time.perf_counter() - t0
+    after = eng.stats() if eng is not None else {}
+    rt_per_step = (after.get("control_round_trips", 0)
+                   - before.get("control_round_trips", 0)) / iters
     if hvd.rank() == 0:
         print(f"TF_STEP_MS {dt / iters * 1e3:.3f} "
-              f"TF_IMG_PER_SEC {batch * hvd.size() * iters / dt:.1f}",
+              f"TF_IMG_PER_SEC {batch * hvd.size() * iters / dt:.1f} "
+              f"TF_RT_PER_STEP {rt_per_step:.2f}",
               flush=True)
     hvd.shutdown()
 
@@ -94,7 +110,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_ranks(n: int, argv: list, timeout: int = 240) -> str:
+def _run_ranks(n: int, argv: list, timeout: int = 240,
+               extra_env: dict | None = None) -> str:
     """Run ``argv`` as n engine ranks; returns rank 0's stdout."""
     port = _free_port()
     procs = []
@@ -107,6 +124,7 @@ def _run_ranks(n: int, argv: list, timeout: int = 240) -> str:
             "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
             "CUDA_VISIBLE_DEVICES": "-1",
         })
+        env.update(extra_env or {})
         procs.append(subprocess.Popen(
             argv, env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE))
@@ -127,11 +145,18 @@ def _run_ranks(n: int, argv: list, timeout: int = 240) -> str:
     return outs[0][1]
 
 
+_TF_LINE = re.compile(r"TF_STEP_MS ([\d.]+) TF_IMG_PER_SEC ([\d.]+)"
+                      r"(?: TF_RT_PER_STEP ([\d.]+))?")
+
+
 def main() -> None:
     result: dict = {"metric": "engine_data_plane"}
     torch_rates: dict = {}
     tf_rates: dict = {}
     tf_step_ms: dict = {}
+    tf_step_ms_nocache: dict = {}
+    rt_per_step: dict = {}
+    rt_per_step_nocache: dict = {}
     for n in (2, 4):
         # No --smoke: it would force num_iters to 1, and these numbers
         # exist to catch regressions — keep the 3-sample mean the
@@ -146,15 +171,28 @@ def main() -> None:
         if m:
             torch_rates[str(n)] = float(m.group(1))
 
-        out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
-                             "--tf-worker"])
-        m = re.search(r"TF_STEP_MS ([\d.]+) TF_IMG_PER_SEC ([\d.]+)", out)
-        if m:
-            tf_step_ms[str(n)] = float(m.group(1))
-            tf_rates[str(n)] = float(m.group(2))
+        # TF step loop, negotiation cache ON (default) and OFF — the
+        # delta isolates the control plane's share of step time, and the
+        # OFF run proves the legacy path still reproduces its numbers.
+        for label, env, step_dict, rt_dict in (
+                ("cache", {}, tf_step_ms, rt_per_step),
+                ("nocache", {"HOROVOD_CACHE_CAPACITY": "0"},
+                 tf_step_ms_nocache, rt_per_step_nocache)):
+            out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
+                                 "--tf-worker"], extra_env=env)
+            m = _TF_LINE.search(out)
+            if m:
+                step_dict[str(n)] = float(m.group(1))
+                if label == "cache":
+                    tf_rates[str(n)] = float(m.group(2))
+                if m.group(3) is not None:
+                    rt_dict[str(n)] = float(m.group(3))
     result["torch_img_per_sec"] = torch_rates
     result["tf_img_per_sec"] = tf_rates
     result["tf_step_ms"] = tf_step_ms
+    result["tf_step_ms_nocache"] = tf_step_ms_nocache
+    result["control_round_trips_per_step"] = rt_per_step
+    result["control_round_trips_per_step_nocache"] = rt_per_step_nocache
     print(json.dumps(result))
 
 

@@ -36,6 +36,15 @@ echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
     python -m pytest tests/ -q -m fault
 
+echo "== control-plane cache gate (2 ranks, 50 steps, hard timeout) =="
+# Regression gate for the negotiation response cache: a steady-state
+# identical-tensor loop must negotiate via cache-hit bits at ~1 control
+# round trip per step; the worker asserts and FAILS the run when
+# control_round_trips_per_step exceeds 1.5 (or the hit rate drops).
+PALLAS_AXON_POOL_IPS= HOROVOD_SMOKE_STEPS=50 timeout -k 10 180 \
+    python -m pytest \
+    "tests/test_engine_stats.py::test_steady_state_hit_rate_and_round_trips[2]" -q
+
 echo "== multichip sharding dry run =="
 PALLAS_AXON_POOL_IPS= python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8) OK')"
 

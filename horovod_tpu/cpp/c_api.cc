@@ -80,6 +80,25 @@ int64_t horovod_tensors_executed() {
   return Engine::Get().tensors_executed();
 }
 
+// Control-plane / response-cache observability (see Engine accessors):
+// cache hit/miss/eviction counts, control-frame bytes each way, and the
+// number of completed coordinator round trips — bench and tests divide
+// the last by step count to prove steady state needs ~1 round trip/step.
+int64_t horovod_cache_hits() { return Engine::Get().cache_hits(); }
+int64_t horovod_cache_misses() { return Engine::Get().cache_misses(); }
+int64_t horovod_cache_evictions() {
+  return Engine::Get().cache_evictions();
+}
+int64_t horovod_negotiation_bytes_tx() {
+  return Engine::Get().negotiation_bytes_tx();
+}
+int64_t horovod_negotiation_bytes_rx() {
+  return Engine::Get().negotiation_bytes_rx();
+}
+int64_t horovod_control_round_trips() {
+  return Engine::Get().control_round_trips();
+}
+
 // Why the engine aborted, copied into buf (truncated to buflen-1); empty
 // while the engine is healthy or after a clean shutdown.  Lets callers
 // attach the culprit rank to enqueues attempted AFTER the abort, whose

@@ -253,6 +253,17 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   // Knobs (reference operations.cc:1556-1618).
   cycle_time_ms_ = static_cast<int>(EnvInt64("HOROVOD_CYCLE_TIME", 5));
   if (cycle_time_ms_ < 1) cycle_time_ms_ = 1;
+  cache_capacity_ = EnvInt64("HOROVOD_CACHE_CAPACITY", 1024);
+  if (cache_capacity_ < 0) cache_capacity_ = 0;
+  // Slot ids must stay under the wire format's bitvector bound
+  // (ParseSlotBitvector rejects nbits > 1<<20 as a corrupt frame).
+  if (cache_capacity_ > (1 << 20)) cache_capacity_ = 1 << 20;
+  cache_enabled_ = cache_capacity_ > 0 && size_ > 1;
+  // An elastic re-Init (shutdown + init in the same process) must start
+  // with an empty cache on every rank: the new world's coordinator
+  // assigns slots from scratch, and a replayed stale slot id would
+  // execute the wrong response.  Teardown also clears (belt + braces).
+  ClearCacheState();
   fusion_threshold_ = EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
   stall_check_disabled_ = EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) != 0;
   stall_warning_sec_ =
@@ -614,8 +625,21 @@ void Engine::Shutdown() {
   // initialized_ is still true — join and clear state regardless, or a
   // subsequent Init() would see initialized_ and no-op on a dead engine.
   shutdown_requested_.store(true);
+  cycle_cv_.notify_all();  // wake the event-driven cycle wait immediately
   if (background_.joinable()) background_.join();
   initialized_.store(false);
+}
+
+void Engine::ClearCacheState() {
+  cache_by_name_.clear();
+  cache_entries_.clear();
+  pending_cache_hits_.clear();
+  cache_resubmits_.clear();
+  coord_slot_bits_.clear();
+  coord_slot_names_.clear();
+  coord_slot_by_name_.clear();
+  free_slots_.clear();
+  next_slot_ = 0;
 }
 
 // ---------------------------------------------------------------------------
@@ -661,6 +685,9 @@ void Engine::BackgroundLoop() {
   // world's readiness counts with the dead world's pending entries.
   // Thread-correct: this is still the background thread.
   message_table_.clear();
+  // Same for the response cache: a recovered world must never replay the
+  // dead world's slot ids (the new coordinator numbers slots from zero).
+  ClearCacheState();
   // Close every connection so peers blocked in recv see EOF immediately and
   // the failure propagates around the ring instead of stranding them until
   // their own timeout.
@@ -748,12 +775,31 @@ void Engine::BroadcastAbort(int culprit, const std::string& message) {
   }
 }
 
+// "Did this control frame carry negotiation payload?" — the shared rule
+// behind control_round_trips_ on coordinator and workers (idle heartbeat
+// exchanges don't count; see engine.h).  Any new wire field that carries
+// work belongs here, or the stat skews between rank 0 and workers.
+static bool HasPayload(const RequestList& l) {
+  return !l.requests.empty() || !l.cache_hits.empty() ||
+         !l.cache_evicts.empty() || l.shutdown;
+}
+
+static bool HasPayload(const ResponseList& l) {
+  return !l.responses.empty() || !l.cached_slots.empty() ||
+         !l.evict_slots.empty() || l.shutdown || l.abort;
+}
+
 bool Engine::RunLoopOnce() {
   if (fault_hang_.load()) {
     // Injected wedge: stay alive but stop cycling.  Control frames cease;
     // peers must detect the hang via HOROVOD_FAULT_TIMEOUT_SEC /
     // HOROVOD_CONTROL_PATIENCE_SEC, exactly like a real stuck process.
-    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    // Same event-driven primitive as the cycle gate below (no fixed
+    // sleep anywhere in the loop), with no predicate: a wedge ignores
+    // enqueues and shutdown by design, it only stops burning a fixed
+    // 100 ms floor per poll when something else wakes the cv.
+    std::unique_lock<std::mutex> lk(mu_);
+    cycle_cv_.wait_for(lk, std::chrono::milliseconds(100));
     return true;
   }
   if (fault_drop_.load()) {
@@ -762,16 +808,23 @@ bool Engine::RunLoopOnce() {
     CloseSockets();  // abrupt: no shutdown handshake, peers see raw EOF
     return false;
   }
-  std::this_thread::sleep_for(std::chrono::milliseconds(cycle_time_ms_));
+  // Event-driven cycle gate (replaces the unconditional
+  // sleep_for(cycle_time_ms_)): wake the instant work is enqueued, or
+  // after cycle_time_ms_ as an idle heartbeat so peers' control frames
+  // keep flowing.  HOROVOD_CYCLE_TIME is thereby an UPPER bound on
+  // negotiation latency instead of a floor under it — a single eager
+  // allreduce negotiates in one control round trip, not in >= 5 ms.
+  {
+    std::unique_lock<std::mutex> lk(mu_);
+    cycle_cv_.wait_for(lk, std::chrono::milliseconds(cycle_time_ms_), [&] {
+      return !message_queue_.empty() || shutdown_requested_.load() ||
+             fault_hang_.load() || fault_drop_.load();
+    });
+  }
+  if (fault_hang_.load() || fault_drop_.load()) return true;  // next pass
 
   RequestList my_list;
-  {
-    std::lock_guard<std::mutex> lk(mu_);
-    while (!message_queue_.empty()) {
-      my_list.requests.push_back(std::move(message_queue_.front()));
-      message_queue_.pop_front();
-    }
-  }
+  DrainMessageQueue(&my_list);
   my_list.shutdown = shutdown_requested_.load();
 
   if (size_ == 1) {
@@ -816,6 +869,8 @@ bool Engine::RunLoopOnce() {
                    "connection; check its logs. Aborting all ranks.");
         return false;
       }
+      negotiation_bytes_rx_.fetch_add(
+          static_cast<int64_t>(frame.size()) + 8);
       Reader reader(frame.data(), frame.size());
       if (!ParseRequestList(&reader, &lists[r])) {
         BroadcastAbort(
@@ -835,9 +890,26 @@ bool Engine::RunLoopOnce() {
                    "Aborting all ranks.");
         return false;
       }
+      negotiation_bytes_tx_.fetch_add(
+          static_cast<int64_t>(w.bytes().size()) + 8);
     }
-    if (!response_list.responses.empty()) exec_cycles_.fetch_add(1);
+    // Count NEGOTIATION round trips only — cycles where some rank shipped
+    // requests/hit-bits/evicts or the frame carried work back.  Idle
+    // heartbeats (empty frames while every rank computes) would otherwise
+    // drown the per-step signal bench and CI gate on.
+    bool carried_payload = HasPayload(response_list);
+    for (int r = 0; r < size_ && !carried_payload; ++r) {
+      carried_payload = HasPayload(lists[r]);
+    }
+    if (carried_payload) control_round_trips_.fetch_add(1);
+    // The coordinator is a cache participant like any worker: update the
+    // local replica from the list it just broadcast, execute the fully
+    // negotiated responses, then the agreed cached slots.
+    ApplyCacheUpdates(response_list);
+    bool executed_any = !response_list.responses.empty();
     for (auto& resp : response_list.responses) PerformResponse(resp);
+    if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
+    if (executed_any) exec_cycles_.fetch_add(1);
     if (!stall_check_disabled_) CheckForStalledTensors();
     return !response_list.shutdown;
   }
@@ -848,6 +920,7 @@ bool Engine::RunLoopOnce() {
       "another rank failed; check rank 0's logs.";
   Writer w;
   SerializeRequestList(my_list, &w);
+  negotiation_bytes_tx_.fetch_add(static_cast<int64_t>(w.bytes().size()) + 8);
   if (!coordinator_conn_.SendFrame(w.bytes())) {
     // The coordinator may have broadcast an abort (naming the culprit
     // rank) just before tearing down; that frame survives in our receive
@@ -875,6 +948,7 @@ bool Engine::RunLoopOnce() {
                  abort_reason_.c_str());
     return false;
   }
+  negotiation_bytes_rx_.fetch_add(static_cast<int64_t>(frame.size()) + 8);
   Reader reader(frame.data(), frame.size());
   ResponseList response_list;
   if (!ParseResponseList(&reader, &response_list)) {
@@ -892,21 +966,221 @@ bool Engine::RunLoopOnce() {
                  abort_reason_.c_str());
     return false;
   }
-  if (!response_list.responses.empty()) exec_cycles_.fetch_add(1);
+  // Negotiation round trips only (same HasPayload rule as the
+  // coordinator): idle heartbeat exchanges are not counted.
+  if (HasPayload(my_list) || HasPayload(response_list)) {
+    control_round_trips_.fetch_add(1);
+  }
+  ApplyCacheUpdates(response_list);
+  bool executed_any = !response_list.responses.empty();
   for (auto& resp : response_list.responses) PerformResponse(resp);
+  if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
+  if (executed_any) exec_cycles_.fetch_add(1);
   return !response_list.shutdown;
+}
+
+// Request types whose responses are pure functions of the validated
+// cross-rank signature — safe to replay from the cache.  ALLGATHER is
+// excluded: its response embeds every rank's RUNTIME dim-0, renegotiated
+// each step.
+static bool IsCacheableType(RequestType t) {
+  return t == RequestType::ALLREDUCE || t == RequestType::BROADCAST ||
+         t == RequestType::REDUCESCATTER || t == RequestType::ALLTOALL;
+}
+
+static bool IsCacheableResponse(ResponseType t) {
+  return t == ResponseType::ALLREDUCE || t == ResponseType::BROADCAST ||
+         t == ResponseType::REDUCESCATTER || t == ResponseType::ALLTOALL;
+}
+
+// Queue drain + cache classification (every rank, coordinator included).
+// A request whose name maps to a live slot with a matching signature
+// collapses to one hit bit; a signature CHANGE evicts the slot locally
+// and travels as evict + full replacement Request in the same frame;
+// everything else is a full request.
+void Engine::DrainMessageQueue(RequestList* my_list) {
+  AssertBackgroundThread();
+  // Requests bounced back to full negotiation by a remote evict go first
+  // (they have already been waiting a cycle).
+  for (auto& q : cache_resubmits_) {
+    cache_misses_.fetch_add(1);
+    my_list->requests.push_back(std::move(q));
+  }
+  cache_resubmits_.clear();
+  std::deque<Request> pending;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending.swap(message_queue_);
+  }
+  for (auto& q : pending) {
+    if (cache_enabled_ && !q.probe) {
+      auto it = cache_by_name_.find(q.tensor_name);
+      if (it != cache_by_name_.end()) {
+        uint32_t slot = it->second;
+        if (cache_entries_[slot].sig.Matches(q)) {
+          cache_hits_.fetch_add(1);
+          my_list->cache_hits.push_back(slot);
+          pending_cache_hits_[slot] = q.tensor_name;
+          continue;
+        }
+        // Same name, new shape/dtype/op/root: drop the slot everywhere
+        // and renegotiate from scratch (airtight invalidation — the
+        // fusion buffer must never see the old layout again).
+        my_list->cache_evicts.push_back(slot);
+        cache_entries_.erase(slot);
+        cache_by_name_.erase(it);
+        cache_evictions_.fetch_add(1);
+      }
+      if (IsCacheableType(q.type)) cache_misses_.fetch_add(1);
+    }
+    my_list->requests.push_back(std::move(q));
+  }
+}
+
+static Request RequestFromEntry(const TensorTableEntry& e, int rank) {
+  Request q;
+  q.request_rank = rank;
+  q.type = e.type;
+  q.dtype = e.dtype;
+  q.tensor_name = e.name;
+  q.root_rank = e.root_rank;
+  q.red_op = e.red_op;
+  for (int d = 0; d < e.shape.ndim(); ++d) q.shape.push_back(e.shape.dim(d));
+  return q;
+}
+
+void Engine::ApplyCacheUpdates(const ResponseList& list) {
+  if (list.evict_slots.empty() && list.responses.empty()) return;
+  AssertBackgroundThread();
+  // Evictions FIRST: a freed slot id may be reassigned by a response in
+  // this very frame.
+  for (uint32_t slot : list.evict_slots) {
+    auto it = cache_entries_.find(slot);
+    if (it != cache_entries_.end()) {
+      cache_by_name_.erase(it->second.response.tensor_names[0]);
+      cache_entries_.erase(it);
+      cache_evictions_.fetch_add(1);
+    }
+    auto pit = pending_cache_hits_.find(slot);
+    if (pit != pending_cache_hits_.end()) {
+      // Our hit bit rode a slot that just died; renegotiate the tensor
+      // fully next cycle so it cannot strand (if the signatures really
+      // diverged across ranks, full validation reports the mismatch).
+      std::lock_guard<std::mutex> lk(mu_);
+      auto tit = tensor_table_.find(pit->second);
+      if (tit != tensor_table_.end()) {
+        cache_resubmits_.push_back(RequestFromEntry(tit->second, rank_));
+      }
+      pending_cache_hits_.erase(pit);
+    }
+  }
+  if (!cache_enabled_) return;
+  // New slot assignments: store this rank's own signature plus the
+  // single-tensor response to replay on future hits.
+  for (const auto& resp : list.responses) {
+    for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+      if (i >= resp.cache_slots.size() || resp.cache_slots[i] < 0) continue;
+      uint32_t slot = static_cast<uint32_t>(resp.cache_slots[i]);
+      const std::string& name = resp.tensor_names[i];
+      CacheEntry entry;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto tit = tensor_table_.find(name);
+        if (tit == tensor_table_.end()) continue;  // defensive
+        const TensorTableEntry& e = tit->second;
+        entry.sig.type = e.type;
+        entry.sig.dtype = e.dtype;
+        entry.sig.root_rank = e.root_rank;
+        entry.sig.red_op = e.red_op;
+        for (int d = 0; d < e.shape.ndim(); ++d) {
+          entry.sig.shape.push_back(e.shape.dim(d));
+        }
+      }
+      Response single;
+      single.type = resp.type;
+      single.tensor_names.push_back(name);
+      single.tensor_sizes = resp.tensor_sizes;
+      single.root_rank = resp.root_rank;
+      single.red_op = resp.red_op;
+      single.cache_slots.assign(1, -1);
+      entry.response = std::move(single);
+      cache_by_name_[name] = slot;
+      cache_entries_[slot] = std::move(entry);
+    }
+  }
+}
+
+bool Engine::ExecuteCachedResponses(const ResponseList& list,
+                                    bool* executed_any) {
+  if (list.cached_slots.empty()) return true;
+  AssertBackgroundThread();
+  std::vector<Response> cached;
+  cached.reserve(list.cached_slots.size());
+  for (uint32_t slot : list.cached_slots) {
+    auto it = cache_entries_.find(slot);
+    if (it == cache_entries_.end()) {
+      // Replica divergence: executing anything further would desync the
+      // ring ordering across ranks — abort loudly instead of stranding
+      // tensors or corrupting buffers.
+      abort_reason_ = "negotiation cache protocol error: coordinator "
+                      "agreed on cache slot " + std::to_string(slot) +
+                      " which this rank does not hold";
+      std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
+                   abort_reason_.c_str());
+      return false;
+    }
+    pending_cache_hits_.erase(slot);
+    timeline_.NegotiateCached(it->second.response.tensor_names[0]);
+    cached.push_back(it->second.response);
+  }
+  // Deterministic across ranks: identical slot order (from the frame) and
+  // identical per-tensor dtypes/sizes (signature-agreed) ⇒ identical
+  // fusion ⇒ identical ring execution order.
+  FuseResponses(cached);
+  *executed_any = true;
+  for (auto& resp : cached) PerformResponse(resp);
+  return true;
+}
+
+void Engine::CoordinatorEvictSlot(uint32_t slot, ResponseList* out) {
+  AssertBackgroundThread();
+  auto it = coord_slot_names_.find(slot);
+  if (it == coord_slot_names_.end()) return;  // duplicate evict this cycle
+  coord_slot_by_name_.erase(it->second);
+  coord_slot_names_.erase(it);
+  coord_slot_bits_.erase(slot);
+  free_slots_.insert(slot);
+  out->evict_slots.push_back(slot);
 }
 
 // Readiness counting + response construction + fusion, on the coordinator.
 // Reference: IncrementTensorCount (operations.cc:282-307) +
-// ConstructMPIResponse (315-517) + fusion (1815-1842).
+// ConstructMPIResponse (315-517) + fusion (1815-1842); the cache-slot
+// readiness bits are the reference 0.21 response-cache bitvector idea
+// mapped onto this coordinator.
 ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
   AssertBackgroundThread();
   ResponseList out;
+  // Cache evictions first — readiness bits and slot reassignments below
+  // must see the slot freed, and bits arriving for a slot evicted in the
+  // same cycle are dropped (their senders renegotiate on receipt of the
+  // evict broadcast).
+  for (int r = 0; r < size_; ++r) {
+    for (uint32_t slot : lists[r].cache_evicts) {
+      CoordinatorEvictSlot(slot, &out);
+    }
+  }
   std::vector<std::string> became_ready;
   for (int r = 0; r < size_; ++r) {
     if (lists[r].shutdown) out.shutdown = true;
     for (auto& q : lists[r].requests) {
+      // A full request for a name that still holds a slot means some rank
+      // invalidated it (or a replica missed the assignment): drop the
+      // slot globally and fall through to full renegotiation.
+      auto cs = coord_slot_by_name_.find(q.tensor_name);
+      if (cs != coord_slot_by_name_.end()) {
+        CoordinatorEvictSlot(cs->second, &out);
+      }
       auto it = message_table_.find(q.tensor_name);
       if (it == message_table_.end()) {
         timeline_.NegotiateStart(q.tensor_name);
@@ -928,9 +1202,56 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
       }
     }
   }
+  // Readiness bits against live slots; when every rank's bit is in, the
+  // slot fires this cycle as a slot id — ConstructResponse is skipped
+  // entirely (the validated response is replayed from each replica).
+  std::vector<uint32_t> agreed;
+  for (int r = 0; r < size_; ++r) {
+    for (uint32_t slot : lists[r].cache_hits) {
+      if (coord_slot_names_.find(slot) == coord_slot_names_.end()) continue;
+      SlotPending& sp = coord_slot_bits_[slot];
+      if (sp.seen.empty()) {
+        sp.seen.assign(size_, false);
+        sp.first_seen = std::chrono::steady_clock::now();
+      }
+      if (!sp.seen[r]) {
+        sp.seen[r] = true;
+        sp.count++;
+      }
+      if (sp.count == size_) agreed.push_back(slot);
+    }
+  }
+  std::sort(agreed.begin(), agreed.end());
+  for (uint32_t slot : agreed) {
+    coord_slot_bits_.erase(slot);
+    out.cached_slots.push_back(slot);
+  }
   for (auto& name : became_ready) {
     timeline_.NegotiateEnd(name);
-    out.responses.push_back(BuildResponse(name));
+    bool any_probe = false;
+    {
+      auto it = message_table_.find(name);
+      for (int r = 0; it != message_table_.end() && r < size_; ++r) {
+        if (it->second.requests[r].probe) any_probe = true;
+      }
+    }
+    Response resp = BuildResponse(name);
+    resp.cache_slots.assign(resp.tensor_names.size(), -1);
+    if (cache_enabled_ && !any_probe && resp.type != ResponseType::ERROR &&
+        IsCacheableResponse(resp.type) &&
+        static_cast<int64_t>(coord_slot_names_.size()) < cache_capacity_) {
+      uint32_t slot;
+      if (!free_slots_.empty()) {
+        slot = *free_slots_.begin();
+        free_slots_.erase(free_slots_.begin());
+      } else {
+        slot = next_slot_++;
+      }
+      coord_slot_names_[slot] = name;
+      coord_slot_by_name_[name] = slot;
+      resp.cache_slots[0] = static_cast<int32_t>(slot);
+    }
+    out.responses.push_back(std::move(resp));
   }
 
   // Sparse-layout rendezvous: a pending entry whose received requests are
@@ -1148,6 +1469,9 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
   };
   std::vector<Response> fused;
   for (auto& resp : responses) {
+    // Keep the slot-assignment vector parallel to tensor_names through
+    // the merge (paths that never assign slots leave it empty).
+    resp.cache_slots.resize(resp.tensor_names.size(), -1);
     if (resp.type == ResponseType::ALLREDUCE && !fused.empty() &&
         fused.back().type == ResponseType::ALLREDUCE &&
         fused.back().red_op == resp.red_op &&
@@ -1157,6 +1481,7 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
       for (auto& n : fused.back().tensor_names) total += entry_bytes(n);
       if (total + entry_bytes(resp.tensor_names[0]) <= fusion_threshold_) {
         fused.back().tensor_names.push_back(resp.tensor_names[0]);
+        fused.back().cache_slots.push_back(resp.cache_slots[0]);
         continue;
       }
     }
@@ -1715,32 +2040,51 @@ void Engine::CheckForStalledTensors() {
   // message_table_ is background-thread-only (see engine.h); no lock.
   AssertBackgroundThread();
   bool preamble = false;
+  auto warn_preamble = [&] {
+    if (preamble) return;
+    std::fprintf(
+        stderr,
+        "One or more tensors were submitted to be reduced, gathered or "
+        "broadcasted by subset of ranks and are waiting for remainder of "
+        "ranks for more than %d seconds. This may indicate that different "
+        "ranks are trying to submit different tensors or that only subset "
+        "of ranks is submitting tensors, which will cause deadlock.\n",
+        stall_warning_sec_);
+    std::fprintf(stderr, "Stalled ops:\n");
+    preamble = true;
+  };
+  auto missing_ranks = [&](const std::vector<bool>& seen) {
+    std::string missing;
+    for (int r = 0; r < size_; ++r) {
+      if (!seen[r]) {
+        if (!missing.empty()) missing += ", ";
+        missing += std::to_string(r);
+      }
+    }
+    return missing;
+  };
   for (auto& kv : message_table_) {
     auto age = std::chrono::duration_cast<std::chrono::seconds>(
                    now - kv.second.first_seen)
                    .count();
     if (age < stall_warning_sec_) continue;
-    if (!preamble) {
-      std::fprintf(
-          stderr,
-          "One or more tensors were submitted to be reduced, gathered or "
-          "broadcasted by subset of ranks and are waiting for remainder of "
-          "ranks for more than %d seconds. This may indicate that different "
-          "ranks are trying to submit different tensors or that only subset "
-          "of ranks is submitting tensors, which will cause deadlock.\n",
-          stall_warning_sec_);
-      std::fprintf(stderr, "Stalled ops:\n");
-      preamble = true;
-    }
-    std::string missing;
-    for (int r = 0; r < size_; ++r) {
-      if (!kv.second.seen[r]) {
-        if (!missing.empty()) missing += ", ";
-        missing += std::to_string(r);
-      }
-    }
+    warn_preamble();
     std::fprintf(stderr, "%s [missing ranks: %s]\n", kv.first.c_str(),
-                 missing.c_str());
+                 missing_ranks(kv.second.seen).c_str());
+  }
+  // Cache-hit readiness bits stall the same way full requests do (a
+  // subset of ranks re-enqueued a cached tensor, the rest never did).
+  for (auto& kv : coord_slot_bits_) {
+    if (kv.second.count == 0 || kv.second.count == size_) continue;
+    auto age = std::chrono::duration_cast<std::chrono::seconds>(
+                   now - kv.second.first_seen)
+                   .count();
+    if (age < stall_warning_sec_) continue;
+    warn_preamble();
+    auto nit = coord_slot_names_.find(kv.first);
+    std::fprintf(stderr, "%s [cached slot %u; missing ranks: %s]\n",
+                 nit == coord_slot_names_.end() ? "?" : nit->second.c_str(),
+                 kv.first, missing_ranks(kv.second.seen).c_str());
   }
 }
 
@@ -1837,6 +2181,10 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
     tensor_table_.emplace(name, std::move(e));
     message_queue_.push_back(std::move(q));
   }
+  // Wake the background loop immediately (event-driven cycle): the tensor
+  // negotiates on the next control round trip instead of waiting out the
+  // remainder of HOROVOD_CYCLE_TIME.
+  cycle_cv_.notify_one();
   return handle;
 }
 

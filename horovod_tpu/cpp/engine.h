@@ -33,6 +33,7 @@
 #include <deque>
 #include <memory>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <unordered_map>
@@ -104,6 +105,25 @@ class Engine {
   int64_t responses_executed() const { return responses_executed_.load(); }
   int64_t tensors_executed() const { return tensors_executed_.load(); }
 
+  // Response-cache / control-plane observability.  `cache_hits` counts
+  // enqueues negotiated as a single slot bit; `cache_misses` counts
+  // cacheable-type enqueues that went through full negotiation (first
+  // sight of a signature, renegotiation after an evict);
+  // `cache_evictions` counts slots dropped from this rank's replica.
+  // `negotiation_bytes_tx/rx` sum control-frame payloads (+8-byte length
+  // prefix) from this process's perspective; `control_round_trips`
+  // counts request→response exchanges that carried NEGOTIATION payload
+  // (requests, hit bits, evicts, responses, cached slots, or shutdown —
+  // idle heartbeat cycles are excluded) — bench divides it by steps to
+  // show the cache collapsing per-tensor negotiation into ~1 round trip
+  // per step.
+  int64_t cache_hits() const { return cache_hits_.load(); }
+  int64_t cache_misses() const { return cache_misses_.load(); }
+  int64_t cache_evictions() const { return cache_evictions_.load(); }
+  int64_t negotiation_bytes_tx() const { return negotiation_bytes_tx_.load(); }
+  int64_t negotiation_bytes_rx() const { return negotiation_bytes_rx_.load(); }
+  int64_t control_round_trips() const { return control_round_trips_.load(); }
+
   // Why the engine aborted ("" while healthy or after a clean shutdown).
   // Safe to call from any thread: the background thread publishes
   // abort_reason_ before its shut_down_ release-store, and this reads it
@@ -123,6 +143,25 @@ class Engine {
   Engine() = default;
   void BackgroundLoop();
   bool RunLoopOnce();                        // returns false on shutdown
+  // Pop the message queue into `my_list`, classifying each request
+  // against the local cache replica: known signature → hit bit, changed
+  // signature → evict + full request, unknown → full request.  Also
+  // flushes requests forced back to full negotiation by a remote evict.
+  void DrainMessageQueue(RequestList* my_list);
+  // Worker-side replica maintenance for one response frame: apply
+  // evict_slots (resubmitting any of our tensors that were riding an
+  // evicted slot), then insert new slot assignments carried by the
+  // responses.  Must run BEFORE the responses execute (execution drains
+  // the tensor table the signatures are read from).
+  void ApplyCacheUpdates(const ResponseList& list);
+  // Execute the cycle's agreed cached slots from the local replica
+  // (fused like freshly negotiated responses).  Returns false — aborting
+  // the engine — on a replica/protocol inconsistency (an agreed slot this
+  // rank does not hold), which would otherwise strand tensors forever.
+  bool ExecuteCachedResponses(const ResponseList& list, bool* executed_any);
+  // Coordinator-side: drop a slot everywhere (idempotent within a cycle).
+  void CoordinatorEvictSlot(uint32_t slot, ResponseList* out);
+  void ClearCacheState();
   // Coordinator-only: tell every still-reachable worker that `culprit`
   // failed, so survivors abort promptly instead of waiting out their own
   // transport timeouts; sets abort_reason_ to `message`.
@@ -161,7 +200,15 @@ class Engine {
   std::thread background_;
 
   // -- knobs (reference operations.h:53-58 env vars) --
+  // Upper bound on a negotiation cycle's idle wait, NOT a floor: the
+  // background loop waits on cycle_cv_ and wakes immediately when work
+  // is enqueued (or shutdown/fault is requested), so single-tensor
+  // latency is bounded by the control round trip, not by this knob.
   int cycle_time_ms_ = 5;
+  // HOROVOD_CACHE_CAPACITY: max live negotiation-cache slots (0 disables
+  // the cache entirely — every cycle uses the full-Request path).
+  int64_t cache_capacity_ = 1024;
+  bool cache_enabled_ = false;               // capacity > 0 && size > 1
   int64_t fusion_threshold_ = 64 * 1024 * 1024;
   bool stall_check_disabled_ = false;
   int stall_warning_sec_ = 60;
@@ -214,6 +261,10 @@ class Engine {
   std::mutex mu_;
   std::unordered_map<std::string, TensorTableEntry> tensor_table_;
   std::deque<Request> message_queue_;
+  // Wakes the background loop the moment work arrives (Enqueue) or
+  // shutdown/fault is requested; RunLoopOnce waits on it with
+  // cycle_time_ms_ as the idle-heartbeat upper bound.
+  std::condition_variable cycle_cv_;
 
   // -- handles --
   std::mutex handle_mu_;
@@ -237,6 +288,52 @@ class Engine {
   std::atomic<std::thread::id> bg_thread_id_{};
   void AssertBackgroundThread() const;
   std::chrono::steady_clock::time_point last_stall_check_;
+
+  // -- negotiation response cache (background-thread-only, like
+  //    message_table_; every access site is AssertBackgroundThread-
+  //    checked via its callers).
+  //
+  // Every rank keeps an identical replica: slot → (signature, the
+  // single-tensor Response negotiated for it).  The coordinator is the
+  // only writer of slot ASSIGNMENTS (broadcast via Response::cache_slots)
+  // and EVICTIONS (ResponseList::evict_slots), so the replicas stay in
+  // lockstep with the wire protocol's one-frame-per-cycle cadence. --
+  struct CacheSignature {
+    RequestType type = RequestType::ALLREDUCE;
+    DataType dtype = DataType::FLOAT32;
+    int32_t root_rank = -1;
+    ReduceOp red_op = ReduceOp::SUM;
+    std::vector<int64_t> shape;
+    bool Matches(const Request& q) const {
+      return q.type == type && q.dtype == dtype && q.root_rank == root_rank &&
+             q.red_op == red_op && q.shape == shape;
+    }
+  };
+  struct CacheEntry {
+    CacheSignature sig;
+    Response response;    // single-tensor, ready to execute/fuse
+  };
+  std::unordered_map<std::string, uint32_t> cache_by_name_;
+  std::unordered_map<uint32_t, CacheEntry> cache_entries_;
+  // Slots whose hit bit we sent but whose cached response has not fired
+  // yet (tensor still in tensor_table_); on an evict broadcast these
+  // convert back to full Requests so nothing strands.
+  std::unordered_map<uint32_t, std::string> pending_cache_hits_;
+  std::vector<Request> cache_resubmits_;     // forced-full after evicts
+
+  // Coordinator-only readiness bits per slot (the cached analogue of
+  // PendingInfo) plus the slot allocator.  Freed slot ids are reused
+  // smallest-first so ids stay < capacity and hit bitvectors stay tiny.
+  struct SlotPending {
+    std::vector<bool> seen;
+    int count = 0;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  std::unordered_map<uint32_t, SlotPending> coord_slot_bits_;
+  std::unordered_map<uint32_t, std::string> coord_slot_names_;
+  std::unordered_map<std::string, uint32_t> coord_slot_by_name_;
+  std::set<uint32_t> free_slots_;
+  uint32_t next_slot_ = 0;
 
   // -- network --
   Socket control_listener_;                // rank 0
@@ -266,6 +363,12 @@ class Engine {
   std::atomic<int64_t> exec_cycles_{0};
   std::atomic<int64_t> responses_executed_{0};
   std::atomic<int64_t> tensors_executed_{0};
+  std::atomic<int64_t> cache_hits_{0};
+  std::atomic<int64_t> cache_misses_{0};
+  std::atomic<int64_t> cache_evictions_{0};
+  std::atomic<int64_t> negotiation_bytes_tx_{0};
+  std::atomic<int64_t> negotiation_bytes_rx_{0};
+  std::atomic<int64_t> control_round_trips_{0};
 
   // -- timeline --
   Timeline timeline_;

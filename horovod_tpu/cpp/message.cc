@@ -1,5 +1,7 @@
 #include "message.h"
 
+#include <algorithm>
+
 namespace hvd {
 
 static void SerializeRequest(const Request& q, Writer* w) {
@@ -28,10 +30,51 @@ static bool ParseRequest(Reader* r, Request* q) {
   return r->ok();
 }
 
+// Cache-hit slot ids travel bit-packed: u32 bit count (highest set slot
+// + 1, 0 when no hits) followed by ceil(nbits/8) bytes.  Slot ids are
+// dense and bounded by HOROVOD_CACHE_CAPACITY, so a steady-state cycle's
+// whole readiness report is a handful of bytes.
+static void SerializeSlotBitvector(const std::vector<uint32_t>& slots,
+                                   Writer* w) {
+  uint32_t nbits = 0;
+  for (auto s : slots) nbits = std::max(nbits, s + 1);
+  w->u32(nbits);
+  std::vector<uint8_t> bits((nbits + 7) / 8, 0);
+  for (auto s : slots) bits[s / 8] |= static_cast<uint8_t>(1u << (s % 8));
+  for (auto b : bits) w->u8(b);
+}
+
+static bool ParseSlotBitvector(Reader* r, std::vector<uint32_t>* slots) {
+  slots->clear();
+  uint32_t nbits = r->u32();
+  if (!r->ok() || nbits > (1u << 20)) return false;  // corrupt frame guard
+  for (uint32_t byte = 0; byte < (nbits + 7) / 8; ++byte) {
+    uint8_t b = r->u8();
+    for (int i = 0; i < 8 && byte * 8 + i < nbits; ++i) {
+      if (b & (1u << i)) slots->push_back(byte * 8 + i);
+    }
+  }
+  return r->ok();
+}
+
+static void SerializeSlotList(const std::vector<uint32_t>& slots, Writer* w) {
+  w->u32(static_cast<uint32_t>(slots.size()));
+  for (auto s : slots) w->u32(s);
+}
+
+static bool ParseSlotList(Reader* r, std::vector<uint32_t>* slots) {
+  slots->clear();
+  uint32_t n = r->u32();
+  for (uint32_t i = 0; i < n && r->ok(); ++i) slots->push_back(r->u32());
+  return r->ok();
+}
+
 void SerializeRequestList(const RequestList& list, Writer* w) {
   w->u8(list.shutdown ? 1 : 0);
   w->u32(static_cast<uint32_t>(list.requests.size()));
   for (const auto& q : list.requests) SerializeRequest(q, w);
+  SerializeSlotBitvector(list.cache_hits, w);
+  SerializeSlotList(list.cache_evicts, w);
 }
 
 bool ParseRequestList(Reader* r, RequestList* out) {
@@ -41,6 +84,8 @@ bool ParseRequestList(Reader* r, RequestList* out) {
   for (uint32_t i = 0; i < n; ++i) {
     if (!ParseRequest(r, &out->requests[i])) return false;
   }
+  if (!ParseSlotBitvector(r, &out->cache_hits)) return false;
+  if (!ParseSlotList(r, &out->cache_evicts)) return false;
   return r->ok();
 }
 
@@ -53,6 +98,8 @@ static void SerializeResponse(const Response& s, Writer* w) {
   for (auto v : s.tensor_sizes) w->i64(v);
   w->i32(s.root_rank);
   w->u8(static_cast<uint8_t>(s.red_op));
+  w->u32(static_cast<uint32_t>(s.cache_slots.size()));
+  for (auto c : s.cache_slots) w->i32(c);
 }
 
 static bool ParseResponse(Reader* r, Response* s) {
@@ -66,6 +113,12 @@ static bool ParseResponse(Reader* r, Response* s) {
   for (uint32_t i = 0; i < m && r->ok(); ++i) s->tensor_sizes.push_back(r->i64());
   s->root_rank = r->i32();
   s->red_op = static_cast<ReduceOp>(r->u8());
+  uint32_t c = r->u32();
+  s->cache_slots.clear();
+  for (uint32_t i = 0; i < c && r->ok(); ++i) s->cache_slots.push_back(r->i32());
+  // Normalize: every tensor name has a slot entry (-1 = uncached), so
+  // consumers can index the two vectors in lockstep unconditionally.
+  s->cache_slots.resize(s->tensor_names.size(), -1);
   return r->ok();
 }
 
@@ -76,6 +129,8 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
   w->str(list.abort_message);
   w->u32(static_cast<uint32_t>(list.responses.size()));
   for (const auto& s : list.responses) SerializeResponse(s, w);
+  SerializeSlotList(list.cached_slots, w);
+  SerializeSlotList(list.evict_slots, w);
 }
 
 bool ParseResponseList(Reader* r, ResponseList* out) {
@@ -88,6 +143,8 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
   for (uint32_t i = 0; i < n; ++i) {
     if (!ParseResponse(r, &out->responses[i])) return false;
   }
+  if (!ParseSlotList(r, &out->cached_slots)) return false;
+  if (!ParseSlotList(r, &out->evict_slots)) return false;
   return r->ok();
 }
 

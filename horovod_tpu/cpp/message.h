@@ -93,6 +93,17 @@ struct Request {
 struct RequestList {
   std::vector<Request> requests;
   bool shutdown = false;    // shutdown piggybacks on the control stream
+  // Response-cache control (upstream Horovod 0.21's bitvector idea): a
+  // tensor whose (name, type, dtype, shape, root, op) was negotiated
+  // before is reported as a single bit — the coordinator-assigned cache
+  // slot id — instead of a full serialized Request.  On the wire the
+  // hits travel bit-packed (slot ids are dense, bounded by
+  // HOROVOD_CACHE_CAPACITY), so a steady-state step is a few bytes.
+  std::vector<uint32_t> cache_hits;    // slot ids this rank is ready on
+  // Slots this rank invalidated (same name re-enqueued with a different
+  // signature); the full replacement Request rides in `requests` in the
+  // same frame.
+  std::vector<uint32_t> cache_evicts;
 };
 
 struct Response {
@@ -104,6 +115,11 @@ struct Response {
   std::vector<int64_t> tensor_sizes;
   int32_t root_rank = -1;
   ReduceOp red_op = ReduceOp::SUM;
+  // Parallel to tensor_names: the cache slot the coordinator assigned to
+  // each tensor (-1 = uncached).  Every rank inserts (name → slot,
+  // slot → single-tensor response) into its local cache replica on
+  // receipt, so later steps negotiate via RequestList::cache_hits.
+  std::vector<int32_t> cache_slots;
 };
 
 struct ResponseList {
@@ -118,6 +134,17 @@ struct ResponseList {
   bool abort = false;
   int32_t abort_rank = -1;      // the rank the coordinator lost
   std::string abort_message;
+  // Slots every rank agreed on this cycle (all size_ hit bits seen):
+  // each rank executes the response stored in its local cache replica —
+  // the coordinator never re-runs ConstructResponse and ships only the
+  // slot ids.  Ascending slot order = deterministic execution order.
+  std::vector<uint32_t> cached_slots;
+  // Slots invalidated this cycle; every rank drops them from its replica.
+  // A rank with a pending hit bit on an evicted slot resubmits that
+  // tensor as a full Request next cycle.  Applied BEFORE cache_slots
+  // assignments from the same frame (a freed slot may be reassigned in
+  // the very cycle it was evicted).
+  std::vector<uint32_t> evict_slots;
 };
 
 // Flat byte-buffer serialization (host byte order; in-cluster only).

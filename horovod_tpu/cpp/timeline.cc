@@ -90,6 +90,12 @@ void Timeline::NegotiateEnd(const std::string& name) {
   WriteEvent(TensorPid(name), 'E', "NEGOTIATE");
 }
 
+void Timeline::NegotiateCached(const std::string& name) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'X', "NEGOTIATE", "NEGOTIATE_CACHED");
+}
+
 void Timeline::Start(const std::string& name) {
   std::lock_guard<std::recursive_mutex> lk(mu_);
   if (file_ == nullptr) return;

@@ -25,6 +25,10 @@ class Timeline {
   void NegotiateStart(const std::string& name);
   void NegotiateRankReady(const std::string& name, int rank);
   void NegotiateEnd(const std::string& name);
+  // Negotiation satisfied from the response cache: one instantaneous
+  // NEGOTIATE_CACHED marker instead of a NEGOTIATE span — the visual
+  // proof that a tensor skipped full coordinator negotiation.
+  void NegotiateCached(const std::string& name);
   void Start(const std::string& name);                    // top-level op
   void ActivityStart(const std::string& name, const std::string& activity);
   void ActivityEnd(const std::string& name);

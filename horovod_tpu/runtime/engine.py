@@ -130,7 +130,13 @@ class NativeEngine:
         try:
             for sym in ("horovod_exec_cycles",
                         "horovod_responses_executed",
-                        "horovod_tensors_executed"):
+                        "horovod_tensors_executed",
+                        "horovod_cache_hits",
+                        "horovod_cache_misses",
+                        "horovod_cache_evictions",
+                        "horovod_negotiation_bytes_tx",
+                        "horovod_negotiation_bytes_rx",
+                        "horovod_control_round_trips"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
                 fn.restype = ctypes.c_int64
@@ -267,20 +273,42 @@ class NativeEngine:
     # -- execution stats --
 
     def stats(self) -> dict:
-        """Cumulative execution counters: negotiation ``cycles`` that
-        executed work, ``responses`` executed (a fused batch counts once),
-        and ``tensors`` executed.  ``tensors/responses > 1`` ⇒ fusion;
-        a frontend batching N tensors into one cycle moves ``cycles`` by
-        ~1 instead of N."""
-        if getattr(getattr(self._lib, "horovod_exec_cycles", None),
+        """Cumulative execution + control-plane counters.
+
+        Execution: negotiation ``cycles`` that executed work,
+        ``responses`` executed (a fused batch counts once), ``tensors``
+        executed.  ``tensors/responses > 1`` ⇒ fusion; a frontend
+        batching N tensors into one cycle moves ``cycles`` by ~1
+        instead of N.
+
+        Control plane (response cache, HOROVOD_CACHE_CAPACITY):
+        ``cache_hits``/``cache_misses`` count enqueues negotiated via a
+        cache-slot bit vs. a full serialized request;
+        ``cache_evictions`` counts slots invalidated (shape/dtype/op
+        change, abort, capacity churn); ``negotiation_bytes_tx``/``_rx``
+        sum control-frame bytes from this process's perspective; and
+        ``control_round_trips`` counts coordinator exchanges that carried
+        negotiation payload (idle heartbeats excluded) — divide its delta
+        by the step count to verify steady state runs at ~1 round trip
+        per step."""
+        if getattr(getattr(self._lib, "horovod_control_round_trips", None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the execution counters — "
-                "rebuild it with `make -C horovod_tpu/cpp`")
+                "libhorovod_core.so predates the execution/control-plane "
+                "counters — rebuild it with `make -C horovod_tpu/cpp`")
         return {
             "cycles": self._lib.horovod_exec_cycles(),
             "responses": self._lib.horovod_responses_executed(),
             "tensors": self._lib.horovod_tensors_executed(),
+            "cache_hits": self._lib.horovod_cache_hits(),
+            "cache_misses": self._lib.horovod_cache_misses(),
+            "cache_evictions": self._lib.horovod_cache_evictions(),
+            "negotiation_bytes_tx":
+                self._lib.horovod_negotiation_bytes_tx(),
+            "negotiation_bytes_rx":
+                self._lib.horovod_negotiation_bytes_rx(),
+            "control_round_trips":
+                self._lib.horovod_control_round_trips(),
         }
 
     # -- handle API --

@@ -346,6 +346,141 @@ def scenario_fault_steps(rank, size, eng):
         f"{fkind} on rank {frank}")
 
 
+def scenario_cache_steady(rank, size, eng):
+    # Steady-state identical-tensor loop (the data-parallel training
+    # shape): step 1 fully negotiates and earns a cache slot; every later
+    # step negotiates as ONE slot bit and ONE coordinator round trip.
+    # HOROVOD_SMOKE_STEPS overrides the step count (ci.sh's bounded
+    # 50-step control-plane gate rides this scenario).
+    steps = int(os.environ.get("HOROVOD_SMOKE_STEPS", "100"))
+    expected = size * (size + 1) / 2.0
+    before = eng.stats()
+    for _ in range(steps):
+        x = np.full((1024,), float(rank + 1), dtype=np.float32)
+        out = eng.allreduce(x, name="steady.t")
+        assert np.allclose(out, expected), out[0]
+    after = eng.stats()
+    hits = after["cache_hits"] - before["cache_hits"]
+    misses = after["cache_misses"] - before["cache_misses"]
+    assert hits + misses == steps, (hits, misses, steps)
+    # Only the first sight of the signature may miss: >= 98% at the
+    # default 100 steps, and never more than the warm-up miss + 2% churn.
+    assert misses <= max(1, steps // 50), (
+        f"cache hit rate {hits / float(steps):.3f} ({hits}/{steps})")
+    # The ISSUE's steady-state bound: <= 1 coordinator round trip per
+    # cycle/step (1.5 allows the rare idle heartbeat landing mid-loop).
+    rts = after["control_round_trips"] - before["control_round_trips"]
+    per_step = rts / float(steps)
+    assert per_step <= 1.5, (
+        f"{per_step:.2f} control round trips per step (want ~1)")
+    # Steady-state control frames are a few dozen bytes (slot bitvector +
+    # framing), nowhere near a serialized per-tensor Request stream.
+    tx_per_step = (after["negotiation_bytes_tx"]
+                   - before["negotiation_bytes_tx"]) / float(steps)
+    if rank != 0:
+        assert tx_per_step < 128, f"{tx_per_step:.0f} tx bytes/step"
+
+
+def scenario_cache_invalidate(rank, size, eng):
+    # Same tensor name renegotiated with a new shape, then a new dtype:
+    # each change must evict the slot and renegotiate (never reuse the
+    # stale layout), and hits must resume on the new signature.
+    before = eng.stats()
+    expected = size * (size + 1) / 2.0
+    a = np.full((8,), float(rank + 1), dtype=np.float32)
+    assert np.allclose(eng.allreduce(a, name="inv.t"), expected)   # miss
+    assert np.allclose(eng.allreduce(a, name="inv.t"), expected)   # hit
+    b = np.full((4, 2), float(rank + 1), dtype=np.float32)
+    assert np.allclose(eng.allreduce(b, name="inv.t"), expected)   # evict
+    assert np.allclose(eng.allreduce(b, name="inv.t"), expected)   # hit
+    c = np.full((4, 2), float(rank + 1), dtype=np.float64)
+    assert np.allclose(eng.allreduce(c, name="inv.t"), expected)   # evict
+    after = eng.stats()
+    assert after["cache_evictions"] - before["cache_evictions"] >= 2, (
+        before, after)
+    assert after["cache_hits"] - before["cache_hits"] >= 2, (before, after)
+    assert after["cache_misses"] - before["cache_misses"] >= 3, (
+        before, after)
+    # A fused burst straight after the churn: the fusion buffer must pack
+    # the NEW layouts (a stale cached response here would corrupt offsets).
+    handles = [
+        eng.enqueue_allreduce(
+            np.full((16,), float(rank + i), dtype=np.float32),
+            name=f"inv.fused.{i}")
+        for i in range(8)
+    ]
+    for i, h in enumerate(handles):
+        out = eng.synchronize(h)
+        assert np.allclose(out, sum(r + i for r in range(size))), (i, out)
+
+
+def scenario_cache_disabled(rank, size, eng):
+    # HOROVOD_CACHE_CAPACITY=0 (pinned by the test): the pre-cache
+    # negotiation path must stay fully intact — correct values, zero
+    # cache activity.
+    before = eng.stats()
+    expected = size * (size + 1) / 2.0
+    for _ in range(20):
+        x = np.full((64,), float(rank + 1), dtype=np.float32)
+        assert np.allclose(eng.allreduce(x, name="nc.t"), expected)
+    after = eng.stats()
+    assert after["cache_hits"] == before["cache_hits"], (before, after)
+    assert after["cache_misses"] == before["cache_misses"], (before, after)
+    assert after["cache_evictions"] == before["cache_evictions"]
+
+
+def scenario_cache_restart(rank, size, eng):
+    # Clean shutdown + re-Init must start from an EMPTY cache on every
+    # rank: the first post-restart step of a previously cached tensor is
+    # a full renegotiation (a stale slot id replayed into the new world
+    # would execute the wrong response).
+    expected = size * (size + 1) / 2.0
+    for _ in range(3):
+        x = np.full((8,), float(rank + 1), dtype=np.float32)
+        assert np.allclose(eng.allreduce(x, name="cr.t"), expected)
+    s1 = eng.stats()
+    basics.shutdown()
+    basics.init()
+    x = np.full((8,), float(rank + 1), dtype=np.float32)
+    assert np.allclose(eng.allreduce(x, name="cr.t"), expected)
+    s2 = eng.stats()
+    assert s2["cache_hits"] == s1["cache_hits"], "stale cache slot replayed"
+    assert s2["cache_misses"] == s1["cache_misses"] + 1, (s1, s2)
+    # ... and the new world's cache warms up again.
+    assert np.allclose(eng.allreduce(x.copy(), name="cr.t"), expected)
+    s3 = eng.stats()
+    assert s3["cache_hits"] == s2["cache_hits"] + 1, (s2, s3)
+
+
+def scenario_cache_fault_reinit(rank, size, eng):
+    # Elastic abort path (PR 1) with a HOT cache: HOROVOD_FAULT_INJECT
+    # drop-conn kills the world mid-steady-state; after the abort an
+    # in-process shutdown + re-Init must start from an EMPTY cache on
+    # every rank — recovery never replays stale slot ids — and the
+    # recovered world must produce correct values and warm up again.
+    expected = size * (size + 1) / 2.0
+    try:
+        for _ in range(8):
+            x = np.full((16,), float(rank + 1), dtype=np.float32)
+            out = eng.allreduce(x, name="cf.t")
+            assert np.allclose(out, expected), out[0]
+        raise AssertionError("expected an abort from the injected fault")
+    except HorovodInternalError:
+        pass
+    basics.shutdown()
+    basics.init()
+    s1 = eng.stats()
+    x = np.full((16,), float(rank + 1), dtype=np.float32)
+    assert np.allclose(eng.allreduce(x, name="cf.t"), expected)
+    s2 = eng.stats()
+    assert s2["cache_hits"] == s1["cache_hits"], "stale cache slot replayed"
+    assert s2["cache_misses"] == s1["cache_misses"] + 1, (s1, s2)
+    for _ in range(3):
+        assert np.allclose(eng.allreduce(x.copy(), name="cf.t"), expected)
+    s3 = eng.stats()
+    assert s3["cache_hits"] == s2["cache_hits"] + 3, (s2, s3)
+
+
 SCENARIOS = {
     "allreduce": scenario_allreduce,
     "fused": scenario_fused,
@@ -365,6 +500,11 @@ SCENARIOS = {
     "worker_death": scenario_worker_death,
     "wedged_peer": scenario_wedged_peer,
     "fault_steps": scenario_fault_steps,
+    "cache_steady": scenario_cache_steady,
+    "cache_invalidate": scenario_cache_invalidate,
+    "cache_disabled": scenario_cache_disabled,
+    "cache_restart": scenario_cache_restart,
+    "cache_fault_reinit": scenario_cache_fault_reinit,
     "all": None,
 }
 

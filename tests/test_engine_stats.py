@@ -1,0 +1,72 @@
+"""Control-plane response-cache tests (steady-state negotiation bypass).
+
+The negotiation cache (HOROVOD_CACHE_CAPACITY, default 1024) lets a
+tensor whose (name, type, dtype, shape, root, op) was validated once ride
+a single slot bit instead of a full serialized Request, and lets the
+coordinator skip ConstructResponse entirely when every rank's bitvector
+agrees.  These tests pin down the three properties the bench alone cannot:
+
+* steady state: >= 98% hit rate and ~1 coordinator round trip per step
+  over an identical-tensor loop (the ISSUE's "<= 1 round trip per cycle"
+  acceptance bound, with 1.5x slack for stray idle heartbeats);
+* invalidation: a shape/dtype change for a cached name evicts the slot
+  and renegotiates — never replays the stale layout into the fusion
+  buffer;
+* lifecycle: capacity 0 reproduces the uncached path exactly, and a
+  shutdown + re-Init starts from an empty cache on every rank.
+
+Scenario bodies live in tests/native_worker.py (multi-process, jax-free).
+"""
+
+import pytest
+
+from tests.test_native_engine import run_workers
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_steady_state_hit_rate_and_round_trips(n):
+    """100-step identical-tensor loop: >= 98% cache hits, <= 1.5 control
+    round trips per step, steady-state frames a few dozen bytes."""
+    run_workers(n, "cache_steady", timeout=150)
+
+
+def test_cache_invalidation_evicts_and_renegotiates():
+    """Shape then dtype change on a cached name: evict + full
+    renegotiation each time, correct values, fusion buffer intact."""
+    run_workers(2, "cache_invalidate", timeout=120)
+
+
+def test_cache_invalidation_wide_world():
+    """Same churn at 4 ranks — the evict broadcast must reach ranks that
+    are neither the coordinator nor the evicting rank."""
+    run_workers(4, "cache_invalidate", timeout=150)
+
+
+def test_cache_capacity_zero_disables_cache():
+    """HOROVOD_CACHE_CAPACITY=0: the pre-cache negotiation path stays
+    intact with zero cache activity (the documented escape hatch, and the
+    de-flake pin used by cycle-count tests)."""
+    run_workers(2, "cache_disabled", timeout=120,
+                extra_env={"HOROVOD_CACHE_CAPACITY": "0"})
+
+
+def test_clean_restart_starts_with_empty_cache():
+    """shutdown() + init() in the same processes: the first post-restart
+    step of a previously cached tensor fully renegotiates (no stale slot
+    replay into the new world)."""
+    run_workers(3, "cache_restart", timeout=120)
+
+
+def test_timeline_records_cached_negotiation(tmp_path):
+    """Cache-satisfied negotiations surface as NEGOTIATE_CACHED markers in
+    the chrome-tracing timeline (observability for docs/performance.md)."""
+    path = tmp_path / "timeline.json"
+    run_workers(2, "cache_steady", timeout=150,
+                extra_env={"HOROVOD_TIMELINE": str(path),
+                           "HOROVOD_SMOKE_STEPS": "20"})
+    text = path.read_text()
+    assert "NEGOTIATE_CACHED" in text
+    # The warm-up step still produced a real NEGOTIATE span (the 'B'
+    # begin event carries name "NEGOTIATE" exactly, which the cached
+    # marker's "NEGOTIATE_CACHED" cannot shadow).
+    assert '"name": "NEGOTIATE"}' in text

@@ -80,6 +80,16 @@ def test_injected_fault_mid_rank():
                 extra_env={**FAULT_ENV, "HOROVOD_FAULT_INJECT": "1:4:exit"})
 
 
+def test_abort_recovery_starts_with_empty_cache():
+    """drop-conn abort while the negotiation cache is HOT, then in-process
+    shutdown + re-Init: every rank must come back with an EMPTY cache (the
+    first post-recovery step fully renegotiates — recovery never replays
+    stale slot ids) and still produce correct values."""
+    run_workers(3, "cache_fault_reinit", timeout=90,
+                extra_env={**FAULT_ENV,
+                           "HOROVOD_FAULT_INJECT": "1:3:drop-conn"})
+
+
 def _run_elastic_job(inject: str | None, timeout=240):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)

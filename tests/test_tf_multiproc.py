@@ -28,7 +28,9 @@ def test_tf_ops(n):
 
 
 def test_tf_gradients():
-    run_tf_workers(2, "grads")
+    # Cache pinned off: the scenario asserts negotiation cycle counts,
+    # which must keep measuring the uncached full-request path.
+    run_tf_workers(2, "grads", extra_env={"HOROVOD_CACHE_CAPACITY": "0"})
 
 
 @pytest.mark.parametrize("n", [2, 4])
@@ -36,8 +38,12 @@ def test_tf_grouped_allreduce_single_cycle(n):
     """The whole gradient batch completes in ~one negotiation cycle with
     fused responses (reference async+fusion property).  HOROVOD_CYCLE_TIME
     is pinned well above the default so the enqueue burst deterministically
-    lands inside one batching window even on a loaded CI host."""
-    run_tf_workers(n, "grouped", extra_env={"HOROVOD_CYCLE_TIME": "25"})
+    lands inside one batching window even on a loaded CI host, and
+    HOROVOD_CACHE_CAPACITY=0 pins the UNCACHED path so the cycle/response
+    counts keep asserting full-negotiation behavior deterministically
+    (the cached path has its own suite, tests/test_engine_stats.py)."""
+    run_tf_workers(n, "grouped", extra_env={"HOROVOD_CYCLE_TIME": "25",
+                                            "HOROVOD_CACHE_CAPACITY": "0"})
 
 
 def test_tf_mismatch_errors():
