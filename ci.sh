@@ -32,9 +32,30 @@ PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault"
 echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # These tests previously WOULD HANG when a rank died mid-collective; the
 # outer `timeout` makes a regression that reintroduces a hang fail fast
-# (124) instead of eating the whole CI budget.
+# (124) instead of eating the whole CI budget.  The chaos soaks (fault
+# AND slow) get their own budget below, and the shrink test runs in its
+# dedicated gate — not twice.
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
-    python -m pytest tests/ -q -m fault
+    python -m pytest tests/ -q -m "fault and not slow" \
+    --deselect tests/test_fault_tolerance.py::test_shrink_to_survivors_completes_at_smaller_size
+
+echo "== chaos membership soak (seeded multi-failure, hard timeout) =="
+# Randomized-but-seeded fault schedules over elastic runs: every seed
+# must converge or stop with the clean HOROVOD_ELASTIC_MIN_SIZE error —
+# never hang (the timeout is the hang detector).
+PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
+    python -m pytest tests/ -q -m "fault and slow"
+
+echo "== elastic resize gate (3 ranks, kill rank 2, no replacement) =="
+# In-place membership regression gate: rank 2 dies with no replacement;
+# the survivors must re-form the world at size 2 under a new membership
+# epoch and FINISH (the worker's in-state shadow asserts the result
+# equals a 2-rank run resumed from the same commit, and the post-resize
+# control-plane round-trip bound).  The hard timeout is the hang
+# detector — a regression that wedges the re-rendezvous fails fast.
+PALLAS_AXON_POOL_IPS= timeout -k 15 300 \
+    python -m pytest \
+    "tests/test_fault_tolerance.py::test_shrink_to_survivors_completes_at_smaller_size" -q
 
 echo "== control-plane cache gate (2 ranks, 50 steps, hard timeout) =="
 # Regression gate for the negotiation response cache: a steady-state

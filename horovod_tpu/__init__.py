@@ -17,6 +17,7 @@ SURVEY.md §7 steps 5-6.)
 
 from horovod_tpu import elastic
 from horovod_tpu.common import (
+    epoch,
     init,
     is_initialized,
     local_rank,
@@ -38,5 +39,6 @@ __all__ = [
     "size",
     "local_rank",
     "local_size",
+    "epoch",
     "mpi_threads_supported",
 ]

@@ -12,6 +12,7 @@ rank = basics.rank
 size = basics.size
 local_rank = basics.local_rank
 local_size = basics.local_size
+epoch = basics.epoch
 mpi_threads_supported = basics.mpi_threads_supported
 
 __all__ = [
@@ -24,5 +25,6 @@ __all__ = [
     "size",
     "local_rank",
     "local_size",
+    "epoch",
     "mpi_threads_supported",
 ]

@@ -285,6 +285,17 @@ class HorovodBasics:
                         f"native horovod_init failed with code {ret}"
                         + (f": {detail}" if detail else "")
                     )
+                # Adopt the COMMITTED identity: under elastic membership
+                # (HOROVOD_ELASTIC=1) the coordinator may have re-formed
+                # the world around the survivors — contiguous re-ranked,
+                # smaller (or re-grown) size — so the env-pinned identity
+                # is only the join candidacy, not the final word.  Gated
+                # on the elastic flag: outside it the engine never
+                # reassigns, and the process-wide engine singleton may
+                # predate this (test-local) HorovodBasics instance.
+                if os.environ.get("HOROVOD_ELASTIC", "") not in ("", "0"):
+                    self._rank = int(self._lib.horovod_rank())
+                    self._size = int(self._lib.horovod_size())
             self._initialized = True
             if not self._atexit_registered:
                 # Reference registers shutdown via atexit (common/__init__.py:69).
@@ -334,6 +345,16 @@ class HorovodBasics:
         self._check()
         return self._local_size
 
+    def epoch(self) -> int:
+        """Committed membership epoch — 0 before init or without the
+        native core.  Bumped by every successful rendezvous commit, so an
+        in-place elastic resize (shrink to survivors, worker rejoin)
+        increments it on every live member; control frames from older
+        epochs are structurally rejected by the engine."""
+        if self._lib is None or not hasattr(self._lib, "horovod_epoch"):
+            return 0
+        return int(self._lib.horovod_epoch())
+
     def mpi_threads_supported(self) -> bool:
         """Parity shim: there is no MPI; the coordination service is
         inherently multi-threaded, so report True (reference
@@ -375,6 +396,9 @@ class HorovodBasics:
         if hasattr(lib, "horovod_last_error"):
             lib.horovod_last_error.argtypes = []
             lib.horovod_last_error.restype = ctypes.c_char_p
+        if hasattr(lib, "horovod_epoch"):
+            lib.horovod_epoch.argtypes = []
+            lib.horovod_epoch.restype = ctypes.c_int64
         self._lib = lib
 
     @property

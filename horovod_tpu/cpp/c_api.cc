@@ -33,6 +33,11 @@ int horovod_size() { return Engine::Get().size(); }
 int horovod_local_rank() { return Engine::Get().local_rank(); }
 int horovod_local_size() { return Engine::Get().local_size(); }
 
+// Committed membership epoch: bumped by every successful rendezvous
+// commit; all live members of a world agree on it, and an elastic resize
+// increments it (stale-epoch control frames are rejected structurally).
+int64_t horovod_epoch() { return Engine::Get().epoch(); }
+
 // No MPI anywhere; the engine's own threading is unconditional.
 int horovod_mpi_threads_supported() { return 1; }
 
@@ -97,6 +102,9 @@ int64_t horovod_negotiation_bytes_rx() {
 }
 int64_t horovod_control_round_trips() {
   return Engine::Get().control_round_trips();
+}
+int64_t horovod_stale_epoch_msgs() {
+  return Engine::Get().stale_epoch_msgs();
 }
 
 // Why the engine aborted, copied into buf (truncated to buflen-1); empty

@@ -8,6 +8,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <map>
 #include <sstream>
 
 #if defined(__x86_64__)
@@ -247,6 +248,11 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   size_ = size;
   local_rank_ = local_rank;
   local_size_ = local_size;
+  // The launch identity is the persistent worker id and the job's full
+  // world size; an elastic rendezvous commit may assign a different
+  // (contiguous) rank_ and a smaller/restored size_ below.
+  worker_id_ = rank;
+  world_size_ = size;
   shut_down_.store(false);
   shutdown_requested_.store(false);
 
@@ -289,6 +295,19 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   // worker_patience_rounds_) still totals <= the fault timeout even in
   // the worst case where the COORDINATOR is the hung rank and no abort
   // broadcast is coming.
+  // Elastic in-place membership: HOROVOD_ELASTIC=1 lets a re-init after
+  // an abort commit a new world around the survivors (plus any candidates
+  // that show up within the grow window) instead of requiring every
+  // original rank back.
+  elastic_enabled_ = EnvInt64("HOROVOD_ELASTIC", 0) != 0;
+  min_size_ = static_cast<int>(EnvInt64("HOROVOD_ELASTIC_MIN_SIZE", 1));
+  if (min_size_ < 1) min_size_ = 1;
+  grow_timeout_sec_ =
+      static_cast<int>(EnvInt64("HOROVOD_ELASTIC_GROW_TIMEOUT_SEC", 30));
+  if (grow_timeout_sec_ < 1) grow_timeout_sec_ = 1;
+  rendezvous_timeout_sec_ =
+      static_cast<int>(EnvInt64("HOROVOD_RENDEZVOUS_TIMEOUT_SEC", 120));
+  if (rendezvous_timeout_sec_ < 5) rendezvous_timeout_sec_ = 5;
   fault_timeout_sec_ =
       static_cast<int>(EnvInt64("HOROVOD_FAULT_TIMEOUT_SEC", 0));
   if (fault_timeout_sec_ > 0) {
@@ -318,13 +337,26 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   enqueue_count_.store(0);
   fault_hang_.store(false);
   fault_drop_.store(false);
+  fault_stale_epoch_.store(false);
   if (const char* spec = std::getenv("HOROVOD_FAULT_INJECT");
       !fault_fired_ && spec != nullptr && spec[0] != '\0') {
-    int frank = -1;
-    long long fstep = -1;
-    char fkind[16] = {0};
-    if (std::sscanf(spec, "%d:%lld:%15s", &frank, &fstep, fkind) == 3 &&
-        frank == rank_) {
+    // Comma-separated schedule (chaos tests inject on several ranks in
+    // one job): each process arms the first entry matching its PERSISTENT
+    // worker id — stable across elastic re-ranking, identical to rank in
+    // a fixed world.
+    std::string all(spec);
+    for (size_t start = 0; start < all.size();) {
+      size_t end = all.find(',', start);
+      if (end == std::string::npos) end = all.size();
+      std::string tok = all.substr(start, end - start);
+      start = end + 1;
+      int frank = -1;
+      long long fstep = -1;
+      char fkind[16] = {0};
+      if (std::sscanf(tok.c_str(), "%d:%lld:%15s", &frank, &fstep, fkind)
+              != 3 || frank != worker_id_) {
+        continue;
+      }
       fault_step_ = fstep;
       if (std::strcmp(fkind, "exit") == 0) {
         fault_kind_ = FaultKind::EXIT;
@@ -332,13 +364,18 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         fault_kind_ = FaultKind::HANG;
       } else if (std::strcmp(fkind, "drop-conn") == 0) {
         fault_kind_ = FaultKind::DROP_CONN;
+      } else if (std::strcmp(fkind, "stale-epoch") == 0) {
+        fault_kind_ = FaultKind::STALE_EPOCH;
       } else {
         std::fprintf(stderr,
                      "horovod_tpu: unknown HOROVOD_FAULT_INJECT kind '%s' "
-                     "(want exit|hang|drop-conn); ignored\n",
+                     "(want exit|hang|drop-conn|stale-epoch); ignored\n",
                      fkind);
         fault_step_ = -1;
+        fault_kind_ = FaultKind::NONE;
+        continue;
       }
+      break;
     }
   }
   const char* timeline_path = std::getenv("HOROVOD_TIMELINE");
@@ -370,162 +407,27 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       return 1;
     }
 
-    // Rendezvous: workers report (rank, host, data_port) to the
-    // coordinator, which broadcasts the full peer table — the moral
-    // equivalent of MPI_Init's wire-up or NCCL's ncclUniqueId broadcast
-    // (reference operations.cc:894-931).
-    std::vector<std::string> peer_hosts(size_);
-    std::vector<int> peer_ports(size_, 0);
-    if (rank_ == 0) {
-      control_listener_ = Listen(host, port, size_ + 8, nullptr, &err);
-      if (!control_listener_.valid()) {
-        last_error_ = "coordinator listen on " + coordinator_addr + ": " + err;
-        return 1;
-      }
-      peer_hosts[0] = my_host;
-      peer_ports[0] = data_port;
-      std::vector<int32_t> peer_lr(size_, 0), peer_ls(size_, 1);
-      peer_lr[0] = local_rank_;
-      peer_ls[0] = local_size_;
-      worker_conns_.clear();
-      worker_conns_.resize(size_);
-      // Tolerant accept loop: a restart can race a dying previous
-      // engine's listener — workers whose connect landed there retry
-      // against this one, so dead/garbled/duplicate connections are
-      // dropped (latest per rank wins — safe because a rank's old-world
-      // and new-world workers are the SAME process acting sequentially,
-      // so a stale registrant cannot follow a live one) rather than
-      // failing the init.  Both the accept and each frame read are
-      // bounded so a silent remnant cannot park the loop, and the whole
-      // wait has a deadline so a crashed worker yields a diagnosable
-      // error instead of a hang.
-      control_listener_.SetTimeouts(5);  // accept honors SO_RCVTIMEO
-      auto rdv_deadline = std::chrono::steady_clock::now() +
-                          std::chrono::milliseconds(120000);
-      int got = 0;
-      while (got < size_ - 1) {
-        if (std::chrono::steady_clock::now() > rdv_deadline) {
-          last_error_ = "rendezvous timed out: heard from " +
-                        std::to_string(got) + " of " +
-                        std::to_string(size_ - 1) +
-                        " workers — check the other ranks' logs";
-          return 1;
-        }
-        Socket conn = Accept(control_listener_, &err);
-        if (!conn.valid()) {
-          continue;  // accept timeout tick; re-check the deadline
-        }
-        conn.SetTimeouts(10);
-        std::vector<uint8_t> frame;
-        if (!conn.RecvFrame(&frame)) {
-          continue;  // peer gave up (retrying) or stale/silent remnant
-        }
-        Reader r(frame.data(), frame.size());
-        int32_t peer_rank = r.i32();
-        std::string peer_host = r.str();
-        int32_t peer_port = r.i32();
-        int32_t lr = r.i32(), ls = r.i32();
-        if (!r.ok() || peer_rank < 1 || peer_rank >= size_) {
-          continue;  // not a rendezvous frame from this world
-        }
-        if (!worker_conns_[peer_rank].valid()) got++;
-        peer_hosts[peer_rank] = peer_host;
-        peer_ports[peer_rank] = peer_port;
-        peer_lr[peer_rank] = lr;
-        peer_ls[peer_rank] = ls;
-        worker_conns_[peer_rank] = std::move(conn);
-      }
-      // Coordinator decides the two-level topology GLOBALLY (the
-      // reference's is_homogeneous check, operations.cc:1511-1525):
-      // every rank must report the same local_size, block placement
-      // (local_rank == rank % local_size), and the layout must span >1
-      // node.  Per-rank guessing would let half the job wire hierarchical
-      // rings while the other half expects a flat ring.
-      bool want_hier = EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0;
-      bool hier_ok = want_hier && local_size_ > 1 &&
-                     size_ % local_size_ == 0 && size_ > local_size_;
-      for (int i = 0; hier_ok && i < size_; ++i) {
-        hier_ok = peer_ls[i] == local_size_ && peer_lr[i] == i % local_size_;
-      }
-      if (want_hier && !hier_ok) {
-        std::fprintf(stderr,
-                     "horovod_tpu: HOROVOD_HIERARCHICAL_ALLREDUCE ignored — "
-                     "needs a homogeneous block layout (equal local_size > 1 "
-                     "dividing size, local_rank == rank %% local_size on "
-                     "every rank); using the flat ring.\n");
-      }
-      hierarchical_ = hier_ok;
-      Writer w;
-      w.u8(hierarchical_ ? 1 : 0);
-      for (int i = 0; i < size_; ++i) {
-        w.str(peer_hosts[i]);
-        w.i32(peer_ports[i]);
-      }
-      for (int i = 1; i < size_; ++i) {
-        if (!worker_conns_[i].SendFrame(w.bytes())) {
-          last_error_ = "rendezvous bcast failed";
-          return 1;
-        }
-      }
-    } else {
-      // Retry the whole connect+exchange: after a restart, the first
-      // connect can land on the PREVIOUS engine's closing listener and
-      // die with EOF before the table arrives — the new listener is up
-      // moments later.
-      auto deadline = std::chrono::steady_clock::now() +
-                      std::chrono::milliseconds(60000);
-      bool joined = false;
-      std::string lasterr = "rendezvous timed out";
-      while (!joined && std::chrono::steady_clock::now() < deadline) {
-        auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
-                        deadline - std::chrono::steady_clock::now())
-                        .count();
-        coordinator_conn_ = ConnectRetry(host, port,
-                                         static_cast<int>(left), &err);
-        if (!coordinator_conn_.valid()) {
-          lasterr = err;
-          break;
-        }
-        // Bound the exchange: a connect that landed on a wedged previous
-        // listener must time out and retry, not block forever.
-        coordinator_conn_.SetTimeouts(10);
-        Writer w;
-        w.i32(rank_);
-        w.str(my_host);
-        w.i32(data_port);
-        w.i32(local_rank_);
-        w.i32(local_size_);
-        std::vector<uint8_t> frame;
-        // The table legitimately takes as long as the slowest worker's
-        // arrival: tolerate idle 10s rounds up to ~2 min (a dying
-        // previous listener still fails fast via EOF and retries).
-        if (!coordinator_conn_.SendFrame(w.bytes()) ||
-            !coordinator_conn_.RecvFrame(&frame, 11)) {
-          lasterr = "rendezvous exchange failed";
-          coordinator_conn_.Close();
-          std::this_thread::sleep_for(std::chrono::milliseconds(50));
-          continue;
-        }
-        Reader r(frame.data(), frame.size());
-        hierarchical_ = r.u8() != 0;
-        for (int i = 0; i < size_; ++i) {
-          peer_hosts[i] = r.str();
-          peer_ports[i] = r.i32();
-        }
-        if (!r.ok()) {
-          lasterr = "bad rendezvous table";
-          break;
-        }
-        joined = true;
-      }
-      if (!joined) {
-        last_error_ = lasterr;
-        return 1;
-      }
-    }
-
+    // Rendezvous: workers report (worker id, host, data_port) to the
+    // coordinator, which commits a membership epoch and broadcasts
+    // (epoch, assigned rank, size, peer table) — the moral equivalent of
+    // MPI_Init's wire-up or NCCL's ncclUniqueId broadcast (reference
+    // operations.cc:894-931), extended with elastic re-formation around
+    // survivors (HOROVOD_ELASTIC=1).
+    std::vector<std::string> peer_hosts;
+    std::vector<int> peer_ports;
+    int rdv = rank_ == 0
+        ? CoordinatorRendezvous(host, port, my_host, data_port,
+                                &peer_hosts, &peer_ports)
+        : WorkerRendezvous(host, port, my_host, data_port,
+                           &peer_hosts, &peer_ports);
+    if (rdv != 0) return rdv;
+    // rank_/size_/epoch_ now reflect the COMMITTED world, which on an
+    // elastic re-init may be smaller than the env identity.  A world
+    // shrunk to one keeps its control listener open (a later candidate
+    // triggers a grow re-rendezvous) but wires no rings.
+    if (size_ > 1) {
     node_id_ = rank_ / local_size_;
-    nnodes_ = size_ / local_size_;
+    nnodes_ = local_size_ > 0 ? size_ / local_size_ : 1;
 
     // Ring wiring.  Each directed ring edge is its own TCP connection,
     // opened by the edge's source, identified by an (origin rank, ring id)
@@ -565,12 +467,27 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         return 1;
       }
     }
+    // Bounded ring accepts: a neighbor that died between rendezvous and
+    // wiring must surface as a clean init error, not park the accept
+    // forever (Accept honors the listener timeout; see socket.cc).
+    data_listener_.SetTimeouts(5);
+    auto ring_deadline = std::chrono::steady_clock::now() +
+                         std::chrono::seconds(rendezvous_timeout_sec_);
     for (size_t i = 0; i < incoming.size(); ++i) {
-      Socket conn = Accept(data_listener_, &err);
-      if (!conn.valid()) {
-        last_error_ = "ring accept: " + err;
-        return 1;
+      Socket conn;
+      while (!conn.valid()) {
+        if (std::chrono::steady_clock::now() > ring_deadline) {
+          last_error_ = "ring accept: timed out waiting for neighbor "
+                        "connections — a peer likely died during wiring";
+          return 1;
+        }
+        conn = Accept(data_listener_, &err);
+        if (!conn.valid() && err != kAcceptTimedOut) {
+          last_error_ = "ring accept: " + err;
+          return 1;
+        }
       }
+      conn.SetTimeouts(10);
       int32_t hello[2] = {-1, -1};
       if (!conn.RecvAll(hello, sizeof(hello))) {
         last_error_ = "ring handshake recv failed";
@@ -610,12 +527,331 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         c.EnableKeepalive();
       }
     }
+    }  // committed size_ > 1: ring wiring + transport bounds
+  } else {
+    // Env-identity world of one (no rendezvous ran): commit a local epoch
+    // so restarts still advance it and stats stay meaningful.
+    epoch_.fetch_add(1);
   }
 
   last_stall_check_ = std::chrono::steady_clock::now();
   initialized_.store(true);
   background_ = std::thread(&Engine::BackgroundLoop, this);
   return 0;
+}
+
+// Tag on every JOIN frame ("HVJN"): the coordinator's listener is a
+// well-known port, and an untagged stray connection (health probe, port
+// scanner) must never be mistaken for a membership candidate — in the
+// mid-run path that mistake would abort the whole world.
+static constexpr uint32_t kJoinMagic = 0x4e4a5648u;
+
+// Coordinator-led membership rendezvous (see engine.h).  The first init
+// (and every non-elastic re-init) requires the full env world within
+// HOROVOD_RENDEZVOUS_TIMEOUT_SEC; an elastic re-init instead waits a
+// bounded HOROVOD_ELASTIC_GROW_TIMEOUT_SEC grace window for relaunched or
+// new candidates and then commits the survivors — contiguous ranks sorted
+// by persistent worker id, epoch + 1 — or rejects everyone with a clean
+// terminal error below HOROVOD_ELASTIC_MIN_SIZE.
+int Engine::CoordinatorRendezvous(const std::string& host, int port,
+                                  const std::string& my_host, int data_port,
+                                  std::vector<std::string>* peer_hosts,
+                                  std::vector<int>* peer_ports) {
+  std::string err;
+  const bool regrow = elastic_enabled_ && epoch_.load() > 0;
+  control_listener_ = Listen(host, port, world_size_ + 8, nullptr, &err);
+  if (!control_listener_.valid()) {
+    last_error_ = "coordinator listen on " + host + ":" +
+                  std::to_string(port) + ": " + err;
+    return 1;
+  }
+  // Tolerant accept loop: a restart can race a dying previous engine's
+  // listener — workers whose connect landed there retry against this one,
+  // so dead/garbled/duplicate connections are dropped (latest join per
+  // worker id wins — safe because a worker id's old-world and new-world
+  // incarnations act sequentially) rather than failing the init.  Accept
+  // and each frame read are bounded so a silent remnant cannot park the
+  // loop, and the whole wait has a deadline.
+  control_listener_.SetTimeouts(2);  // Accept honors SO_RCVTIMEO
+  struct JoinInfo {
+    std::string host;
+    int data_port = 0;
+    int32_t lr = 0, ls = 1;
+    Socket conn;
+  };
+  std::map<int, JoinInfo> joined;  // worker id → latest join (sorted)
+  const int64_t window_ms =
+      (regrow ? grow_timeout_sec_ : rendezvous_timeout_sec_) * 1000ll;
+  auto rdv_deadline = std::chrono::steady_clock::now() +
+                      std::chrono::milliseconds(window_ms);
+  while (static_cast<int>(joined.size()) < world_size_ - 1) {
+    if (std::chrono::steady_clock::now() > rdv_deadline) {
+      if (regrow) break;  // grace window over: commit whoever showed up
+      last_error_ = "rendezvous timed out: heard from " +
+                    std::to_string(joined.size()) + " of " +
+                    std::to_string(world_size_ - 1) +
+                    " workers — check the other ranks' logs";
+      return 1;
+    }
+    Socket conn = Accept(control_listener_, &err);
+    if (!conn.valid()) {
+      continue;  // accept timeout tick; re-check the deadline
+    }
+    conn.SetTimeouts(10);
+    std::vector<uint8_t> frame;
+    if (!conn.RecvFrame(&frame)) {
+      continue;  // peer gave up (retrying) or stale/silent remnant
+    }
+    Reader r(frame.data(), frame.size());
+    uint32_t magic = r.u32();
+    int32_t id = r.i32();
+    std::string peer_host = r.str();
+    int32_t peer_port = r.i32();
+    int32_t lr = r.i32(), ls = r.i32();
+    if (!r.ok() || magic != kJoinMagic || id < 1 || id >= world_size_) {
+      continue;  // not a join frame from this job
+    }
+    JoinInfo info;
+    info.host = std::move(peer_host);
+    info.data_port = peer_port;
+    info.lr = lr;
+    info.ls = ls;
+    info.conn = std::move(conn);
+    joined[id] = std::move(info);
+  }
+
+  // Membership commit: contiguous ranks over {coordinator} ∪ survivors,
+  // sorted by worker id (std::map iteration order).
+  const int new_size = static_cast<int>(joined.size()) + 1;
+  const int64_t new_epoch = epoch_.load() + 1;
+  if (regrow && new_size < min_size_) {
+    std::string msg =
+        "elastic membership: the world shrank to " + std::to_string(new_size) +
+        " worker(s), below HOROVOD_ELASTIC_MIN_SIZE=" +
+        std::to_string(min_size_) + " (no replacement joined within the " +
+        std::to_string(grow_timeout_sec_) +
+        "s HOROVOD_ELASTIC_GROW_TIMEOUT_SEC window); terminating cleanly";
+    Writer w;
+    w.u8(1);  // reject
+    w.str(msg);
+    for (auto& kv : joined) kv.second.conn.SendFrame(w.bytes());
+    last_error_ = msg;
+    std::fprintf(stderr, "horovod_tpu coordinator: %s\n", msg.c_str());
+    return 1;
+  }
+  peer_hosts->assign(new_size, "");
+  peer_ports->assign(new_size, 0);
+  std::vector<int32_t> peer_lr(new_size, 0), peer_ls(new_size, 1);
+  std::vector<int> member_ids(new_size, 0);
+  std::vector<Socket> conns(new_size);
+  (*peer_hosts)[0] = my_host;
+  (*peer_ports)[0] = data_port;
+  peer_lr[0] = local_rank_;
+  peer_ls[0] = local_size_;
+  int next_rank = 1;
+  for (auto& kv : joined) {
+    (*peer_hosts)[next_rank] = kv.second.host;
+    (*peer_ports)[next_rank] = kv.second.data_port;
+    peer_lr[next_rank] = kv.second.lr;
+    peer_ls[next_rank] = kv.second.ls;
+    member_ids[next_rank] = kv.first;
+    conns[next_rank] = std::move(kv.second.conn);
+    ++next_rank;
+  }
+  // Coordinator decides the two-level topology GLOBALLY (the reference's
+  // is_homogeneous check, operations.cc:1511-1525): every member must
+  // report the same local_size, block placement (local_rank == rank %
+  // local_size) under the NEW ranks, and the layout must span >1 node —
+  // a shrunken world that broke the block layout falls back to the flat
+  // ring automatically.
+  bool want_hier = EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0;
+  bool hier_ok = want_hier && local_size_ > 1 &&
+                 new_size % local_size_ == 0 && new_size > local_size_;
+  for (int i = 0; hier_ok && i < new_size; ++i) {
+    hier_ok = peer_ls[i] == local_size_ && peer_lr[i] == i % local_size_;
+  }
+  if (want_hier && !hier_ok) {
+    std::fprintf(stderr,
+                 "horovod_tpu: HOROVOD_HIERARCHICAL_ALLREDUCE ignored — "
+                 "needs a homogeneous block layout (equal local_size > 1 "
+                 "dividing size, local_rank == rank %% local_size on "
+                 "every rank); using the flat ring.\n");
+  }
+  hierarchical_ = hier_ok;
+  for (int r = 1; r < new_size; ++r) {
+    Writer w;
+    w.u8(0);  // ok
+    w.i64(new_epoch);
+    w.i32(r);  // assigned rank
+    w.i32(new_size);
+    w.u8(hierarchical_ ? 1 : 0);
+    for (int i = 0; i < new_size; ++i) {
+      w.str((*peer_hosts)[i]);
+      w.i32((*peer_ports)[i]);
+    }
+    if (!conns[r].SendFrame(w.bytes())) {
+      last_error_ = "rendezvous assign to worker id " +
+                    std::to_string(member_ids[r]) + " failed";
+      return 1;
+    }
+  }
+  worker_conns_.clear();
+  worker_conns_.resize(new_size);
+  for (int r = 1; r < new_size; ++r) worker_conns_[r] = std::move(conns[r]);
+  if (regrow || new_size != world_size_) {
+    std::string members;
+    for (int i = 0; i < new_size; ++i) {
+      if (!members.empty()) members += ",";
+      members += std::to_string(member_ids[i]);
+    }
+    std::fprintf(stderr,
+                 "horovod_tpu coordinator: committed membership epoch %lld: "
+                 "size %d (worker ids %s)\n",
+                 static_cast<long long>(new_epoch), new_size,
+                 members.c_str());
+  }
+  rank_ = 0;
+  size_ = new_size;
+  epoch_.store(new_epoch);
+  return 0;
+}
+
+int Engine::WorkerRendezvous(const std::string& host, int port,
+                             const std::string& my_host, int data_port,
+                             std::vector<std::string>* peer_hosts,
+                             std::vector<int>* peer_ports) {
+  std::string err;
+  // Retry the whole connect+exchange: after a restart, the first connect
+  // can land on the PREVIOUS engine's closing listener and die with EOF
+  // before the assignment arrives — the new listener is up moments later.
+  // A mid-run join candidate's first exchange dies the same way when the
+  // coordinator tears the running world down to admit it.
+  int64_t join_ms = static_cast<int64_t>(rendezvous_timeout_sec_) * 1000;
+  if (elastic_enabled_) {
+    join_ms += static_cast<int64_t>(grow_timeout_sec_) * 2000 + 30000;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(join_ms);
+  std::string lasterr = "rendezvous timed out";
+  while (std::chrono::steady_clock::now() < deadline) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                    deadline - std::chrono::steady_clock::now())
+                    .count();
+    coordinator_conn_ = ConnectRetry(host, port, static_cast<int>(left),
+                                     &err);
+    if (!coordinator_conn_.valid()) {
+      lasterr = err;
+      break;
+    }
+    // Bound the exchange: a connect that landed on a wedged previous
+    // listener must time out and retry, not block forever.
+    coordinator_conn_.SetTimeouts(10);
+    Writer w;
+    w.u32(kJoinMagic);
+    w.i32(worker_id_);
+    w.str(my_host);
+    w.i32(data_port);
+    w.i32(local_rank_);
+    w.i32(local_size_);
+    std::vector<uint8_t> frame;
+    // The assignment legitimately takes as long as the slowest member's
+    // arrival plus — elastic — the entire grow grace window the
+    // coordinator holds open for further candidates.
+    int idle_rounds = 11 + (elastic_enabled_ ? grow_timeout_sec_ / 10 + 2
+                                             : 0);
+    if (!coordinator_conn_.SendFrame(w.bytes()) ||
+        !coordinator_conn_.RecvFrame(&frame, idle_rounds)) {
+      lasterr = "rendezvous exchange failed";
+      coordinator_conn_.Close();
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      continue;
+    }
+    Reader r(frame.data(), frame.size());
+    uint8_t status = r.u8();
+    if (status != 0) {
+      // Terminal membership rejection (e.g. the surviving world is below
+      // HOROVOD_ELASTIC_MIN_SIZE): no retry will change the verdict.
+      std::string msg = r.str();
+      last_error_ = (r.ok() && !msg.empty())
+                        ? msg
+                        : "membership rejected by the coordinator";
+      std::fprintf(stderr, "horovod_tpu worker id %d: %s\n", worker_id_,
+                   last_error_.c_str());
+      return 1;
+    }
+    int64_t new_epoch = r.i64();
+    int32_t new_rank = r.i32();
+    int32_t new_size = r.i32();
+    uint8_t hier = r.u8();
+    if (!r.ok() || new_size < 1 || new_rank < 0 || new_rank >= new_size) {
+      lasterr = "bad membership assignment frame";
+      break;
+    }
+    peer_hosts->assign(new_size, "");
+    peer_ports->assign(new_size, 0);
+    for (int i = 0; i < new_size; ++i) {
+      (*peer_hosts)[i] = r.str();
+      (*peer_ports)[i] = r.i32();
+    }
+    if (!r.ok()) {
+      lasterr = "bad rendezvous table";
+      break;
+    }
+    hierarchical_ = hier != 0;
+    if (new_rank != worker_id_ || new_size != world_size_) {
+      std::fprintf(stderr,
+                   "horovod_tpu worker id %d: joined membership epoch %lld "
+                   "as rank %d of %d\n",
+                   worker_id_, static_cast<long long>(new_epoch), new_rank,
+                   new_size);
+    }
+    rank_ = new_rank;
+    size_ = new_size;
+    epoch_.store(new_epoch);
+    return 0;
+  }
+  last_error_ = lasterr;
+  return 1;
+}
+
+// Coordinator, elastic mode, once per cycle: a relaunched/new worker
+// connecting to the control listener mid-run is a join candidate.  Its
+// join triggers a collective abort so every member falls back into
+// run_elastic's recovery loop and the next rendezvous admits the
+// candidate under epoch+1 — the "rejoin without whole-job restart" half
+// of in-place elastic membership.
+bool Engine::PollJoinCandidate() {
+  if (!elastic_enabled_ || worker_id_ != 0 || !control_listener_.valid()) {
+    return false;
+  }
+  if (!HasPendingConnection(control_listener_)) return false;
+  std::string err;
+  Socket conn = Accept(control_listener_, &err);
+  if (!conn.valid()) return false;
+  // A genuine candidate sends its JOIN immediately after connecting; a
+  // silent stray (health probe, scanner) must not park the negotiation
+  // loop — bound the speculative read to a fraction of a cycle's budget
+  // and require the join magic before this connection may abort a
+  // running world.
+  if (!WaitReadable(conn, 250)) return false;
+  conn.SetTimeouts(1);
+  std::vector<uint8_t> frame;
+  if (!conn.RecvFrame(&frame)) return false;  // stray/garbled: drop it
+  Reader r(frame.data(), frame.size());
+  uint32_t magic = r.u32();
+  int32_t id = r.i32();
+  if (!r.ok() || magic != kJoinMagic || id < 1 || id >= world_size_) {
+    return false;
+  }
+  // The candidate's connection is dropped here; it retries its join and
+  // lands on the re-formed world's listener.
+  BroadcastAbort(
+      -1, "elastic re-rendezvous: worker id " + std::to_string(id) +
+              " is waiting to join (epoch " +
+              std::to_string(epoch_.load()) + ", size " +
+              std::to_string(size_) +
+              "); aborting in-flight collectives to re-form the world");
+  return true;
 }
 
 void Engine::Shutdown() {
@@ -761,6 +997,7 @@ void Engine::BroadcastAbort(int culprit, const std::string& message) {
   abort_reason_ = message;
   std::fprintf(stderr, "horovod_tpu coordinator: %s\n", message.c_str());
   ResponseList abort_list;
+  abort_list.epoch = epoch_.load();
   abort_list.abort = true;
   abort_list.abort_rank = culprit;
   abort_list.abort_message = message;
@@ -823,8 +1060,14 @@ bool Engine::RunLoopOnce() {
   }
   if (fault_hang_.load() || fault_drop_.load()) return true;  // next pass
 
+  // Elastic rejoin: a candidate knocking on the control listener aborts
+  // this world so the next rendezvous can admit it (checked before the
+  // size-1 fast path — a world shrunk to one must still grow back).
+  if (PollJoinCandidate()) return false;
+
   RequestList my_list;
   DrainMessageQueue(&my_list);
+  my_list.epoch = epoch_.load();
   my_list.shutdown = shutdown_requested_.load();
 
   if (size_ == 1) {
@@ -859,24 +1102,46 @@ bool Engine::RunLoopOnce() {
     // world size (a crashed worker still fails immediately via
     // EOF/keepalive).
     for (int r = 1; r < size_; ++r) {
-      std::vector<uint8_t> frame;
-      std::string who = "control frame from rank " + std::to_string(r);
-      if (!worker_conns_[r].RecvFrame(&frame, control_patience_rounds_,
-                                      who.c_str())) {
-        BroadcastAbort(
-            r, "coordinator lost connection to rank " + std::to_string(r) +
-                   " — that process crashed, hung, or dropped its "
-                   "connection; check its logs. Aborting all ranks.");
-        return false;
-      }
-      negotiation_bytes_rx_.fetch_add(
-          static_cast<int64_t>(frame.size()) + 8);
-      Reader reader(frame.data(), frame.size());
-      if (!ParseRequestList(&reader, &lists[r])) {
-        BroadcastAbort(
-            r, "coordinator received a corrupt control frame from rank " +
-                   std::to_string(r) + ". Aborting all ranks.");
-        return false;
+      // Epoch gate: a frame stamped with a different membership epoch is
+      // a delayed message from a dead incarnation of the world — drop it,
+      // count it, and read the next frame from the same rank.  Bounded so
+      // a peer stuck in the past cannot spin the coordinator forever.
+      for (int stale = 0;; ++stale) {
+        std::vector<uint8_t> frame;
+        std::string who = "control frame from rank " + std::to_string(r);
+        if (!worker_conns_[r].RecvFrame(&frame, control_patience_rounds_,
+                                        who.c_str())) {
+          BroadcastAbort(
+              r, "coordinator lost connection to rank " + std::to_string(r) +
+                     " — that process crashed, hung, or dropped its "
+                     "connection; check its logs. Aborting all ranks.");
+          return false;
+        }
+        negotiation_bytes_rx_.fetch_add(
+            static_cast<int64_t>(frame.size()) + 8);
+        Reader reader(frame.data(), frame.size());
+        if (!ParseRequestList(&reader, &lists[r])) {
+          BroadcastAbort(
+              r, "coordinator received a corrupt control frame from rank " +
+                     std::to_string(r) + ". Aborting all ranks.");
+          return false;
+        }
+        if (lists[r].epoch == epoch_.load()) break;
+        stale_epoch_msgs_.fetch_add(1);
+        std::fprintf(stderr,
+                     "horovod_tpu coordinator: dropped a stale control "
+                     "frame from rank %d (epoch %lld, current epoch "
+                     "%lld)\n",
+                     r, static_cast<long long>(lists[r].epoch),
+                     static_cast<long long>(epoch_.load()));
+        lists[r] = RequestList();  // discard the stale payload entirely
+        if (stale >= 15) {
+          BroadcastAbort(
+              r, "rank " + std::to_string(r) +
+                     " keeps sending control frames from a stale "
+                     "membership epoch. Aborting all ranks.");
+          return false;
+        }
       }
     }
     ResponseList response_list = CoordinatorStep(lists);
@@ -920,6 +1185,17 @@ bool Engine::RunLoopOnce() {
       "another rank failed; check rank 0's logs.";
   Writer w;
   SerializeRequestList(my_list, &w);
+  if (fault_stale_epoch_.exchange(false)) {
+    // Injected dead-incarnation replay (HOROVOD_FAULT_INJECT
+    // kind=stale-epoch): the same payload stamped with the PREVIOUS epoch
+    // precedes the real frame; the coordinator must drop and count it
+    // (stale_epoch_msgs) and negotiate from the genuine frame only.
+    RequestList ghost = my_list;
+    ghost.epoch = my_list.epoch - 1;
+    Writer gw;
+    SerializeRequestList(ghost, &gw);
+    coordinator_conn_.SendFrame(gw.bytes());
+  }
   negotiation_bytes_tx_.fetch_add(static_cast<int64_t>(w.bytes().size()) + 8);
   if (!coordinator_conn_.SendFrame(w.bytes())) {
     // The coordinator may have broadcast an abort (naming the culprit
@@ -939,22 +1215,43 @@ bool Engine::RunLoopOnce() {
                  abort_reason_.c_str());
     return false;
   }
-  std::vector<uint8_t> frame;
-  if (!coordinator_conn_.RecvFrame(&frame, worker_patience_rounds_,
-                                   "response frame from the coordinator "
-                                   "(rank 0)")) {
-    abort_reason_ = lost_coordinator;
-    std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
-                 abort_reason_.c_str());
-    return false;
-  }
-  negotiation_bytes_rx_.fetch_add(static_cast<int64_t>(frame.size()) + 8);
-  Reader reader(frame.data(), frame.size());
   ResponseList response_list;
-  if (!ParseResponseList(&reader, &response_list)) {
-    abort_reason_ = "corrupt control frame from the coordinator.";
-    std::fprintf(stderr, "horovod_tpu rank %d: bad response frame\n", rank_);
-    return false;
+  // Epoch gate, worker side: a response frame — including an abort
+  // verdict — stamped with a different membership epoch is a dead
+  // incarnation's delayed message; drop, count, read the next frame.
+  for (int stale = 0;; ++stale) {
+    std::vector<uint8_t> frame;
+    if (!coordinator_conn_.RecvFrame(&frame, worker_patience_rounds_,
+                                     "response frame from the coordinator "
+                                     "(rank 0)")) {
+      abort_reason_ = lost_coordinator;
+      std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
+                   abort_reason_.c_str());
+      return false;
+    }
+    negotiation_bytes_rx_.fetch_add(static_cast<int64_t>(frame.size()) + 8);
+    Reader reader(frame.data(), frame.size());
+    if (!ParseResponseList(&reader, &response_list)) {
+      abort_reason_ = "corrupt control frame from the coordinator.";
+      std::fprintf(stderr, "horovod_tpu rank %d: bad response frame\n",
+                   rank_);
+      return false;
+    }
+    if (response_list.epoch == epoch_.load()) break;
+    stale_epoch_msgs_.fetch_add(1);
+    std::fprintf(stderr,
+                 "horovod_tpu rank %d: dropped a stale response frame "
+                 "(epoch %lld, current epoch %lld)\n",
+                 rank_, static_cast<long long>(response_list.epoch),
+                 static_cast<long long>(epoch_.load()));
+    response_list = ResponseList();
+    if (stale >= 15) {
+      abort_reason_ = "the coordinator keeps sending control frames from "
+                      "a stale membership epoch.";
+      std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
+                   abort_reason_.c_str());
+      return false;
+    }
   }
   if (response_list.abort) {
     // Coordinator-initiated collective abort: another rank failed.
@@ -1161,6 +1458,7 @@ void Engine::CoordinatorEvictSlot(uint32_t slot, ResponseList* out) {
 ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
   AssertBackgroundThread();
   ResponseList out;
+  out.epoch = epoch_.load();
   // Cache evictions first — readiness bits and slot reassignments below
   // must see the slot freed, and bits arriving for a slot evicted in the
   // same cycle are dropped (their senders renegotiate on receipt of the
@@ -2121,6 +2419,16 @@ void Engine::MaybeInjectFault() {
                    "connections at enqueue %lld\n",
                    rank_, static_cast<long long>(idx));
       fault_drop_.store(true);
+      break;
+    case FaultKind::STALE_EPOCH:
+      // Worker-only (the coordinator sends no RequestList frames): the
+      // next control frame is preceded by a duplicate stamped epoch-1,
+      // exercising the receiver's structural stale-epoch rejection.
+      std::fprintf(stderr,
+                   "horovod_tpu rank %d: fault injection: sending a "
+                   "stale-epoch control frame at enqueue %lld\n",
+                   rank_, static_cast<long long>(idx));
+      fault_stale_epoch_.store(true);
       break;
     case FaultKind::NONE:
       break;

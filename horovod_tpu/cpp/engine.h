@@ -80,6 +80,12 @@ class Engine {
   int size() const { return size_; }
   int local_rank() const { return local_rank_; }
   int local_size() const { return local_size_; }
+  // Committed membership epoch: bumped by every successful rendezvous
+  // commit (first init and every re-init).  Workers adopt the
+  // coordinator's value, so all live members of a world agree on it and
+  // every control frame carries it (stale frames from a dead incarnation
+  // are structurally rejected — see stale_epoch_msgs).
+  int64_t epoch() const { return epoch_.load(); }
   const std::string& last_error() const { return last_error_; }
 
   // Enqueue a collective on caller-owned memory.  Returns a handle, or -1
@@ -123,6 +129,10 @@ class Engine {
   int64_t negotiation_bytes_tx() const { return negotiation_bytes_tx_.load(); }
   int64_t negotiation_bytes_rx() const { return negotiation_bytes_rx_.load(); }
   int64_t control_round_trips() const { return control_round_trips_.load(); }
+  // Control frames dropped because they were stamped with a different
+  // membership epoch than this rank's committed one (a delayed message
+  // from a dead incarnation after an elastic resize).
+  int64_t stale_epoch_msgs() const { return stale_epoch_msgs_.load(); }
 
   // Why the engine aborted ("" while healthy or after a clean shutdown).
   // Safe to call from any thread: the background thread publishes
@@ -143,6 +153,31 @@ class Engine {
   Engine() = default;
   void BackgroundLoop();
   bool RunLoopOnce();                        // returns false on shutdown
+  // Coordinator-led membership rendezvous (worker id 0).  First init
+  // requires the full world; an elastic re-init (HOROVOD_ELASTIC=1 and a
+  // previously committed epoch) waits a bounded grace window
+  // (HOROVOD_ELASTIC_GROW_TIMEOUT_SEC) for relaunched/new candidates,
+  // then commits whoever showed up — contiguous re-ranking sorted by
+  // persistent worker id, new size, epoch+1 — or fails with a clean
+  // terminal error when the survivor count is below
+  // HOROVOD_ELASTIC_MIN_SIZE.  Fills the committed peer tables for ring
+  // wiring; returns nonzero + last_error_ on failure.
+  int CoordinatorRendezvous(const std::string& host, int port,
+                            const std::string& my_host, int data_port,
+                            std::vector<std::string>* peer_hosts,
+                            std::vector<int>* peer_ports);
+  // Worker side: join (persistent worker id = the launch-time rank), wait
+  // for the ASSIGN frame, adopt (epoch, rank, size) and the peer table.
+  int WorkerRendezvous(const std::string& host, int port,
+                       const std::string& my_host, int data_port,
+                       std::vector<std::string>* peer_hosts,
+                       std::vector<int>* peer_ports);
+  // Coordinator, elastic mode, once per cycle: zero-timeout probe of the
+  // control listener for a join candidate (a relaunched or new worker).
+  // A valid join triggers a collective abort so every member re-enters
+  // rendezvous and the candidate is admitted under epoch+1; returns true
+  // when the cycle loop must exit for that re-rendezvous.
+  bool PollJoinCandidate();
   // Pop the message queue into `my_list`, classifying each request
   // against the local cache replica: known signature → hit bit, changed
   // signature → evict + full request, unknown → full request.  Also
@@ -233,6 +268,25 @@ class Engine {
   // defaults.
   int fault_timeout_sec_ = 0;
 
+  // -- elastic membership (HOROVOD_ELASTIC=1) --
+  // Persistent launch identity: the rank passed to Init (stable across
+  // re-inits and supervisor relaunches) is the worker id; committed ranks
+  // are assigned per-epoch by the coordinator, contiguous over survivors.
+  int worker_id_ = 0;
+  // The job's launch-time world size (the env identity); an elastic
+  // commit may set size_ below it (shrink) or back up to it (rejoin).
+  int world_size_ = 1;
+  bool elastic_enabled_ = false;
+  int min_size_ = 1;               // HOROVOD_ELASTIC_MIN_SIZE
+  int grow_timeout_sec_ = 30;      // HOROVOD_ELASTIC_GROW_TIMEOUT_SEC
+  // First-rendezvous deadline (coordinator full-house wait and a worker's
+  // whole join+assign exchange), HOROVOD_RENDEZVOUS_TIMEOUT_SEC.
+  int rendezvous_timeout_sec_ = 120;
+  // Committed membership epoch; survives re-Init (a process keeps its
+  // history across engine incarnations) but NOT process relaunch — a
+  // fresh replacement adopts the coordinator's epoch at join.
+  std::atomic<int64_t> epoch_{0};
+
   // -- deterministic fault injection (HOROVOD_FAULT_INJECT=rank:step:kind;
   //    kinds: exit | hang | drop-conn).  Armed at Init when rank matches;
   //    fires on the `step`-th Enqueue on this rank (0-based, counting every
@@ -240,7 +294,10 @@ class Engine {
   //    the background loop (control frames stop, the process stays alive);
   //    `drop-conn` makes the background loop close every connection and
   //    abort locally without any shutdown handshake. --
-  enum class FaultKind { NONE, EXIT, HANG, DROP_CONN };
+  // stale-epoch: the worker prefixes its next control frame with a
+  // duplicate stamped epoch-1 (a dead incarnation's delayed message) so
+  // tests can assert the coordinator's structural rejection path.
+  enum class FaultKind { NONE, EXIT, HANG, DROP_CONN, STALE_EPOCH };
   FaultKind fault_kind_ = FaultKind::NONE;
   int64_t fault_step_ = -1;
   // Survives re-Init: an injected fault fires once per process, so an
@@ -250,6 +307,7 @@ class Engine {
   std::atomic<int64_t> enqueue_count_{0};
   std::atomic<bool> fault_hang_{false};
   std::atomic<bool> fault_drop_{false};
+  std::atomic<bool> fault_stale_epoch_{false};
   void MaybeInjectFault();
 
   // Why the background loop aborted (set by the background thread before
@@ -369,6 +427,7 @@ class Engine {
   std::atomic<int64_t> negotiation_bytes_tx_{0};
   std::atomic<int64_t> negotiation_bytes_rx_{0};
   std::atomic<int64_t> control_round_trips_{0};
+  std::atomic<int64_t> stale_epoch_msgs_{0};
 
   // -- timeline --
   Timeline timeline_;

@@ -70,6 +70,7 @@ static bool ParseSlotList(Reader* r, std::vector<uint32_t>* slots) {
 }
 
 void SerializeRequestList(const RequestList& list, Writer* w) {
+  w->i64(list.epoch);
   w->u8(list.shutdown ? 1 : 0);
   w->u32(static_cast<uint32_t>(list.requests.size()));
   for (const auto& q : list.requests) SerializeRequest(q, w);
@@ -78,6 +79,7 @@ void SerializeRequestList(const RequestList& list, Writer* w) {
 }
 
 bool ParseRequestList(Reader* r, RequestList* out) {
+  out->epoch = r->i64();
   out->shutdown = r->u8() != 0;
   uint32_t n = r->u32();
   out->requests.resize(n);
@@ -123,6 +125,7 @@ static bool ParseResponse(Reader* r, Response* s) {
 }
 
 void SerializeResponseList(const ResponseList& list, Writer* w) {
+  w->i64(list.epoch);
   w->u8(list.shutdown ? 1 : 0);
   w->u8(list.abort ? 1 : 0);
   w->i32(list.abort_rank);
@@ -134,6 +137,7 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
 }
 
 bool ParseResponseList(Reader* r, ResponseList* out) {
+  out->epoch = r->i64();
   out->shutdown = r->u8() != 0;
   out->abort = r->u8() != 0;
   out->abort_rank = r->i32();

@@ -91,6 +91,13 @@ struct Request {
 };
 
 struct RequestList {
+  // Membership epoch this frame belongs to (elastic in-place resize).
+  // Every control message is stamped with the sender's committed epoch;
+  // a receiver on epoch E structurally rejects frames stamped != E, so a
+  // delayed message from a dead incarnation of the world can never poison
+  // the resized world's negotiation state (or replay a stale cache slot —
+  // the PR 2 response cache is thereby keyed per-epoch).
+  int64_t epoch = 0;
   std::vector<Request> requests;
   bool shutdown = false;    // shutdown piggybacks on the control stream
   // Response-cache control (upstream Horovod 0.21's bitvector idea): a
@@ -123,6 +130,9 @@ struct Response {
 };
 
 struct ResponseList {
+  // Membership epoch (see RequestList::epoch).  Workers drop response
+  // frames — including abort verdicts — stamped with a different epoch.
+  int64_t epoch = 0;
   std::vector<Response> responses;
   bool shutdown = false;
   // Fault-tolerance abort broadcast: when the coordinator loses a rank

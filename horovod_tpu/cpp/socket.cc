@@ -138,6 +138,29 @@ static void SetNoDelay(int fd) {
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
+namespace {
+
+// Scoped O_NONBLOCK toggle: SendRecvAll multiplexes with poll and must not
+// block inside send/recv, and Accept must not block inside accept(2) when
+// the pending connection vanishes between poll and accept; the blocking
+// mode is restored on exit so the frame-based control plane keeps its
+// simple blocking reads.
+class NonblockGuard {
+ public:
+  explicit NonblockGuard(int fd) : fd_(fd), flags_(::fcntl(fd, F_GETFL, 0)) {
+    if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_ | O_NONBLOCK);
+  }
+  ~NonblockGuard() {
+    if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_);
+  }
+
+ private:
+  int fd_;
+  int flags_;
+};
+
+}  // namespace
+
 Socket Listen(const std::string& host, int port, int backlog,
               int* bound_port, std::string* error) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
@@ -180,14 +203,63 @@ Socket Listen(const std::string& host, int port, int backlog,
   return Socket(fd);
 }
 
+const char* const kAcceptTimedOut =
+    "accept: timed out waiting for an incoming connection";
+
 Socket Accept(Socket& listener, std::string* error) {
-  int fd = ::accept(listener.fd(), nullptr, nullptr);
-  if (fd < 0) {
+  // Enforce the listener's SetTimeouts bound with poll(2), NOT the
+  // kernel's SO_RCVTIMEO-on-accept behavior: sandboxed/older kernels
+  // (e.g. gVisor) silently ignore the latter, which turned every
+  // "bounded" rendezvous accept into an unbounded block — the exact
+  // half-open-connect wedge this timeout exists to prevent.
+  timeval tv{};
+  socklen_t tvlen = sizeof(tv);
+  int timeout_ms = -1;  // no timeout configured: block indefinitely
+  if (::getsockopt(listener.fd(), SOL_SOCKET, SO_RCVTIMEO, &tv, &tvlen) == 0
+      && (tv.tv_sec > 0 || tv.tv_usec > 0)) {
+    timeout_ms = static_cast<int>(tv.tv_sec * 1000 + tv.tv_usec / 1000);
+  }
+  // The accept itself runs nonblocking: a connection that poll reported
+  // can be reset before accept(2) picks it up (the classic poll/accept
+  // race, accept(2) BUGS), and a blocking accept would then wait for the
+  // NEXT connection — unbounded, on kernels that ignore SO_RCVTIMEO.
+  NonblockGuard nb(listener.fd());
+  while (true) {
+    pollfd pfd{listener.fd(), POLLIN, 0};
+    int rc = ::poll(&pfd, 1, timeout_ms);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      *error = std::string("accept poll: ") + strerror(errno);
+      return Socket();
+    }
+    if (rc == 0) {
+      // Deadline tick, not a failure — surface it distinctly so
+      // rendezvous loops re-check their own deadline instead of
+      // mistaking the expiry for a broken listener.
+      *error = kAcceptTimedOut;
+      return Socket();
+    }
+    int fd = ::accept(listener.fd(), nullptr, nullptr);
+    if (fd >= 0) {
+      SetNoDelay(fd);
+      return Socket(fd);
+    }
+    if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) {
+      continue;  // the pending connection vanished (reset before accept)
+    }
     *error = std::string("accept: ") + strerror(errno);
     return Socket();
   }
-  SetNoDelay(fd);
-  return Socket(fd);
+}
+
+bool WaitReadable(Socket& s, int timeout_ms) {
+  if (!s.valid()) return false;
+  pollfd pfd{s.fd(), POLLIN, 0};
+  return ::poll(&pfd, 1, timeout_ms) > 0 && (pfd.revents & POLLIN) != 0;
+}
+
+bool HasPendingConnection(Socket& listener) {
+  return WaitReadable(listener, 0);
 }
 
 Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
@@ -225,27 +297,6 @@ Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
            " (" + last_err + ")";
   return Socket();
 }
-
-namespace {
-
-// Scoped O_NONBLOCK toggle: SendRecvAll multiplexes with poll and must not
-// block inside send/recv; the blocking mode is restored on exit so the
-// frame-based control plane keeps its simple blocking reads.
-class NonblockGuard {
- public:
-  explicit NonblockGuard(int fd) : fd_(fd), flags_(::fcntl(fd, F_GETFL, 0)) {
-    if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_ | O_NONBLOCK);
-  }
-  ~NonblockGuard() {
-    if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_);
-  }
-
- private:
-  int fd_;
-  int flags_;
-};
-
-}  // namespace
 
 bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
                  Socket& rcv, void* recv_buf, size_t rn,

@@ -75,8 +75,26 @@ bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
 // fills *bound_port.
 Socket Listen(const std::string& host, int port, int backlog,
               int* bound_port, std::string* error);
-// Accept one connection (blocking).
+// Accept one connection.  Honors the listener's SetTimeouts bound
+// (SO_RCVTIMEO applies to accept(2) on Linux): with a timeout set, an
+// accept that sees no completed connection within the bound returns an
+// invalid Socket with *error == kAcceptTimedOut — callers loop against
+// their own deadline instead of wedging forever on a listener that a
+// half-open or never-arriving connect left silent.
 Socket Accept(Socket& listener, std::string* error);
+
+// The distinguished Accept timeout error (deadline expiry, not a failure).
+extern const char* const kAcceptTimedOut;
+
+// True when the listener has a completed connection ready to accept RIGHT
+// NOW (poll with zero timeout) — the coordinator's per-cycle probe for
+// elastic mid-run join candidates; never blocks.
+bool HasPendingConnection(Socket& listener);
+
+// True when `s` becomes readable within timeout_ms (0 = only if readable
+// right now).  Bounds a speculative read on a connection that may never
+// send anything — e.g. a port scanner hitting the coordinator's listener.
+bool WaitReadable(Socket& s, int timeout_ms);
 // Connect with retry until deadline_ms elapses (peer may not be up yet).
 Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
                     std::string* error);

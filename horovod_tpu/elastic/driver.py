@@ -15,6 +15,7 @@ survivors' rollback point instead of step 0.
 from __future__ import annotations
 
 import os
+import random
 import sys
 import time
 from typing import Callable, Optional
@@ -36,6 +37,20 @@ def _env_num(name: str, default, cast):
     return cast(value)
 
 
+def _jittered(delay: float, attempt: int) -> float:
+    """±25% seeded jitter on a backoff delay.
+
+    A deterministic exponential backoff makes every survivor sleep the
+    IDENTICAL delay after a collective failure, so the whole world
+    reconnects to the coordinator in the same instant (thundering-herd
+    rendezvous).  The jitter is seeded from (persistent worker id,
+    attempt): decorrelated across ranks, yet reproducible per process so
+    failures replay identically under test.
+    """
+    rng = random.Random(f"{os.environ.get('HOROVOD_RANK', '0')}:{attempt}")
+    return delay * (0.75 + 0.5 * rng.random())
+
+
 def run_elastic(train_fn: Callable[[ElasticState], object],
                 state: ElasticState, *,
                 max_retries: Optional[int] = None,
@@ -52,7 +67,8 @@ def run_elastic(train_fn: Callable[[ElasticState], object],
     commit landed since the previous failure, so a long run survives many
     spaced-out failures while a crash loop still terminates.  Backoff
     starts at ``backoff_sec`` (default ``HOROVOD_ELASTIC_BACKOFF_SEC``,
-    1.0) and doubles per consecutive failure, capped at 30 s.
+    1.0) and doubles per consecutive failure, capped at 30 s, with ±25%
+    seeded jitter so survivors don't hammer the coordinator in lockstep.
     """
     if max_retries is None:
         max_retries = _env_num("HOROVOD_ELASTIC_MAX_RETRIES", 3, int)
@@ -65,6 +81,16 @@ def run_elastic(train_fn: Callable[[ElasticState], object],
         try:
             if not basics.is_initialized():
                 basics.init()
+                if retries > 0:
+                    # Under elastic membership (HOROVOD_ELASTIC=1) the
+                    # re-init may have committed a RESIZED world — shrunk
+                    # to the survivors, or re-grown by a rejoined
+                    # replacement — so train_fn must re-read rank/size.
+                    print(
+                        "horovod_tpu elastic: re-entered the world at "
+                        f"epoch={basics.epoch()} rank={basics.rank()} "
+                        f"size={basics.size()}",
+                        file=sys.stderr, flush=True)
             state.sync()
             commits_at_entry = state.commit_count
             return train_fn(state)
@@ -79,8 +105,9 @@ def run_elastic(train_fn: Callable[[ElasticState], object],
                     f"{max_retries} consecutive retries: {e}",
                     file=sys.stderr, flush=True)
                 raise
-            delay = min(backoff_sec * (2 ** (retries - 1)),
-                        _BACKOFF_CAP_SEC)
+            delay = _jittered(
+                min(backoff_sec * (2 ** (retries - 1)), _BACKOFF_CAP_SEC),
+                retries)
             print(
                 f"horovod_tpu elastic: collective failure ({e}); "
                 f"rolling back to the last commit and retrying in "

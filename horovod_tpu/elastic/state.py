@@ -74,6 +74,13 @@ class ElasticState:
     The constructor takes the initial snapshot, so ``restore()`` is always
     well-defined.  Slots are plain attributes between calls; only the
     names given at construction are tracked.
+
+    Under elastic membership the world ``sync()`` runs in may differ from
+    the previous entry's (shrunk to survivors, or re-grown by a rejoined
+    replacement); ``last_sync_size`` / ``last_sync_epoch`` record the
+    world each sync committed into, so a training loop can detect an
+    in-place resize and re-derive anything size-dependent (per-rank
+    shards, loss scaling, data partitions).
     """
 
     def __init__(self, **slots):
@@ -84,6 +91,10 @@ class ElasticState:
             setattr(self, k, v)
         self._commit_count = 0
         self._snapshot: dict = {}
+        #: World identity of the most recent sync() (None before the
+        #: first): the membership a resumed step loop is running under.
+        self.last_sync_size: int | None = None
+        self.last_sync_epoch: int | None = None
         self.commit()
 
     @property
@@ -111,8 +122,14 @@ class ElasticState:
         Collective: all ranks must call it at the same point.  After a
         failure, survivors ``restore()`` then ``sync()`` while a
         relaunched worker syncs its fresh state — everyone leaves with
-        rank 0's committed values (including step counters).
+        rank 0's committed values (including step counters).  Because the
+        broadcast spans whatever world the current membership epoch
+        committed, this is also what redistributes state across an
+        in-place RESIZE: the shrunken (or re-grown) world leaves sync
+        with identical state regardless of which ranks survived.
         """
+        from horovod_tpu.common.basics import basics
+
         eng = engine_or_none()
         if eng is not None:
             # Enqueue EVERY leaf broadcast before synchronizing any (the
@@ -161,4 +178,6 @@ class ElasticState:
 
             for k in self._keys:
                 setattr(self, k, _walk(getattr(self, k), k, adopt))
+        self.last_sync_size = basics.size() if basics.is_initialized() else 1
+        self.last_sync_epoch = basics.epoch()
         self.commit()

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec
 from horovod_tpu.common.jax_compat import shard_map
 
 from horovod_tpu.common import (
+    epoch,
     init,
     is_initialized,
     local_rank,
@@ -67,7 +68,7 @@ from horovod_tpu.parallel.mesh import (
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
-    "local_size", "mpi_threads_supported",
+    "local_size", "epoch", "mpi_threads_supported",
     "num_chips", "local_devices",
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "reducescatter", "alltoall",

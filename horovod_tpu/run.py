@@ -18,6 +18,16 @@ on :func:`horovod_tpu.elastic.run_elastic`, whose surviving ranks roll
 back to their last commit and re-rendezvous with the replacement.  A
 relaunched worker's env is scrubbed of ``HOROVOD_FAULT_INJECT`` so an
 injected fault fires once, not on every incarnation.
+
+Elastic membership: ``--elastic`` additionally sets ``HOROVOD_ELASTIC=1``
+so the engine may re-form the world IN PLACE around the survivors — the
+env rank becomes a persistent worker id (a join candidacy, not the final
+rank), and the coordinator commits contiguous re-ranked membership
+epochs.  Under ``--elastic`` a worker that dies with no restart budget
+left is ABANDONED (the survivors shrink and keep training) instead of
+terminating the job; a relaunched worker joins the RUNNING world as a
+candidate and the world grows back.  The job fails only when worker id 0
+(the coordinator/authority) fails or no worker exits cleanly.
 """
 
 from __future__ import annotations
@@ -64,6 +74,18 @@ def main(argv=None) -> int:
                              "exits non-zero (same rank/env), up to N "
                              "relaunches total, instead of terminating "
                              "the job (pair with horovod_tpu.elastic)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="in-place elastic membership: set "
+                             "HOROVOD_ELASTIC=1 for every worker, abandon "
+                             "a dead worker once the restart budget is "
+                             "spent (survivors shrink and continue), and "
+                             "let relaunched workers rejoin the running "
+                             "world as candidates")
+    parser.add_argument("--relaunch-delay-sec", type=float, default=0.0,
+                        metavar="SEC",
+                        help="supervisor mode: wait SEC before relaunching "
+                             "a dead worker (forces an elastic shrink "
+                             "before the rejoin; mainly for tests)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run (prefix with --)")
     args = parser.parse_args(argv)
@@ -90,6 +112,11 @@ def main(argv=None) -> int:
             "HOROVOD_LOCAL_SIZE": str(pph),
             "HOROVOD_COORDINATOR": coordinator,
         })
+        if args.elastic:
+            # The env rank is a persistent worker id / join candidacy under
+            # elastic membership; the engine's coordinator commits the
+            # actual (epoch, rank, size) at rendezvous.
+            env["HOROVOD_ELASTIC"] = "1"
         if scrub_fault_inject:
             # A relaunched incarnation must not re-fire the injected
             # fault at the same step, or the job would never converge.
@@ -107,11 +134,29 @@ def main(argv=None) -> int:
         spawn(local_rank) for local_rank in range(args.num_proc)
     ]
     restarts_left = max(0, args.restart_on_failure)
+    pending_respawn: dict[int, float] = {}  # local index → respawn due time
+    exit_codes: dict[int, int] = {}         # local index → last exit code
+
+    import time
 
     rc = 0
     try:
         remaining = set(range(len(procs)))
-        while remaining:
+        while remaining or pending_respawn:
+            if not remaining and pending_respawn:
+                # Everyone else already finished: there is no running world
+                # for a delayed replacement to rejoin — don't spawn it into
+                # a doomed rendezvous.
+                sys.stderr.write(
+                    "job finished before the delayed relaunch; "
+                    "cancelling it\n")
+                sys.stderr.flush()
+                break
+            now = time.time()
+            for i in [i for i, due in pending_respawn.items() if due <= now]:
+                del pending_respawn[i]
+                procs[i] = spawn(i, scrub_fault_inject=True)
+                remaining.add(i)
             for i in list(remaining):
                 code = procs[i].poll()
                 if code is None:
@@ -119,30 +164,54 @@ def main(argv=None) -> int:
                 # Report the global rank, matching the stream prefixes
                 # (local index i != rank when --host-index > 0).
                 rank = args.host_index * pph + i
+                exit_codes[i] = code
                 if code != 0 and restarts_left > 0:
                     restarts_left -= 1
                     sys.stderr.write(
                         f"rank {rank} exited with code {code}; "
                         f"relaunching ({restarts_left} restarts left)\n")
                     sys.stderr.flush()
-                    procs[i] = spawn(i, scrub_fault_inject=True)
+                    if args.relaunch_delay_sec > 0:
+                        remaining.discard(i)
+                        pending_respawn[i] = now + args.relaunch_delay_sec
+                    else:
+                        procs[i] = spawn(i, scrub_fault_inject=True)
                     continue
                 remaining.discard(i)
-                if code != 0 and rc == 0:
-                    rc = code
-                    sys.stderr.write(
-                        f"rank {rank} exited with "
-                        f"code {code}; terminating remaining ranks\n")
-                    for j in remaining:
-                        procs[j].terminate()
-            if remaining:
-                import time
-
+                if code != 0:
+                    # Compare the GLOBAL rank, not the local index: on a
+                    # --host-index > 0 supervisor no local worker is the
+                    # coordinator, and all of them are abandonable.
+                    if args.elastic and rank != 0:
+                        # In-place shrink: abandon the dead worker; the
+                        # surviving ranks re-form the world without it
+                        # (worker id 0 is the coordinator/authority — its
+                        # death still terminates the job below).
+                        sys.stderr.write(
+                            f"rank {rank} exited with code {code}; "
+                            "abandoning it (elastic shrink — survivors "
+                            "continue)\n")
+                        sys.stderr.flush()
+                        continue
+                    if rc == 0:
+                        rc = code
+                        sys.stderr.write(
+                            f"rank {rank} exited with "
+                            f"code {code}; terminating remaining ranks\n")
+                        for j in remaining:
+                            procs[j].terminate()
+            if remaining or pending_respawn:
                 time.sleep(0.1)
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGINT)
         rc = 130
+    if (args.elastic and rc == 0 and exit_codes
+            and all(c != 0 for c in exit_codes.values())):
+        # Elastic abandons individual failures, but a job where NO worker
+        # exited cleanly still failed (e.g. the world shrank below
+        # HOROVOD_ELASTIC_MIN_SIZE and every survivor terminated).
+        rc = next(c for c in exit_codes.values() if c != 0)
     for t in threads:
         t.join(timeout=5)
     return rc

@@ -136,7 +136,9 @@ class NativeEngine:
                         "horovod_cache_evictions",
                         "horovod_negotiation_bytes_tx",
                         "horovod_negotiation_bytes_rx",
-                        "horovod_control_round_trips"):
+                        "horovod_control_round_trips",
+                        "horovod_stale_epoch_msgs",
+                        "horovod_epoch"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
                 fn.restype = ctypes.c_int64
@@ -185,6 +187,16 @@ class NativeEngine:
         buf = ctypes.create_string_buffer(4096)
         self._lib.horovod_abort_reason(buf, len(buf))
         return buf.value.decode(errors="replace")
+
+    def epoch(self) -> int:
+        """The committed membership epoch: bumped by every successful
+        rendezvous commit, so an elastic resize (shrink to survivors or a
+        worker rejoin) increments it on every live member.  0 until the
+        first init (or against a stale prebuilt .so)."""
+        fn = getattr(self._lib, "horovod_epoch", None)
+        if getattr(fn, "restype", None) is not ctypes.c_int64:
+            return 0
+        return int(fn())
 
     def _not_running_error(self) -> HorovodInternalError:
         reason = self.abort_reason()
@@ -291,7 +303,9 @@ class NativeEngine:
         negotiation payload (idle heartbeats excluded) — divide its delta
         by the step count to verify steady state runs at ~1 round trip
         per step."""
-        if getattr(getattr(self._lib, "horovod_control_round_trips", None),
+        # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
+        # the rebuild hint instead of an AttributeError mid-dict.
+        if getattr(getattr(self._lib, "horovod_stale_epoch_msgs", None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
                 "libhorovod_core.so predates the execution/control-plane "
@@ -309,6 +323,8 @@ class NativeEngine:
                 self._lib.horovod_negotiation_bytes_rx(),
             "control_round_trips":
                 self._lib.horovod_control_round_trips(),
+            "stale_epoch_msgs":
+                self._lib.horovod_stale_epoch_msgs(),
         }
 
     # -- handle API --

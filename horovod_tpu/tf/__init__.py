@@ -24,13 +24,13 @@ import tensorflow as tf
 
 from horovod_tpu.tf.compression import Compression
 from horovod_tpu.tf.mpi_ops import (
-    init, shutdown, size, rank, local_size, local_rank,
+    init, shutdown, size, rank, local_size, local_rank, epoch,
     _allreduce, _grouped_allreduce, _auto_name, allgather, broadcast,
     _normalize_name,
 )
 
 __all__ = [
-    "init", "shutdown", "size", "rank", "local_size", "local_rank",
+    "init", "shutdown", "size", "rank", "local_size", "local_rank", "epoch",
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "broadcast_variables", "broadcast_global_variables",
     "BroadcastGlobalVariablesHook", "DistributedOptimizer",
@@ -46,6 +46,7 @@ def _avg(summed, dtype):
     return summed // n
 
 
+@tf.autograph.experimental.do_not_convert
 def allreduce(tensor, average: bool = True, device_dense: str = "",
               device_sparse: str = "", compression=Compression.none,
               name: Optional[str] = None):
@@ -72,6 +73,7 @@ def allreduce(tensor, average: bool = True, device_dense: str = "",
     return _avg(summed, tensor.dtype) if average else summed
 
 
+@tf.autograph.experimental.do_not_convert
 def grouped_allreduce(tensors, average: bool = True,
                       compression=Compression.none,
                       name: Optional[str] = None, names=None):

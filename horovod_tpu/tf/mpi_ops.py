@@ -43,7 +43,7 @@ from horovod_tpu.runtime import engine_or_none as _engine
 
 __all__ = [
     "init", "shutdown", "size", "rank", "local_size", "local_rank",
-    "_allreduce", "_grouped_allreduce", "allgather", "broadcast",
+    "epoch", "_allreduce", "_grouped_allreduce", "allgather", "broadcast",
 ]
 
 init = basics.init
@@ -52,6 +52,7 @@ rank = basics.rank
 size = basics.size
 local_rank = basics.local_rank
 local_size = basics.local_size
+epoch = basics.epoch
 
 
 def _normalize_name(name: str) -> str:
@@ -80,6 +81,13 @@ def _np(t: tf.Tensor) -> np.ndarray:
     return t.numpy().copy()
 
 
+# The collective builders below (and their tf/__init__ wrappers) carry
+# @do_not_convert: they stage py_function/custom_gradient ops with no
+# tensor-dependent Python control flow, so autograph conversion buys
+# nothing — and its converted-call cache can MISRESOLVE a callee under a
+# long test session (observed: `_allreduce(x, name=...)` dispatching to
+# the converted `_np`), breaking tf.function-traced training loops.
+@tf.autograph.experimental.do_not_convert
 def _allreduce(tensor, name: Optional[str] = None):
     """Sum ``tensor`` over all processes (reference mpi_ops.py:77-90).
 
@@ -108,6 +116,7 @@ def _allreduce(tensor, name: Optional[str] = None):
     return fn(tf.convert_to_tensor(tensor))
 
 
+@tf.autograph.experimental.do_not_convert
 def _grouped_allreduce(tensors, names):
     """Sum-allreduce a batch of tensors through ONE ``py_function``.
 
@@ -155,6 +164,7 @@ def _grouped_allreduce(tensors, names):
     return fn(*[tf.convert_to_tensor(t) for t in tensors])
 
 
+@tf.autograph.experimental.do_not_convert
 def allgather(tensor, name: Optional[str] = None):
     """Concatenate each rank's tensor along dim 0 (reference
     mpi_ops.py:107-123).  Per-rank dim 0 may differ — it is negotiated at
@@ -209,6 +219,7 @@ def allgather(tensor, name: Optional[str] = None):
     return fn(tf.convert_to_tensor(tensor))
 
 
+@tf.autograph.experimental.do_not_convert
 def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     """Broadcast root's value to every rank (reference mpi_ops.py:150-164).
 

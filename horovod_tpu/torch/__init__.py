@@ -52,11 +52,12 @@ from horovod_tpu.torch.mpi_ops import (
 )
 
 is_initialized = basics.is_initialized
+epoch = basics.epoch
 mpi_threads_supported = basics.mpi_threads_supported
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
-    "local_size", "mpi_threads_supported",
+    "local_size", "epoch", "mpi_threads_supported",
     "allreduce", "allreduce_async", "allreduce_", "allreduce_async_",
     "grouped_allreduce", "grouped_allreduce_async",
     "allgather", "allgather_async",

@@ -1,0 +1,127 @@
+"""Elastic-membership worker: SGD under in-place resizes.
+
+Launched by tests/test_fault_tolerance.py via the supervised launcher in
+elastic mode (``python -m horovod_tpu.run --elastic ...``).  Unlike
+tests/elastic_worker.py (fixed world: every recovery re-enters at the
+original size), this worker's world may RESIZE mid-run — shrink to the
+survivors when a dead rank is never replaced, or grow back when a
+relaunched candidate rejoins under a new membership epoch — so the
+closed form for the final weights depends on the membership history.
+
+The worker therefore carries a shadow reference ``ref`` INSIDE the
+elastic state: each step it applies the analytic mean-gradient update
+for the CURRENT world size alongside the engine-averaged update.  Both
+live in the same ``ElasticState``, so rollback and sync keep them in
+lockstep, and the shadow after a shrink is by construction exactly "a
+size-2 run resumed from the same commit".  At the end the engine result
+must match the shadow to float-roundoff — any smear of pre-resize state,
+wrong re-ranking, or stale-epoch replay breaks the equality.
+
+Per-step wall time is tunable (HOROVOD_TEST_STEP_SEC) so tests can park
+the run long enough for a delayed replacement to rejoin mid-training.
+
+Deliberately jax-free (numpy + the native engine), like elastic_worker.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.elastic import ElasticState, run_elastic  # noqa: E402
+from horovod_tpu.runtime import engine_or_none  # noqa: E402
+
+TOTAL_STEPS = int(os.environ.get("HOROVOD_TEST_TOTAL_STEPS", "30"))
+STEP_SEC = float(os.environ.get("HOROVOD_TEST_STEP_SEC", "0"))
+LR = 0.05
+DIM = 8
+
+
+def rank_target(rank: int) -> np.ndarray:
+    return np.linspace(rank + 1.0, rank + 2.0, DIM)
+
+
+def mean_target(size: int) -> np.ndarray:
+    # Same sum-then-divide form the engine's average=True uses, so the
+    # shadow tracks the collective to float roundoff.
+    total = np.zeros(DIM)
+    for r in range(size):
+        total += rank_target(r)
+    return total / size
+
+
+# Worlds this PROCESS trained in (informational; not elastic state — its
+# per-rank length would break sync()'s cross-rank leaf rendezvous for a
+# freshly relaunched worker).
+seen_sizes: set = set()
+
+
+def train(state: ElasticState):
+    eng = engine_or_none()  # re-evaluated every (re-)entry: None at size 1
+    while state.step < TOTAL_STEPS:
+        size = basics.size()
+        if size != state.last_sync_size:
+            raise AssertionError(
+                f"membership changed outside sync: {size} vs "
+                f"{state.last_sync_size}")
+        grad = 2.0 * (state.w - rank_target(basics.rank()))
+        if eng is not None:
+            # Deliberately UNNAMED (exercises the auto-name counter reset
+            # across re-inits, like elastic_worker).
+            grad = eng.allreduce(grad, average=True)
+        state.w = state.w - LR * grad
+        # Shadow: the analytic mean gradient over the CURRENT world —
+        # after a shrink this IS a smaller-world run resumed from the
+        # same commit.
+        state.ref = state.ref - LR * 2.0 * (state.ref - mean_target(size))
+        state.step += 1
+        seen_sizes.add(size)
+        state.commit()
+        if STEP_SEC > 0:
+            time.sleep(STEP_SEC)
+
+
+def main():
+    state = ElasticState(w=np.zeros(DIM, dtype=np.float64),
+                         ref=np.zeros(DIM, dtype=np.float64),
+                         step=0)
+    run_elastic(train, state)
+
+    # The engine-averaged weights must equal the shadow's analytic
+    # membership-history replay to roundoff.
+    assert np.allclose(state.w, state.ref, rtol=0, atol=1e-8), (
+        state.w, state.ref)
+
+    size, epoch = basics.size(), basics.epoch()
+    eng = engine_or_none()
+    if eng is not None:
+        # The PR 2 control-plane gate must hold AFTER a resize too: a
+        # steady-state identical-tensor loop in the committed world runs
+        # at <= 1.5 negotiation round trips per step (first step is the
+        # post-resize cache miss; the rest ride hit bits).
+        post_steps = 20
+        x = np.ones(64, dtype=np.float32)
+        s1 = eng.stats()
+        for _ in range(post_steps):
+            assert np.allclose(eng.allreduce(x.copy(), name="post.t"), size)
+        s2 = eng.stats()
+        rts = (s2["control_round_trips"] - s1["control_round_trips"]) \
+            / post_steps
+        assert rts <= 1.5, f"control-plane gate after resize: {rts} rt/step"
+        assert s2["cache_hits"] > s1["cache_hits"], (s1, s2)
+
+    loss = float(np.mean((state.w - mean_target(size)) ** 2))
+    print(
+        f"ELASTIC_OK id={os.environ.get('HOROVOD_RANK')} "
+        f"rank={basics.rank()} size={size} epoch={epoch} "
+        f"sizes={','.join(map(str, sorted(seen_sizes)))} loss={loss:.12e}",
+        flush=True)
+    basics.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -481,6 +481,26 @@ def scenario_cache_fault_reinit(rank, size, eng):
     assert s3["cache_hits"] == s2["cache_hits"] + 3, (s2, s3)
 
 
+def scenario_stale_epoch(rank, size, eng):
+    # Structural stale-epoch rejection: HOROVOD_FAULT_INJECT=1:2:stale-epoch
+    # makes rank 1 prefix one control frame with a duplicate stamped
+    # epoch-1 (a dead incarnation's delayed message).  The coordinator must
+    # DROP it — counting it in stats()["stale_epoch_msgs"] — and negotiate
+    # from the genuine frame only, so every collective still produces
+    # correct values and nothing desyncs.
+    expected = size * (size + 1) / 2.0
+    for i in range(6):
+        x = np.full((16,), float(rank + 1), dtype=np.float32)
+        out = eng.allreduce(x, name=f"se.{i}")
+        assert np.allclose(out, expected), (i, out[0], expected)
+    s = eng.stats()
+    if rank == 0:
+        assert s["stale_epoch_msgs"] == 1, s
+    else:
+        assert s["stale_epoch_msgs"] == 0, s
+    assert eng.epoch() >= 1
+
+
 SCENARIOS = {
     "allreduce": scenario_allreduce,
     "fused": scenario_fused,
@@ -505,6 +525,7 @@ SCENARIOS = {
     "cache_disabled": scenario_cache_disabled,
     "cache_restart": scenario_cache_restart,
     "cache_fault_reinit": scenario_cache_fault_reinit,
+    "stale_epoch": scenario_stale_epoch,
     "all": None,
 }
 

@@ -5,7 +5,7 @@ production patience) when a rank died or wedged mid-collective; ci.sh
 runs this file under a hard ``timeout`` so a regression that
 reintroduces a hang fails fast instead of eating the CI budget.
 
-Covers the two halves of the elastic runtime:
+Covers the three layers of the elastic runtime:
 
 * detection/abort — HOROVOD_FAULT_INJECT kills/wedges/disconnects one
   rank at a deterministic step; every survivor must raise
@@ -14,9 +14,16 @@ Covers the two halves of the elastic runtime:
 * recovery — ``run_elastic`` + the supervised launcher lose a worker
   mid-training, relaunch it, roll back to the last commit, and converge
   to exactly the uninterrupted run's loss.
+* in-place elastic membership — under ``--elastic`` the world re-forms
+  around the survivors at a new membership epoch when a dead rank is
+  never replaced (shrink-to-survivors), grows back when a relaunched
+  candidate rejoins mid-run, rejects stale-epoch control frames
+  structurally, and terminates with a clean error below
+  ``HOROVOD_ELASTIC_MIN_SIZE``.
 """
 
 import os
+import random
 import re
 import signal
 import subprocess
@@ -30,6 +37,7 @@ pytestmark = pytest.mark.fault
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ELASTIC_WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+SHRINK_WORKER = os.path.join(REPO, "tests", "elastic_shrink_worker.py")
 
 # Tight failure-detection bound so every abort lands in seconds; the
 # subprocess timeout is the hang detector.
@@ -115,6 +123,142 @@ def _losses(p):
     oks = re.findall(r"ELASTIC_OK rank=\d+ loss=(\S+)", out)
     assert len(oks) == 3, out + p.stderr.decode()
     return set(oks)
+
+
+# ---------------------------------------------------------------------------
+# In-place elastic membership (HOROVOD_ELASTIC=1): shrink / rejoin / epochs
+# ---------------------------------------------------------------------------
+
+
+def _run_elastic_membership_job(np_, inject=None, *, restarts=0,
+                                relaunch_delay=0.0, min_size=1,
+                                extra_env=None, timeout=240):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("HOROVOD_FAULT_INJECT", None)
+    env.update({
+        "HOROVOD_CYCLE_TIME": "2",
+        "HOROVOD_FAULT_TIMEOUT_SEC": "5",
+        "HOROVOD_ELASTIC_BACKOFF_SEC": "0.5",
+        "HOROVOD_ELASTIC_MAX_RETRIES": "4",
+        "HOROVOD_ELASTIC_GROW_TIMEOUT_SEC": "2",
+        "HOROVOD_ELASTIC_MIN_SIZE": str(min_size),
+    })
+    env.update(extra_env or {})
+    if inject is not None:
+        env["HOROVOD_FAULT_INJECT"] = inject
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+           "--elastic"]
+    if restarts:
+        cmd += ["--restart-on-failure", str(restarts)]
+    if relaunch_delay:
+        cmd += ["--relaunch-delay-sec", str(relaunch_delay)]
+    cmd += ["--", sys.executable, SHRINK_WORKER]
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          timeout=timeout)
+
+
+def _ok_lines(p):
+    return re.findall(
+        r"ELASTIC_OK id=(\d+) rank=(\d+) size=(\d+) epoch=(\d+) "
+        r"sizes=(\S+) loss=(\S+)", p.stdout.decode())
+
+
+def test_shrink_to_survivors_completes_at_smaller_size():
+    """Rank 2 dies mid-training and is NEVER replaced: the survivors must
+    re-form the world at size 2 under an incremented membership epoch and
+    finish — final weights exactly a 2-rank run resumed from the same
+    commit (the worker's in-state shadow reference asserts it), plus the
+    post-resize control-plane gate (asserted worker-side)."""
+    p = _run_elastic_membership_job(3, "2:10:exit")
+    out = p.stdout.decode() + p.stderr.decode()
+    assert p.returncode == 0, out
+    oks = _ok_lines(p)
+    assert len(oks) == 2, out                      # both survivors finished
+    assert {ok[2] for ok in oks} == {"2"}, oks     # at world size 2
+    assert {ok[4] for ok in oks} == {"2,3"}, oks   # trained in 3 then 2
+    assert int(oks[0][3]) >= 2                     # epoch advanced
+    assert len({ok[5] for ok in oks}) == 1, oks    # identical final loss
+    assert b"committed membership epoch" in p.stdout, out
+
+
+def test_relaunched_worker_rejoins_and_world_grows_back():
+    """Worker id 1 dies; the supervisor relaunches it AFTER the grow
+    window, so the survivors first shrink to size 2, then the candidate's
+    mid-run join triggers a re-rendezvous and ``horovod_size()`` returns
+    3 again under a further-incremented epoch."""
+    p = _run_elastic_membership_job(
+        3, "1:10:exit", restarts=2, relaunch_delay=6.0,
+        extra_env={"HOROVOD_TEST_STEP_SEC": "0.3",
+                   "HOROVOD_TEST_TOTAL_STEPS": "40"})
+    out = p.stdout.decode() + p.stderr.decode()
+    assert p.returncode == 0, out
+    oks = _ok_lines(p)
+    assert len(oks) == 3, out                      # everyone finished
+    assert {ok[2] for ok in oks} == {"3"}, oks     # back at size 3
+    assert all(int(ok[3]) >= 3 for ok in oks), oks  # shrink + grow epochs
+    assert len({ok[5] for ok in oks}) == 1, oks    # identical final loss
+    # The survivors really trained in the shrunken world in between.
+    survivors = [ok for ok in oks if ok[0] != "1"]
+    assert {ok[4] for ok in survivors} == {"2,3"}, oks
+    assert b"is waiting to join" in p.stdout, out
+
+
+def test_shrink_below_min_size_terminates_cleanly():
+    """With HOROVOD_ELASTIC_MIN_SIZE=3, losing a rank permanently must
+    end the job with a clean terminal error naming the knob — promptly,
+    never a hang or a burned retry loop."""
+    p = _run_elastic_membership_job(3, "2:10:exit", min_size=3,
+                                    timeout=120)
+    out = p.stdout.decode() + p.stderr.decode()
+    assert p.returncode != 0, out
+    assert "HOROVOD_ELASTIC_MIN_SIZE" in out, out
+    assert not _ok_lines(p), out
+
+
+def test_stale_epoch_control_frames_dropped_and_counted():
+    """A control frame stamped with epoch N-1 delivered to the
+    coordinator must be dropped and counted in stats()['stale_epoch_msgs']
+    while the genuine frame still negotiates correct values."""
+    run_workers(3, "stale_epoch", timeout=90,
+                extra_env={**FAULT_ENV,
+                           "HOROVOD_FAULT_INJECT": "1:2:stale-epoch"})
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chaos_membership_soak_converges_or_terminates_cleanly(seed):
+    """Seeded randomized fault schedule (rank/step/kind drawn per seed,
+    possibly multi-failure) over a bounded elastic run: the job must
+    ALWAYS either converge (ELASTIC_OK everywhere that survived) or
+    terminate with the clean HOROVOD_ELASTIC_MIN_SIZE error — never hang
+    (the subprocess timeout is the hang detector) and never exit in any
+    third, undiagnosed way."""
+    rng = random.Random(seed)
+    np_ = 3
+    n_faults = rng.randint(1, 2)
+    # Never fault worker id 0: the coordinator is the membership
+    # authority, and its death is a (tested, PR 1) terminal case, not a
+    # resize.
+    ranks = rng.sample(range(1, np_), n_faults)
+    inject = ",".join(
+        f"{r}:{rng.randint(3, 15)}:{rng.choice(['exit', 'drop-conn'])}"
+        for r in ranks)
+    restarts = rng.choice([0, 2])
+    min_size = rng.choice([1, 2])
+    p = _run_elastic_membership_job(
+        np_, inject, restarts=restarts, min_size=min_size,
+        extra_env={"HOROVOD_RENDEZVOUS_TIMEOUT_SEC": "20"},
+        timeout=300)
+    out = p.stdout.decode() + p.stderr.decode()
+    converged = p.returncode == 0 and len(_ok_lines(p)) >= 1
+    min_size_stop = p.returncode != 0 and "HOROVOD_ELASTIC_MIN_SIZE" in out
+    assert converged or min_size_stop, (
+        f"seed={seed} inject={inject} restarts={restarts} "
+        f"min_size={min_size} rc={p.returncode}\n{out}")
+    if converged:
+        # Every completion agrees on the final loss.
+        assert len({ok[5] for ok in _ok_lines(p)}) == 1, out
 
 
 @pytest.mark.parametrize("kind", ["exit", "drop-conn"])
