@@ -66,6 +66,19 @@ PALLAS_AXON_POOL_IPS= HOROVOD_SMOKE_STEPS=50 timeout -k 10 180 \
     python -m pytest \
     "tests/test_engine_stats.py::test_steady_state_hit_rate_and_round_trips[2]" -q
 
+echo "== data-plane gate (channel parity + bandwidth, hard timeout) =="
+# Pipelined multi-channel data plane: channels=4 must be bit-identical to
+# channels=1 across every dtype/op (worker-side byte comparison), and the
+# 16 MB / 4-rank bus-bandwidth ratio must clear the regression floor
+# (see bench_engine.gate: this 2-core box is loopback-CPU-ceilinged, so
+# the floor guards against data-plane breakage — e.g. channel scheduling
+# bugs — rather than asserting the multi-core 1.5x; set
+# HOROVOD_GATE_RATIO=1.5 on capable hosts).  The hard timeouts are the
+# pool-deadlock detectors: a wedged channel driver fails fast and loudly.
+PALLAS_AXON_POOL_IPS= timeout -k 15 420 \
+    python -m pytest "tests/test_data_plane.py::test_channels_bitwise_parity[4]" -q
+PALLAS_AXON_POOL_IPS= timeout -k 15 420 python bench_engine.py --gate
+
 echo "== multichip sharding dry run =="
 PALLAS_AXON_POOL_IPS= python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8) OK')"
 

@@ -107,6 +107,25 @@ int64_t horovod_stale_epoch_msgs() {
   return Engine::Get().stale_epoch_msgs();
 }
 
+// Data-plane observability: payload bytes moved over ring data sockets
+// (all collectives, all channels), cumulative thread-time split between
+// socket progress (wire) and reduction kernels (reduce) — each sums
+// ACROSS channels, so either may exceed wall time when channels overlap —
+// plus ring-allreduce payload bytes and wall time, from which Python's
+// stats() derives allreduce_bus_bw_bytes_per_sec, and the committed
+// per-edge channel count.
+int64_t horovod_data_bytes_tx() { return Engine::Get().data_bytes_tx(); }
+int64_t horovod_data_bytes_rx() { return Engine::Get().data_bytes_rx(); }
+int64_t horovod_reduce_ns() { return Engine::Get().reduce_ns(); }
+int64_t horovod_wire_ns() { return Engine::Get().wire_ns(); }
+int64_t horovod_allreduce_bytes() {
+  return Engine::Get().allreduce_bytes();
+}
+int64_t horovod_allreduce_ns() { return Engine::Get().allreduce_ns(); }
+int64_t horovod_num_channels() {
+  return static_cast<int64_t>(Engine::Get().num_channels());
+}
+
 // Why the engine aborted, copied into buf (truncated to buflen-1); empty
 // while the engine is healthy or after a clean shutdown.  Lets callers
 // attach the culprit rank to enqueues attempted AFTER the abort, whose

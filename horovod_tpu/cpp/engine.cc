@@ -1,9 +1,13 @@
 #include "engine.h"
 
+#include <fcntl.h>
+#include <poll.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <cassert>
+#include <cerrno>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -52,6 +56,11 @@ static inline float HalfToFloat(uint16_t h) {
   return out;
 }
 
+// Round-to-nearest-EVEN, exactly like the F16C hardware converter
+// (_MM_FROUND_TO_NEAREST_INT): the SIMD kernel below handles 8-lane
+// groups and this scalar handles the tails, so any rounding divergence
+// would make results depend on where chunk/shard edges land — the
+// multi-channel bit-exactness guarantee forbids that.
 static inline uint16_t FloatToHalf(float v) {
   uint32_t f;
   memcpy(&f, &v, 4);
@@ -63,12 +72,26 @@ static inline uint16_t FloatToHalf(float v) {
     man |= 0x800000u;
     uint32_t shift = static_cast<uint32_t>(14 - exp);
     uint32_t half_man = man >> shift;
-    uint32_t round = (man >> (shift - 1)) & 1u;
-    return static_cast<uint16_t>(sign | (half_man + round));
+    uint32_t halfbit = 1u << (shift - 1);
+    uint32_t rem = man & ((1u << shift) - 1u);
+    if (rem > halfbit || (rem == halfbit && (half_man & 1u))) half_man += 1;
+    return static_cast<uint16_t>(sign | half_man);
   }
-  if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);
+  if (exp >= 0x1f) {
+    // Source NaN (exponent field 0xff, mantissa nonzero) must become a
+    // QUIET half NaN with the truncated payload — exactly what the F16C
+    // converter emits — not infinity: the SIMD/scalar split falls on
+    // chunk and shard edges, and any divergence would break the
+    // channel-count bit-exactness guarantee.  Finite overflow (source
+    // exponent < 0xff) still rounds to infinity.
+    if (exp == 0xff - 127 + 15 && man != 0) {
+      return static_cast<uint16_t>(sign | 0x7e00u | (man >> 13));
+    }
+    return static_cast<uint16_t>(sign | 0x7c00u);
+  }
   uint32_t half = sign | (static_cast<uint32_t>(exp) << 10) | (man >> 13);
-  if (man & 0x1000u) half += 1;  // round-to-nearest
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1u))) half += 1;
   return static_cast<uint16_t>(half);
 }
 
@@ -88,11 +111,23 @@ static inline uint16_t FloatToBF16(float v) {
   return static_cast<uint16_t>((f + rounding) >> 16);
 }
 
+// __restrict: dst and src never alias (dst is the accumulating local
+// buffer, src a received scratch chunk), and telling GCC 10 so is what
+// lets it vectorize the combine without runtime overlap checks.  The
+// 4-way unrolled body keeps the vectorizer on the wide path even when a
+// chunk tail disables peeling.
 template <typename T, typename F>
 static void CombineLoop(void* dst, const void* src, int64_t n, F f) {
-  T* d = static_cast<T*>(dst);
-  const T* s = static_cast<const T*>(src);
-  for (int64_t i = 0; i < n; ++i) d[i] = f(d[i], s[i]);
+  T* __restrict d = static_cast<T*>(dst);
+  const T* __restrict s = static_cast<const T*>(src);
+  int64_t i = 0;
+  for (; i + 4 <= n; i += 4) {
+    d[i] = f(d[i], s[i]);
+    d[i + 1] = f(d[i + 1], s[i + 1]);
+    d[i + 2] = f(d[i + 2], s[i + 2]);
+    d[i + 3] = f(d[i + 3], s[i + 3]);
+  }
+  for (; i < n; ++i) d[i] = f(d[i], s[i]);
 }
 
 template <typename T>
@@ -113,14 +148,27 @@ static void TypedReduce(void* dst, const void* src, int64_t n, ReduceOp op) {
   }
 }
 
-// 16-bit floats combine through fp32.  The op switch is hoisted out of the
-// loop so each body is straight-line: the bf16 conversions are branch-free
-// shifts and the fused convert-combine-convert loop auto-vectorizes.  This
-// is the eager/DCN hot loop for fused 64 MB gradient buffers (the TPU jit
-// path never touches it).
+// 16-bit floats combine through fp32, staged in blocks: convert a block
+// of each side to fp32, combine, convert back — four SIMPLE loops GCC 10
+// autovectorizes independently (the bf16 conversions are branch-free
+// shifts), where the fused per-element convert-combine-convert body
+// defeated its cost model.  fp16's subnormal-handling conversions stay
+// scalar either way — its SUM hot path goes through the F16C kernel
+// below.  This is the eager/DCN hot loop for fused 64 MB gradient
+// buffers (the TPU jit path never touches it).
 template <float (*ToF)(uint16_t), uint16_t (*FromF)(float), typename F>
-static void HalfCombineLoop(uint16_t* d, const uint16_t* s, int64_t n, F f) {
-  for (int64_t i = 0; i < n; ++i) d[i] = FromF(f(ToF(d[i]), ToF(s[i])));
+static void HalfCombineLoop(uint16_t* __restrict d,
+                            const uint16_t* __restrict s, int64_t n, F f) {
+  constexpr int64_t kBlock = 256;
+  float a[kBlock], b[kBlock];
+  int64_t i = 0;
+  for (; i + kBlock <= n; i += kBlock) {
+    for (int64_t j = 0; j < kBlock; ++j) a[j] = ToF(d[i + j]);
+    for (int64_t j = 0; j < kBlock; ++j) b[j] = ToF(s[i + j]);
+    for (int64_t j = 0; j < kBlock; ++j) a[j] = f(a[j], b[j]);
+    for (int64_t j = 0; j < kBlock; ++j) d[i + j] = FromF(a[j]);
+  }
+  for (; i < n; ++i) d[i] = FromF(f(ToF(d[i]), ToF(s[i])));
 }
 
 #if defined(__x86_64__)
@@ -227,6 +275,70 @@ void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
 }
 
 // ---------------------------------------------------------------------------
+// Data-plane thread pool
+// ---------------------------------------------------------------------------
+
+void DataPool::Start(int nthreads) {
+  Stop();
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = false;
+  }
+  for (int i = 0; i < nthreads; ++i) {
+    threads_.emplace_back(&DataPool::Loop, this);
+  }
+}
+
+void DataPool::Stop() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  for (auto& t : threads_) t.join();
+  threads_.clear();
+  std::lock_guard<std::mutex> lk(mu_);
+  q_.clear();
+  idle_ = 0;
+}
+
+void DataPool::Submit(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    q_.push_back(std::move(fn));
+  }
+  cv_.notify_one();
+}
+
+bool DataPool::TrySubmitIfIdle(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (idle_ - static_cast<int>(q_.size()) <= 0) return false;
+    q_.push_back(std::move(fn));
+  }
+  cv_.notify_one();
+  return true;
+}
+
+void DataPool::Loop() {
+  std::unique_lock<std::mutex> lk(mu_);
+  while (true) {
+    ++idle_;
+    cv_.wait(lk, [&] { return stop_ || !q_.empty(); });
+    --idle_;
+    if (q_.empty()) {
+      if (stop_) return;
+      continue;
+    }
+    auto fn = std::move(q_.front());
+    q_.pop_front();
+    lk.unlock();
+    fn();
+    lk.lock();
+  }
+}
+
+// ---------------------------------------------------------------------------
 // Engine lifecycle
 // ---------------------------------------------------------------------------
 
@@ -271,6 +383,34 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   // execute the wrong response.  Teardown also clears (belt + braces).
   ClearCacheState();
   fusion_threshold_ = EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  // Data-plane fan-out: HOROVOD_NUM_CHANNELS independent socket pairs per
+  // ring edge (1 restores the single-socket path; default auto from the
+  // core count — parallel channels need cores to drive them, and past ~4
+  // the per-message overhead outweighs the loopback/NIC parallelism).
+  // The value used is the COORDINATOR's, committed at rendezvous, so a
+  // heterogeneous env cannot wire mismatched fan-outs.
+  num_channels_ = static_cast<int>(EnvInt64("HOROVOD_NUM_CHANNELS", 0));
+  if (num_channels_ <= 0) {
+    unsigned hc = std::thread::hardware_concurrency();
+    num_channels_ = std::min(4, std::max(1, static_cast<int>(hc)));
+  }
+  if (num_channels_ > 16) num_channels_ = 16;
+  socket_buf_bytes_ =
+      static_cast<int>(EnvInt64("HOROVOD_SOCKET_BUF_BYTES", 0));
+  chunk_bytes_ = EnvInt64("HOROVOD_CHUNK_BYTES", 1 << 20);
+  if (chunk_bytes_ < 4096) chunk_bytes_ = 4096;
+  chunk_bytes_ &= ~int64_t{7};  // multiple of 8: aligns to every dtype
+  channel_drivers_ =
+      static_cast<int>(EnvInt64("HOROVOD_CHANNEL_DRIVERS", 0));
+  if (channel_drivers_ <= 0) {
+    // One driver per core: drivers mostly block in poll, so matching the
+    // core count keeps every core fed without the thrash of a
+    // thread-per-channel (measured on the 2-core CI box: 4 channels on
+    // 2 drivers beat both 1 driver and 4).
+    unsigned hc = std::thread::hardware_concurrency();
+    channel_drivers_ = std::max(1, static_cast<int>(hc));
+  }
+  if (channel_drivers_ > 16) channel_drivers_ = 16;
   stall_check_disabled_ = EnvInt64("HOROVOD_STALL_CHECK_DISABLE", 0) != 0;
   stall_warning_sec_ =
       static_cast<int>(EnvInt64("HOROVOD_STALL_WARNING_SEC", 60));
@@ -400,8 +540,13 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     std::string my_host = my_host_env ? my_host_env : "127.0.0.1";
 
     // Every rank opens an ephemeral data listener for ring neighbors.
+    // Backlog covers the MAXIMUM channel fan-out (16) arriving at once
+    // during wiring — the committed count is only known after
+    // rendezvous, and the coordinator's may exceed this rank's env
+    // value (overflowed connects retry, but the backlog avoids the
+    // retry latency on the common path).
     int data_port = 0;
-    data_listener_ = Listen("0.0.0.0", 0, 8, &data_port, &err);
+    data_listener_ = Listen("0.0.0.0", 0, 16 + 8, &data_port, &err);
     if (!data_listener_.valid()) {
       last_error_ = "data listener: " + err;
       return 1;
@@ -429,28 +574,42 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     node_id_ = rank_ / local_size_;
     nnodes_ = local_size_ > 0 ? size_ / local_size_ : 1;
 
-    // Ring wiring.  Each directed ring edge is its own TCP connection,
-    // opened by the edge's source, identified by an (origin rank, ring id)
-    // handshake.  Connect cannot deadlock: every listener already exists,
-    // so connects complete from the backlog even before the peer accepts.
+    // Ring wiring.  Each directed ring edge is its own TCP connection —
+    // the GLOBAL ring opens num_channels_ independent connections per
+    // edge (the data-plane fan-out; each channel later carries its own
+    // shard of a collective) — opened by the edge's source, identified
+    // by an (origin rank, ring id, channel, epoch) handshake.  The epoch
+    // stamp makes elastic re-rendezvous airtight per channel: a stale
+    // connect from a dead incarnation is dropped instead of stealing a
+    // channel slot in the new world's wiring.  Connect cannot deadlock:
+    // every listener already exists, so connects complete from the
+    // backlog even before the peer accepts.
     enum RingId : int32_t { GLOBAL = 0, LOCAL = 1, CROSS = 2 };
     struct Edge {
       int peer;
       int32_t ring;
+      int32_t channel;
       Socket* slot;
     };
+    ring_next_.clear();
+    ring_prev_.clear();
+    ring_next_.resize(num_channels_);
+    ring_prev_.resize(num_channels_);
     std::vector<Edge> outgoing, incoming;
-    outgoing.push_back({(rank_ + 1) % size_, GLOBAL, &ring_next_});
-    incoming.push_back({(rank_ - 1 + size_) % size_, GLOBAL, &ring_prev_});
+    for (int32_t c = 0; c < num_channels_; ++c) {
+      outgoing.push_back({(rank_ + 1) % size_, GLOBAL, c, &ring_next_[c]});
+      incoming.push_back(
+          {(rank_ - 1 + size_) % size_, GLOBAL, c, &ring_prev_[c]});
+    }
     if (hierarchical_) {
       int L = local_size_, lr = local_rank_, base = node_id_ * L;
-      outgoing.push_back({base + (lr + 1) % L, LOCAL, &local_next_});
-      incoming.push_back({base + (lr - 1 + L) % L, LOCAL, &local_prev_});
+      outgoing.push_back({base + (lr + 1) % L, LOCAL, 0, &local_next_});
+      incoming.push_back({base + (lr - 1 + L) % L, LOCAL, 0, &local_prev_});
       if (lr == 0) {  // node leader: ring over one rank per node
         outgoing.push_back(
-            {((node_id_ + 1) % nnodes_) * L, CROSS, &cross_next_});
-        incoming.push_back(
-            {((node_id_ - 1 + nnodes_) % nnodes_) * L, CROSS, &cross_prev_});
+            {((node_id_ + 1) % nnodes_) * L, CROSS, 0, &cross_next_});
+        incoming.push_back({((node_id_ - 1 + nnodes_) % nnodes_) * L, CROSS,
+                            0, &cross_prev_});
       }
     }
     for (auto& edge : outgoing) {
@@ -461,7 +620,8 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
                       ": " + err;
         return 1;
       }
-      int32_t hello[2] = {rank_, edge.ring};
+      int32_t hello[4] = {rank_, edge.ring, edge.channel,
+                          static_cast<int32_t>(epoch_.load())};
       if (!edge.slot->SendAll(hello, sizeof(hello))) {
         last_error_ = "ring handshake send failed";
         return 1;
@@ -473,7 +633,7 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     data_listener_.SetTimeouts(5);
     auto ring_deadline = std::chrono::steady_clock::now() +
                          std::chrono::seconds(rendezvous_timeout_sec_);
-    for (size_t i = 0; i < incoming.size(); ++i) {
+    for (size_t matched_edges = 0; matched_edges < incoming.size();) {
       Socket conn;
       while (!conn.valid()) {
         if (std::chrono::steady_clock::now() > ring_deadline) {
@@ -488,38 +648,57 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         }
       }
       conn.SetTimeouts(10);
-      int32_t hello[2] = {-1, -1};
+      int32_t hello[4] = {-1, -1, -1, -1};
       if (!conn.RecvAll(hello, sizeof(hello))) {
         last_error_ = "ring handshake recv failed";
         return 1;
       }
+      if (hello[3] != static_cast<int32_t>(epoch_.load())) {
+        // A dead incarnation's delayed wiring connect (elastic
+        // re-rendezvous raced the old world's teardown): drop it and
+        // keep accepting this epoch's channels.
+        continue;
+      }
       bool matched = false;
       for (auto& edge : incoming) {
         if (edge.peer == hello[0] && edge.ring == hello[1] &&
-            !edge.slot->valid()) {
+            edge.channel == hello[2] && !edge.slot->valid()) {
           *edge.slot = std::move(conn);
           matched = true;
+          ++matched_edges;
           break;
         }
       }
       if (!matched) {
         last_error_ = "unexpected ring handshake from rank " +
                       std::to_string(hello[0]) + " ring " +
-                      std::to_string(hello[1]);
+                      std::to_string(hello[1]) + " channel " +
+                      std::to_string(hello[2]);
         return 1;
       }
     }
 
     // Robustness: bound every blocking transport op and probe idle peers
-    // so a dead/hung process surfaces as a clean error, not a hang.
-    Socket* socks[] = {&ring_next_,  &ring_prev_,  &coordinator_conn_,
-                       &local_next_, &local_prev_, &cross_next_,
-                       &cross_prev_};
+    // so a dead/hung process surfaces as a clean error, not a hang.  Ring
+    // data sockets additionally get HOROVOD_SOCKET_BUF_BYTES so the
+    // kernel can stream ahead while userland reduces.
+    std::vector<Socket*> data_socks;
+    for (auto& s : ring_next_) data_socks.push_back(&s);
+    for (auto& s : ring_prev_) data_socks.push_back(&s);
+    data_socks.push_back(&local_next_);
+    data_socks.push_back(&local_prev_);
+    data_socks.push_back(&cross_next_);
+    data_socks.push_back(&cross_prev_);
+    std::vector<Socket*> socks = data_socks;
+    socks.push_back(&coordinator_conn_);
     for (Socket* s : socks) {
       if (s->valid()) {
         s->SetTimeouts(socket_timeout_sec_);
         s->EnableKeepalive();
       }
+    }
+    for (Socket* s : data_socks) {
+      if (s->valid()) s->SetBufSizes(socket_buf_bytes_);
     }
     for (auto& c : worker_conns_) {
       if (c.valid()) {
@@ -527,6 +706,9 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         c.EnableKeepalive();
       }
     }
+    // Data-plane pool: one worker per channel drives channel shards,
+    // concurrent responses, and large parallel reductions.
+    pool_.Start(num_channels_);
     }  // committed size_ > 1: ring wiring + transport bounds
   } else {
     // Env-identity world of one (no rendezvous ran): commit a local epoch
@@ -535,6 +717,9 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   }
 
   last_stall_check_ = std::chrono::steady_clock::now();
+  last_exec_time_ = std::chrono::steady_clock::now();
+  fusion_buffers_.assign(std::max(1, num_channels_),
+                         std::vector<uint8_t>());
   initialized_.store(true);
   background_ = std::thread(&Engine::BackgroundLoop, this);
   return 0;
@@ -685,6 +870,10 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     w.i32(r);  // assigned rank
     w.i32(new_size);
     w.u8(hierarchical_ ? 1 : 0);
+    // The coordinator's data-plane fan-out is THE fan-out: every member
+    // wires exactly this many channels per ring edge, so a rank whose
+    // env disagrees cannot deadlock the channel accepts.
+    w.i32(num_channels_);
     for (int i = 0; i < new_size; ++i) {
       w.str((*peer_hosts)[i]);
       w.i32((*peer_ports)[i]);
@@ -783,7 +972,9 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     int32_t new_rank = r.i32();
     int32_t new_size = r.i32();
     uint8_t hier = r.u8();
-    if (!r.ok() || new_size < 1 || new_rank < 0 || new_rank >= new_size) {
+    int32_t committed_channels = r.i32();
+    if (!r.ok() || new_size < 1 || new_rank < 0 || new_rank >= new_size ||
+        committed_channels < 1 || committed_channels > 16) {
       lasterr = "bad membership assignment frame";
       break;
     }
@@ -798,6 +989,7 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
       break;
     }
     hierarchical_ = hier != 0;
+    num_channels_ = committed_channels;
     if (new_rank != worker_id_ || new_size != world_size_) {
       std::fprintf(stderr,
                    "horovod_tpu worker id %d: joined membership epoch %lld "
@@ -863,6 +1055,9 @@ void Engine::Shutdown() {
   shutdown_requested_.store(true);
   cycle_cv_.notify_all();  // wake the event-driven cycle wait immediately
   if (background_.joinable()) background_.join();
+  // The background loop waits out its in-flight waves before exiting, so
+  // the pool is quiescent here; stop it so a re-Init starts fresh.
+  pool_.Stop();
   initialized_.store(false);
 }
 
@@ -924,6 +1119,9 @@ void Engine::BackgroundLoop() {
   // Same for the response cache: a recovered world must never replay the
   // dead world's slot ids (the new coordinator numbers slots from zero).
   ClearCacheState();
+  // Drop the fusion-scratch high-water allocations: a dead/stopped engine
+  // must not pin up to threshold-sized buffers per channel slot.
+  ReleaseScratch();
   // Close every connection so peers blocked in recv see EOF immediately and
   // the failure propagates around the ring instead of stranding them until
   // their own timeout.
@@ -955,8 +1153,8 @@ std::string Engine::AbortReason() const {
 }
 
 void Engine::CloseSockets() {
-  ring_next_.Close();
-  ring_prev_.Close();
+  for (auto& s : ring_next_) s.Close();
+  for (auto& s : ring_prev_) s.Close();
   local_next_.Close();
   local_prev_.Close();
   cross_next_.Close();
@@ -1060,6 +1258,11 @@ bool Engine::RunLoopOnce() {
   }
   if (fault_hang_.load() || fault_drop_.load()) return true;  // next pass
 
+  // Idle high-water release: no collective for a while ⇒ hand the fusion
+  // scratch back to the allocator (steady-state training re-executes
+  // every few ms and never hits this).
+  MaybeReleaseScratch();
+
   // Elastic rejoin: a candidate knocking on the control listener aborts
   // this world so the next rendezvous can admit it (checked before the
   // size-1 fast path — a world shrunk to one must still grow back).
@@ -1088,7 +1291,7 @@ bool Engine::RunLoopOnce() {
     }
     FuseResponses(responses);
     if (!responses.empty()) exec_cycles_.fetch_add(1);
-    for (auto& resp : responses) PerformResponse(resp);
+    ExecuteResponses(responses);
     return !my_list.shutdown;
   }
 
@@ -1172,7 +1375,7 @@ bool Engine::RunLoopOnce() {
     // negotiated responses, then the agreed cached slots.
     ApplyCacheUpdates(response_list);
     bool executed_any = !response_list.responses.empty();
-    for (auto& resp : response_list.responses) PerformResponse(resp);
+    ExecuteResponses(response_list.responses);
     if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
     if (executed_any) exec_cycles_.fetch_add(1);
     if (!stall_check_disabled_) CheckForStalledTensors();
@@ -1270,7 +1473,7 @@ bool Engine::RunLoopOnce() {
   }
   ApplyCacheUpdates(response_list);
   bool executed_any = !response_list.responses.empty();
-  for (auto& resp : response_list.responses) PerformResponse(resp);
+  ExecuteResponses(response_list.responses);
   if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
   if (executed_any) exec_cycles_.fetch_add(1);
   return !response_list.shutdown;
@@ -1432,10 +1635,11 @@ bool Engine::ExecuteCachedResponses(const ResponseList& list,
   }
   // Deterministic across ranks: identical slot order (from the frame) and
   // identical per-tensor dtypes/sizes (signature-agreed) ⇒ identical
-  // fusion ⇒ identical ring execution order.
+  // fusion ⇒ identical ring execution order (and identical wave/channel
+  // assignment in ExecuteResponses).
   FuseResponses(cached);
   *executed_any = true;
-  for (auto& resp : cached) PerformResponse(resp);
+  ExecuteResponses(cached);
   return true;
 }
 
@@ -1798,7 +2002,118 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
 // hops·full_transfer.
 static constexpr size_t kRelayChunk = 4u << 20;
 
-void Engine::PerformResponse(const Response& response) {
+void Engine::ExecuteResponses(std::vector<Response>& responses) {
+  if (responses.empty()) return;
+  last_exec_time_ = std::chrono::steady_clock::now();
+  // Concurrency degree: the flat global ring has num_channels_ disjoint
+  // socket pairs, so up to that many INDEPENDENT responses can execute at
+  // once, each claiming one channel (assignment by list index — the list
+  // is identical on every rank, so rank r's channel c always talks to
+  // rank r+1's channel c about the same response).  The hierarchical
+  // local/cross rings are single pairs, so that topology executes
+  // serially, as does C == 1 — exactly the pre-channel path.
+  const int C =
+      (size_ > 1 && !hierarchical_ && pool_.size() > 0) ? num_channels_ : 1;
+  if (C <= 1 || responses.size() <= 1) {
+    ExecCtx all{0, std::max(1, C)};
+    for (auto& resp : responses) PerformResponse(resp, all);
+    last_exec_time_ = std::chrono::steady_clock::now();
+    return;
+  }
+  for (size_t base = 0; base < responses.size();
+       base += static_cast<size_t>(C)) {
+    const int wave =
+        static_cast<int>(std::min<size_t>(C, responses.size() - base));
+    if (wave == 1) {
+      // Lone trailing response: give it the full fan-out.
+      PerformResponse(responses[base], ExecCtx{0, C, nullptr});
+      continue;
+    }
+    std::vector<int64_t> slice_walls(wave, 0);
+    TaskLatch latch(wave - 1);
+    for (int j = 1; j < wave; ++j) {
+      pool_.Submit([this, &responses, &slice_walls, base, j, &latch] {
+        PerformResponse(responses[base + j],
+                        ExecCtx{j, 1, &slice_walls[j]});
+        latch.Done();
+      });
+    }
+    PerformResponse(responses[base], ExecCtx{0, 1, &slice_walls[0]});
+    // Wave barrier: a channel must be quiet before the next wave reuses
+    // it, or two responses' streams would interleave on one socket.
+    latch.Wait();
+    // One wall-clock sample per wave: the longest allreduce slice
+    // (bytes were summed per response, so the derived bus bandwidth
+    // reflects real elapsed time, undiluted by co-scheduled
+    // non-allreduce responses).
+    int64_t wall = *std::max_element(slice_walls.begin(),
+                                     slice_walls.end());
+    if (wall > 0) allreduce_ns_.fetch_add(wall);
+  }
+  last_exec_time_ = std::chrono::steady_clock::now();
+}
+
+void Engine::ReleaseScratch() {
+  for (auto& b : fusion_buffers_) std::vector<uint8_t>().swap(b);
+}
+
+void Engine::MaybeReleaseScratch() {
+  bool any = false;
+  for (auto& b : fusion_buffers_) any = any || b.capacity() > 0;
+  if (!any) return;
+  auto now = std::chrono::steady_clock::now();
+  if (now - last_exec_time_ < std::chrono::seconds(2)) return;
+  ReleaseScratch();
+}
+
+void Engine::ReduceIntoTimed(void* dst, const void* src, int64_t count,
+                             DataType dtype, ReduceOp op) {
+  auto t0 = std::chrono::steady_clock::now();
+  const int64_t bytes = count * static_cast<int64_t>(DataTypeSize(dtype));
+  // Large reductions split across IDLE pool workers (disjoint element
+  // ranges of an elementwise kernel — bit-identical to the serial call
+  // for any split).  TrySubmitIfIdle never queues behind a busy channel
+  // task, so a shard either runs on a genuinely free core or inline here
+  // — the pool cannot deadlock on its own reductions.  The cut sits
+  // ABOVE the ring pipeline chunk (chunk_bytes_): chunk reduces are
+  // already overlapped with the wire, and splitting them again just buys
+  // latch traffic; only the big monolithic reduces (hierarchical chain
+  // relays, oversized chunks) benefit.
+  const int64_t kParallelCut = std::max<int64_t>(2 << 20, chunk_bytes_ * 2);
+  if (bytes >= kParallelCut && pool_.size() > 0 && count >= 4) {
+    int parts = std::min<int64_t>(pool_.size() + 1, bytes / (kParallelCut / 2));
+    parts = std::min(parts, 4);
+    if (parts > 1) {
+      uint8_t* d = static_cast<uint8_t*>(dst);
+      const uint8_t* s = static_cast<const uint8_t*>(src);
+      const size_t esize = DataTypeSize(dtype);
+      const int64_t per = count / parts;
+      TaskLatch latch(parts - 1);
+      for (int p = 1; p < parts; ++p) {
+        int64_t off = per * p;
+        int64_t n = (p == parts - 1) ? count - off : per;
+        auto shard = [d, s, off, n, esize, dtype, op, &latch] {
+          ReduceInto(d + off * esize, s + off * esize, n, dtype, op);
+          latch.Done();
+        };
+        if (!pool_.TrySubmitIfIdle(shard)) shard();
+      }
+      ReduceInto(d, s, per, dtype, op);
+      latch.Wait();
+      reduce_ns_.fetch_add(
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now() - t0)
+              .count());
+      return;
+    }
+  }
+  ReduceInto(dst, src, count, dtype, op);
+  reduce_ns_.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                           std::chrono::steady_clock::now() - t0)
+                           .count());
+}
+
+void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
   std::vector<TensorTableEntry> entries;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -1832,19 +2147,19 @@ void Engine::PerformResponse(const Response& response) {
   tensors_executed_.fetch_add(static_cast<int64_t>(entries.size()));
   switch (response.type) {
     case ResponseType::ALLREDUCE:
-      ExecAllreduce(response, entries);
+      ExecAllreduce(response, entries, ctx);
       break;
     case ResponseType::ALLGATHER:
-      ExecAllgather(response, entries);
+      ExecAllgather(response, entries, ctx);
       break;
     case ResponseType::BROADCAST:
-      ExecBroadcast(response, entries);
+      ExecBroadcast(response, entries, ctx);
       break;
     case ResponseType::REDUCESCATTER:
-      ExecReducescatter(response, entries);
+      ExecReducescatter(response, entries, ctx);
       break;
     case ResponseType::ALLTOALL:
-      ExecAlltoall(response, entries);
+      ExecAlltoall(response, entries, ctx);
       break;
     default:
       break;
@@ -1927,6 +2242,385 @@ static bool RingAllreduce(void* data, int64_t count, DataType dtype,
   return true;
 }
 
+// One channel's reduce-scatter phase over explicit per-segment slices,
+// chunk-pipelined: the recv of chunk k+1 streams through the kernel
+// buffers while ReduceInto processes chunk k (SendRecvChunked fires the
+// reduction from the poll loop the moment a chunk's bytes are in).
+bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
+                                      const std::vector<int64_t>& seg_count,
+                                      const std::vector<int64_t>& seg_off,
+                                      DataType dtype, ReduceOp op, int vrank,
+                                      int ch, std::string* err) {
+  const size_t esize = DataTypeSize(dtype);
+  int64_t max_seg = 0;
+  for (auto c : seg_count) max_seg = std::max(max_seg, c);
+  // Raw allocation: vector's value-init would memset up to segment-size
+  // bytes per collective for data every chunk immediately overwrites.
+  std::unique_ptr<uint8_t[]> tmp(
+      new uint8_t[static_cast<size_t>(max_seg) * esize]);
+  const size_t chunk =
+      static_cast<size_t>(chunk_bytes_) / esize * esize;  // dtype-aligned
+  const int timeout_ms = socket_timeout_sec_ * 1000;
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_seg = (vrank - step + 2 * size_) % size_;
+    int recv_seg = (vrank - step - 1 + 2 * size_) % size_;
+    const size_t sn = static_cast<size_t>(seg_count[send_seg]) * esize;
+    const size_t rn = static_cast<size_t>(seg_count[recv_seg]) * esize;
+    uint8_t* rbase = base + seg_off[recv_seg] * esize;
+    int64_t wns = 0;
+    bool ok = SendRecvChunked(
+        ring_next_[ch], base + seg_off[send_seg] * esize, sn, ring_prev_[ch],
+        tmp.get(), rn, chunk,
+        [&](size_t off, size_t len) {
+          ReduceIntoTimed(rbase + off, tmp.get() + off,
+                          static_cast<int64_t>(len / esize), dtype, op);
+        },
+        timeout_ms, err, &wns);
+    wire_ns_.fetch_add(wns);
+    if (!ok) return false;
+    data_bytes_tx_.fetch_add(static_cast<int64_t>(sn));
+    data_bytes_rx_.fetch_add(static_cast<int64_t>(rn));
+  }
+  return true;
+}
+
+
+bool Engine::RingAllgatherPhaseCh(uint8_t* base,
+                                  const std::vector<int64_t>& seg_count,
+                                  const std::vector<int64_t>& seg_off,
+                                  size_t esize, int vrank, int ch,
+                                  std::string* err) {
+  const int timeout_ms = socket_timeout_sec_ * 1000;
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_seg = (vrank - step + 1 + size_) % size_;
+    int recv_seg = (vrank - step + size_) % size_;
+    const size_t sn = static_cast<size_t>(seg_count[send_seg]) * esize;
+    const size_t rn = static_cast<size_t>(seg_count[recv_seg]) * esize;
+    int64_t wns = 0;
+    bool ok = SendRecvChunked(ring_next_[ch], base + seg_off[send_seg] * esize,
+                              sn, ring_prev_[ch],
+                              base + seg_off[recv_seg] * esize, rn,
+                              /*chunk=*/0, nullptr, timeout_ms, err, &wns);
+    wire_ns_.fetch_add(wns);
+    if (!ok) return false;
+    data_bytes_tx_.fetch_add(static_cast<int64_t>(sn));
+    data_bytes_rx_.fetch_add(static_cast<int64_t>(rn));
+  }
+  return true;
+}
+
+// The streaming cascade (see engine.h): sender and receiver cursors walk
+// the unified step schedule s = 0..2(N-1)-1 — reduce-scatter steps then
+// allgather steps — with per-step eligibility fed by the receiver.
+// ready[s] counts bytes of step s's send segment that may ship: step 0 is
+// fully ready at start (local data); step s+1's segment IS the segment
+// received at step s, so the receiver credits ready[s+1] as bytes land
+// (allgather: raw bytes — final on arrival) or as chunks finish reducing
+// (reduce-scatter: a chunk is sendable only once combined).  Both sides
+// walk steps in the same order, so the two FIFO directions stay framed
+// without any headers.
+bool Engine::StreamingRingChannels(uint8_t* base,
+                                   const std::vector<ChannelSegs>& channels,
+                                   DataType dtype, ReduceOp op, int vrank,
+                                   std::string* err) {
+  const size_t esize = DataTypeSize(dtype);
+  const int N = size_;
+  const int nsteps = 2 * (N - 1);
+  const int last_rs = N - 2;  // steps [0, last_rs] reduce; rest allgather
+  // Step schedule (segment ids, shared by every channel).  RS step s:
+  // send (vrank-s), recv (vrank-s-1), reduce.  AG step s' = s-(N-1):
+  // send (vrank-s'+1), recv (vrank-s') — the continuation of the same
+  // per-chunk dependency chain.
+  std::vector<int> send_seg(nsteps), recv_seg(nsteps);
+  for (int s = 0; s < nsteps; ++s) {
+    if (s <= last_rs) {
+      send_seg[s] = (vrank - s + 2 * N) % N;
+      recv_seg[s] = (vrank - s - 1 + 2 * N) % N;
+    } else {
+      int sp = s - (N - 1);
+      send_seg[s] = (vrank - sp + 1 + 2 * N) % N;
+      recv_seg[s] = (vrank - sp + 2 * N) % N;
+    }
+  }
+  const size_t chunk =
+      static_cast<size_t>(chunk_bytes_) / esize * esize;  // dtype-aligned
+
+  // Per-channel cascade state.
+  struct ChState {
+    const ChannelSegs* segs = nullptr;
+    std::vector<size_t> ready;
+    int ss = 0;          // sender step
+    size_t so = 0;       // bytes of step ss already sent
+    int rs = 0;          // receiver step
+    size_t ro = 0;       // bytes of step rs already received
+    size_t reduced = 0;  // bytes of step rs already reduced (RS steps)
+    size_t tx = 0, rx = 0;
+    // RS receive scratch (chunks are reduced out of it as they
+    // complete); raw allocation — value-init would memset a segment per
+    // collective.
+    std::unique_ptr<uint8_t[]> tmp;
+  };
+  std::vector<ChState> st(channels.size());
+  std::vector<std::unique_ptr<NonblockGuard>> guards;
+  for (size_t i = 0; i < channels.size(); ++i) {
+    ChState& c = st[i];
+    c.segs = &channels[i];
+    c.ready.assign(nsteps + 1, 0);
+    int64_t max_seg = 0;
+    for (auto n : c.segs->seg_count) max_seg = std::max(max_seg, n);
+    c.tmp.reset(new uint8_t[static_cast<size_t>(max_seg) * esize]);
+    guards.emplace_back(new NonblockGuard(ring_next_[c.segs->ch].fd()));
+    guards.emplace_back(new NonblockGuard(ring_prev_[c.segs->ch].fd()));
+  }
+  auto seg_bytes = [&](const ChState& c, int seg) {
+    return static_cast<size_t>(c.segs->seg_count[seg]) * esize;
+  };
+  auto advance_sender = [&](ChState& c) {
+    while (c.ss < nsteps && c.so == seg_bytes(c, send_seg[c.ss])) {
+      ++c.ss;
+      c.so = 0;
+    }
+  };
+  auto advance_receiver = [&](ChState& c) {
+    while (c.rs < nsteps && c.ro == seg_bytes(c, recv_seg[c.rs])) {
+      ++c.rs;
+      c.ro = 0;
+      c.reduced = 0;
+    }
+  };
+  for (auto& c : st) {
+    c.ready[0] = seg_bytes(c, send_seg[0]);
+    advance_sender(c);
+    advance_receiver(c);
+  }
+  const int timeout_ms = socket_timeout_sec_ * 1000;
+  auto t0 = std::chrono::steady_clock::now();
+  int64_t local_reduce_ns = 0;
+  bool ok = true;
+  std::vector<pollfd> fds;
+  std::vector<std::pair<int, int>> owner;  // (channel idx, 0=send 1=recv)
+  while (ok) {
+    fds.clear();
+    owner.clear();
+    for (size_t i = 0; i < st.size(); ++i) {
+      ChState& c = st[i];
+      if (c.ss < nsteps && c.so < c.ready[c.ss]) {
+        fds.push_back({ring_next_[c.segs->ch].fd(), POLLOUT, 0});
+        owner.emplace_back(static_cast<int>(i), 0);
+      }
+      if (c.rs < nsteps) {
+        fds.push_back({ring_prev_[c.segs->ch].fd(), POLLIN, 0});
+        owner.emplace_back(static_cast<int>(i), 1);
+      }
+    }
+    if (fds.empty()) break;  // every channel's cascade completed
+    int rc = ::poll(fds.data(), static_cast<nfds_t>(fds.size()),
+                    timeout_ms > 0 ? timeout_ms : -1);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      *err = std::string("poll: ") + strerror(errno);
+      ok = false;
+      break;
+    }
+    if (rc == 0) {
+      *err = "link: no progress for " + std::to_string(timeout_ms / 1000) +
+             "s (peer hung?)";
+      ok = false;
+      break;
+    }
+    // Drain loops: after one poll wakeup, move bytes until EAGAIN (or a
+    // cursor runs out of eligible work) — poll syscalls are the
+    // expensive part on sandboxed kernels, so each should amortize as
+    // much IO as the buffers will take.
+    for (size_t f = 0; ok && f < fds.size(); ++f) {
+      ChState& c = st[owner[f].first];
+      if (owner[f].second == 0) {
+        if ((fds[f].revents & (POLLOUT | POLLERR | POLLHUP)) == 0) continue;
+        while (c.ss < nsteps && c.so < c.ready[c.ss]) {
+          const uint8_t* p =
+              base + c.segs->seg_off[send_seg[c.ss]] * esize + c.so;
+          ssize_t k = ::send(ring_next_[c.segs->ch].fd(), p,
+                             c.ready[c.ss] - c.so, MSG_NOSIGNAL);
+          if (k > 0) {
+            c.so += static_cast<size_t>(k);
+            c.tx += static_cast<size_t>(k);
+            advance_sender(c);
+          } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                               errno == EINTR)) {
+            break;
+          } else {
+            *err = std::string("send to peer: ") + strerror(errno);
+            ok = false;
+            break;
+          }
+        }
+      } else {
+        if ((fds[f].revents & (POLLIN | POLLERR | POLLHUP)) == 0) continue;
+        while (c.rs < nsteps) {
+          const bool reducing = c.rs <= last_rs;
+          const size_t want = seg_bytes(c, recv_seg[c.rs]) - c.ro;
+          uint8_t* dst =
+              reducing ? c.tmp.get() + c.ro
+                       : base + c.segs->seg_off[recv_seg[c.rs]] * esize +
+                             c.ro;
+          ssize_t k = ::recv(ring_prev_[c.segs->ch].fd(), dst, want, 0);
+          if (k > 0) {
+            c.ro += static_cast<size_t>(k);
+            c.rx += static_cast<size_t>(k);
+            if (reducing) {
+              // Reduce every COMPLETED chunk, then credit it downstream.
+              uint8_t* sb =
+                  base + c.segs->seg_off[recv_seg[c.rs]] * esize;
+              const size_t total = seg_bytes(c, recv_seg[c.rs]);
+              while (c.reduced < c.ro &&
+                     (c.ro - c.reduced >= chunk || c.ro == total)) {
+                size_t len = std::min(chunk, c.ro - c.reduced);
+                auto r0 = std::chrono::steady_clock::now();
+                ReduceIntoTimed(sb + c.reduced, c.tmp.get() + c.reduced,
+                                static_cast<int64_t>(len / esize), dtype,
+                                op);
+                local_reduce_ns +=
+                    std::chrono::duration_cast<std::chrono::nanoseconds>(
+                        std::chrono::steady_clock::now() - r0)
+                        .count();
+                c.reduced += len;
+                if (c.rs + 1 < nsteps) c.ready[c.rs + 1] += len;
+              }
+            } else if (c.rs + 1 < nsteps) {
+              // Allgather bytes are final on arrival: credit them raw.
+              c.ready[c.rs + 1] += static_cast<size_t>(k);
+            }
+            advance_receiver(c);
+          } else if (k == 0) {
+            *err =
+                "recv from peer: connection closed (peer process exited?)";
+            ok = false;
+            break;
+          } else if (errno == EAGAIN || errno == EWOULDBLOCK ||
+                     errno == EINTR) {
+            break;
+          } else {
+            *err = std::string("recv from peer: ") + strerror(errno);
+            ok = false;
+            break;
+          }
+        }
+      }
+    }
+  }
+  wire_ns_.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                         std::chrono::steady_clock::now() - t0)
+                         .count() -
+                     local_reduce_ns);
+  for (auto& c : st) {
+    data_bytes_tx_.fetch_add(static_cast<int64_t>(c.tx));
+    data_bytes_rx_.fetch_add(static_cast<int64_t>(c.rx));
+  }
+  return ok;
+}
+
+// Minimum payload per extra channel: below this, sharding just multiplies
+// per-message overhead (syscalls, poll wakeups) without any wire to hide,
+// so the fan-out degrades gracefully toward 1 for small collectives.
+static constexpr int64_t kMinBytesPerChannel = 256 * 1024;
+
+bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
+                                    DataType dtype, ReduceOp op, int vrank,
+                                    const ExecCtx& ctx,
+                                    const std::string& tname,
+                                    std::string* err) {
+  const size_t esize = DataTypeSize(dtype);
+  std::vector<int64_t> seg_count, seg_off;
+  EvenSegments(count, size_, &seg_count, &seg_off);
+  // Effective fan-out, deterministic across ranks (count, esize, and the
+  // committed channel count all agree).  Any value is VALUE-safe: channel
+  // shards slice WITHIN each ring segment, so an element's segment id —
+  // hence the rank order its reduction applies in — never depends on the
+  // fan-out, and results are bit-identical for channels = 1..N.
+  int nch = std::max(1, ctx.nchannels);
+  const int64_t bytes = count * static_cast<int64_t>(esize);
+  while (nch > 1 && bytes / nch < kMinBytesPerChannel) --nch;
+  // Per-channel slices of every segment: channel c owns
+  // seg_count[s]/nch (+1 for the first seg_count[s]%nch channels)
+  // elements at a contiguous offset inside segment s.
+  auto channel_segs = [&](int c, std::vector<int64_t>* cnt,
+                          std::vector<int64_t>* off) {
+    cnt->resize(size_);
+    off->resize(size_);
+    for (int s = 0; s < size_; ++s) {
+      int64_t n = seg_count[s], q = n / nch, r = n % nch;
+      (*cnt)[s] = q + (c < r ? 1 : 0);
+      (*off)[s] = seg_off[s] + q * c + std::min<int64_t>(c, r);
+    }
+  };
+  if (nch == 1 && ctx.nchannels == 1 && num_channels_ == 1) {
+    // HOROVOD_NUM_CHANNELS=1 restores the pre-channel discipline exactly:
+    // the stepped reduce-scatter phase (with its within-step chunked
+    // recv/reduce overlap) followed by the stepped allgather, one socket
+    // pair, per-step barriers.  The streaming cascade below is the
+    // multi-channel data plane.
+    const int ch = ctx.channel;
+    timeline_.ActivityStartCh(tname, "RING_CH" + std::to_string(ch), ch + 1);
+    bool ok = RingReduceScatterPhaseCh(base, seg_count, seg_off, dtype, op,
+                                       vrank, ch, err);
+    if (ok) {
+      ok = RingAllgatherPhaseCh(base, seg_count, seg_off, esize, vrank, ch,
+                                err);
+    }
+    timeline_.ActivityEndCh(tname, ch + 1);
+    return ok;
+  }
+  std::vector<ChannelSegs> all(nch);
+  for (int c = 0; c < nch; ++c) {
+    all[c].ch = ctx.channel + c;
+    channel_segs(c, &all[c].seg_count, &all[c].seg_off);
+  }
+  // Driver threads: channels are cheap (a socket pair + cursor state) but
+  // threads are not — one driver can multiplex several channels' cascades
+  // in its poll loop, so the thread count follows the CORE budget
+  // (HOROVOD_CHANNEL_DRIVERS), not the channel count.  A 2-core box runs
+  // 4 channels on 1 driver; a 16-core host splits them across 4.
+  const int drivers =
+      std::max(1, std::min({nch, channel_drivers_, pool_.size() + 1}));
+  auto run_part = [&](const std::vector<ChannelSegs>& part,
+                      std::string* derr) -> bool {
+    for (const auto& cs : part) {
+      timeline_.ActivityStartCh(tname, "RING_CH" + std::to_string(cs.ch),
+                                cs.ch + 1);
+    }
+    bool ok = StreamingRingChannels(base, part, dtype, op, vrank, derr);
+    for (const auto& cs : part) timeline_.ActivityEndCh(tname, cs.ch + 1);
+    return ok;
+  };
+  if (drivers <= 1) {
+    return run_part(all, err);
+  }
+  std::vector<std::vector<ChannelSegs>> parts(drivers);
+  for (int c = 0; c < nch; ++c) {
+    parts[c % drivers].push_back(std::move(all[c]));
+  }
+  std::vector<std::string> derrs(drivers);
+  std::vector<uint8_t> dok(drivers, 0);
+  TaskLatch latch(drivers - 1);
+  for (int d = 1; d < drivers; ++d) {
+    pool_.Submit([&, d] {
+      dok[d] = run_part(parts[d], &derrs[d]) ? 1 : 0;
+      latch.Done();
+    });
+  }
+  dok[0] = run_part(parts[0], &derrs[0]) ? 1 : 0;
+  latch.Wait();
+  for (int d = 0; d < drivers; ++d) {
+    if (!dok[d]) {
+      // First failed driver wins the attribution; a peer death EOFs
+      // every channel to that neighbor, so the messages agree.
+      *err = derrs[d];
+      return false;
+    }
+  }
+  return true;
+}
+
 // Two-level allreduce (HOROVOD_HIERARCHICAL_ALLREDUCE): chain-reduce each
 // node's buffers onto its leader over loopback/shm-speed local links, ring
 // allreduce across the (few) leaders over the real network, then chain-
@@ -1957,6 +2651,7 @@ bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
                                    base + lr - 1, base + lr - 1);
       return false;
     }
+    data_bytes_tx_.fetch_add(static_cast<int64_t>(nbytes));
   } else {
     std::vector<uint8_t> tmp(std::min(nbytes, kRelayChunk));
     uint8_t* p = static_cast<uint8_t*>(data);
@@ -1972,13 +2667,17 @@ bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
                                      base + lr + 1, base + lr + 1);
         return false;
       }
-      ReduceInto(p + eoff * esize, tmp.data(), n_elems, dtype, op);
-      if (lr > 0 && !local_prev_.SendAll(p + eoff * esize, n)) {
-        *status_msg = TransportError("hierarchical allreduce (local reduce)",
-                                     name,
-                                     "send to peer: transport failure",
-                                     base + lr - 1, base + lr - 1);
-        return false;
+      data_bytes_rx_.fetch_add(static_cast<int64_t>(n));
+      ReduceIntoTimed(p + eoff * esize, tmp.data(), n_elems, dtype, op);
+      if (lr > 0) {
+        if (!local_prev_.SendAll(p + eoff * esize, n)) {
+          *status_msg = TransportError(
+              "hierarchical allreduce (local reduce)", name,
+              "send to peer: transport failure", base + lr - 1,
+              base + lr - 1);
+          return false;
+        }
+        data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
       }
     }
   }
@@ -1994,6 +2693,13 @@ bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
                                    name, err, next_leader, prev_leader);
       return false;
     }
+    // A leader's ring moves 2(nnodes-1)/nnodes of the payload each way
+    // (the static RingAllreduce is uninstrumented; segment remainders
+    // make this exact figure off by < one element per segment).
+    int64_t ring_bytes = static_cast<int64_t>(nbytes) * 2 *
+                         (nnodes_ - 1) / nnodes_;
+    data_bytes_tx_.fetch_add(ring_bytes);
+    data_bytes_rx_.fetch_add(ring_bytes);
   }
 
   // 3. Broadcast the result back up the local chain, streamed in chunks.
@@ -2011,6 +2717,7 @@ bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
                                      base + 1, base + 1);
         return false;
       }
+      data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
     } else {
       if (!local_prev_.RecvAllPatient(p + off, n, 2 * nnodes_ + L + 2)) {
         *status_msg = TransportError("hierarchical allreduce (local bcast)",
@@ -2019,12 +2726,16 @@ bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
                                      base + lr - 1, base + lr - 1);
         return false;
       }
-      if (lr < L - 1 && !local_next_.SendAll(p + off, n)) {
-        *status_msg = TransportError("hierarchical allreduce (local bcast)",
-                                     name,
-                                     "send to peer: transport failure",
-                                     base + lr + 1, base + lr + 1);
-        return false;
+      data_bytes_rx_.fetch_add(static_cast<int64_t>(n));
+      if (lr < L - 1) {
+        if (!local_next_.SendAll(p + off, n)) {
+          *status_msg = TransportError(
+              "hierarchical allreduce (local bcast)", name,
+              "send to peer: transport failure", base + lr + 1,
+              base + lr + 1);
+          return false;
+        }
+        data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
       }
     }
   }
@@ -2032,7 +2743,8 @@ bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
 }
 
 void Engine::ExecAllreduce(const Response& response,
-                           std::vector<TensorTableEntry>& entries) {
+                           std::vector<TensorTableEntry>& entries,
+                           const ExecCtx& ctx) {
   const std::string& tname = entries[0].name;
   for (auto& e : entries) timeline_.Start(e.name);
   DataType dtype = entries[0].dtype;
@@ -2042,22 +2754,26 @@ void Engine::ExecAllreduce(const Response& response,
   if (size_ > 1) {
     void* buf = entries[0].data;
     const size_t esize = DataTypeSize(dtype);
+    // Per-slot fusion scratch: ctx.channel doubles as the scratch slot so
+    // concurrent wave responses never share a buffer.
+    std::vector<uint8_t>& fusion_buffer = fusion_buffers_[ctx.channel];
     if (entries.size() > 1) {
       timeline_.ActivityStart(tname, "MEMCPY_IN_FUSION_BUFFER");
-      if (fusion_buffer_.size() < static_cast<size_t>(total) * esize) {
-        fusion_buffer_.resize(static_cast<size_t>(total) * esize);
+      if (fusion_buffer.size() < static_cast<size_t>(total) * esize) {
+        fusion_buffer.resize(static_cast<size_t>(total) * esize);
       }
       int64_t off = 0;
       for (auto& e : entries) {
         size_t n = static_cast<size_t>(e.shape.num_elements()) * esize;
-        memcpy(fusion_buffer_.data() + off, e.data, n);
+        memcpy(fusion_buffer.data() + off, e.data, n);
         off += n;
       }
-      buf = fusion_buffer_.data();
+      buf = fusion_buffer.data();
       timeline_.ActivityEnd(tname);
     }
     bool ok;
     std::string msg;
+    auto t0 = std::chrono::steady_clock::now();
     if (hierarchical_) {
       timeline_.ActivityStart(tname, "HIERARCHICAL_ALLREDUCE");
       ok = HierarchicalAllreduce(buf, total, dtype, response.red_op, tname,
@@ -2065,14 +2781,22 @@ void Engine::ExecAllreduce(const Response& response,
     } else {
       timeline_.ActivityStart(tname, "RING_ALLREDUCE");
       std::string err;
-      ok = RingAllreduce(buf, total, dtype, response.red_op, rank_, size_,
-                         ring_next_, ring_prev_, socket_timeout_sec_ * 1000,
-                         &err);
+      ok = ChanneledRingAllreduce(static_cast<uint8_t*>(buf), total, dtype,
+                                  response.red_op, rank_, ctx, tname, &err);
       if (!ok) {
         msg = TransportError("allreduce", tname, err, (rank_ + 1) % size_,
                              (rank_ - 1 + size_) % size_);
       }
     }
+    int64_t wall = std::chrono::duration_cast<std::chrono::nanoseconds>(
+                       std::chrono::steady_clock::now() - t0)
+                       .count();
+    if (ctx.wave_allreduce_wall_ns != nullptr) {
+      *ctx.wave_allreduce_wall_ns = wall;  // wave accounts the max once
+    } else {
+      allreduce_ns_.fetch_add(wall);
+    }
+    allreduce_bytes_.fetch_add(total * static_cast<int64_t>(esize));
     timeline_.ActivityEnd(tname);
     if (!ok) {
       for (auto& e : entries) FinishEntry(e, Status::Aborted(msg));
@@ -2083,10 +2807,16 @@ void Engine::ExecAllreduce(const Response& response,
       int64_t off = 0;
       for (auto& e : entries) {
         size_t n = static_cast<size_t>(e.shape.num_elements()) * esize;
-        memcpy(e.data, fusion_buffer_.data() + off, n);
+        memcpy(e.data, fusion_buffer.data() + off, n);
         off += n;
       }
       timeline_.ActivityEnd(tname);
+      // High-water cap: a one-off oversized batch (> the fusion
+      // threshold) must not pin its allocation for the process lifetime.
+      if (static_cast<int64_t>(fusion_buffer.capacity()) >
+          fusion_threshold_) {
+        std::vector<uint8_t>().swap(fusion_buffer);
+      }
     }
   }
   for (auto& e : entries) {
@@ -2096,7 +2826,8 @@ void Engine::ExecAllreduce(const Response& response,
 }
 
 void Engine::ExecAllgather(const Response& response,
-                           std::vector<TensorTableEntry>& entries) {
+                           std::vector<TensorTableEntry>& entries,
+                           const ExecCtx& ctx) {
   // Allgather is never fused (matches the reference); one entry.
   TensorTableEntry& e = entries[0];
   timeline_.Start(e.name);
@@ -2130,17 +2861,25 @@ void Engine::ExecAllgather(const Response& response,
   if (size_ > 1) {
     timeline_.ActivityStart(e.name, "RING_ALLGATHER");
     // Circulate blocks around the ring; after size-1 steps everyone has all.
+    Socket& next = ring_next_[ctx.channel];
+    Socket& prev = ring_prev_[ctx.channel];
     std::string err;
     bool failed = false;
     for (int step = 0; step < size_ - 1 && !failed; ++step) {
       int send_block = (rank_ - step + size_) % size_;
       int recv_block = (rank_ - step - 1 + size_) % size_;
-      failed = !SendRecvAll(
-          ring_next_, hs->result.data() + block_off[send_block],
-          static_cast<size_t>(block_bytes[send_block]), ring_prev_,
+      int64_t wns = 0;
+      failed = !SendRecvChunked(
+          next, hs->result.data() + block_off[send_block],
+          static_cast<size_t>(block_bytes[send_block]), prev,
           hs->result.data() + block_off[recv_block],
-          static_cast<size_t>(block_bytes[recv_block]),
-          socket_timeout_sec_ * 1000, &err);
+          static_cast<size_t>(block_bytes[recv_block]), /*chunk=*/0, nullptr,
+          socket_timeout_sec_ * 1000, &err, &wns);
+      wire_ns_.fetch_add(wns);
+      if (!failed) {
+        data_bytes_tx_.fetch_add(block_bytes[send_block]);
+        data_bytes_rx_.fetch_add(block_bytes[recv_block]);
+      }
     }
     timeline_.ActivityEnd(e.name);
     if (failed) {
@@ -2155,11 +2894,14 @@ void Engine::ExecAllgather(const Response& response,
 }
 
 void Engine::ExecBroadcast(const Response& response,
-                           std::vector<TensorTableEntry>& entries) {
+                           std::vector<TensorTableEntry>& entries,
+                           const ExecCtx& ctx) {
   TensorTableEntry& e = entries[0];
   timeline_.Start(e.name);
   if (size_ > 1) {
     timeline_.ActivityStart(e.name, "RING_BROADCAST");
+    Socket& ring_next = ring_next_[ctx.channel];
+    Socket& ring_prev = ring_prev_[ctx.channel];
     size_t nbytes = static_cast<size_t>(e.shape.num_elements()) *
                     DataTypeSize(e.dtype);
     int root = response.root_rank;
@@ -2178,15 +2920,20 @@ void Engine::ExecBroadcast(const Response& response,
     for (size_t off = 0; ok && off < nbytes; off += kRelayChunk) {
       size_t n = std::min(kRelayChunk, nbytes - off);
       if (rank_ == root) {
-        ok = ring_next_.SendAll(p + off, n);
+        ok = ring_next.SendAll(p + off, n);
+        if (ok) data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
         if (!ok) detail = "send to peer: transport failure";
       } else {
-        ok = ring_prev_.RecvAllPatient(p + off, n, hops + 2);
+        ok = ring_prev.RecvAllPatient(p + off, n, hops + 2);
         if (!ok) {
           detail = "recv from peer: transport failure";
-        } else if (forward) {
-          ok = ring_next_.SendAll(p + off, n);
-          if (!ok) detail = "send to peer: transport failure";
+        } else {
+          data_bytes_rx_.fetch_add(static_cast<int64_t>(n));
+          if (forward) {
+            ok = ring_next.SendAll(p + off, n);
+            if (ok) data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
+            if (!ok) detail = "send to peer: transport failure";
+          }
         }
       }
     }
@@ -2203,7 +2950,8 @@ void Engine::ExecBroadcast(const Response& response,
 }
 
 void Engine::ExecReducescatter(const Response& response,
-                               std::vector<TensorTableEntry>& entries) {
+                               std::vector<TensorTableEntry>& entries,
+                               const ExecCtx& ctx) {
   // Never fused; one entry.  Ring reduce-scatter phase only (the first half
   // of the ring allreduce), on a scratch copy so the caller's input stays
   // intact; each rank keeps its own row-aligned segment.
@@ -2243,12 +2991,13 @@ void Engine::ExecReducescatter(const Response& response,
   std::vector<uint8_t> scratch(
       input, input + static_cast<size_t>(off) * esize);
   // vrank = rank-1 so the phase leaves THIS rank owning segment `rank`
-  // (see RingReduceScatterPhase).
+  // (see RingReduceScatterPhaseCh); single-channel on the ctx's channel —
+  // reducescatter payloads are small on this host plane, and the chunked
+  // phase already overlaps its recv and reduce.
   std::string err;
-  bool ok = RingReduceScatterPhase(
+  bool ok = RingReduceScatterPhaseCh(
       scratch.data(), seg_count, seg_off, e.dtype, response.red_op,
-      (rank_ - 1 + size_) % size_, size_, ring_next_, ring_prev_,
-      socket_timeout_sec_ * 1000, &err);
+      (rank_ - 1 + size_) % size_, ctx.channel, &err);
   timeline_.ActivityEnd(e.name);
   if (!ok) {
     FinishEntry(e, Status::Aborted(TransportError(
@@ -2264,7 +3013,8 @@ void Engine::ExecReducescatter(const Response& response,
 }
 
 void Engine::ExecAlltoall(const Response& response,
-                          std::vector<TensorTableEntry>& entries) {
+                          std::vector<TensorTableEntry>& entries,
+                          const ExecCtx& ctx) {
   // Ring-rotation alltoall: circulate each rank's full input around the
   // ring; at step t a rank holds the input of rank (rank - t) and keeps
   // the block addressed to it.  Link traffic is (size-1)·input — fine for
@@ -2294,9 +3044,11 @@ void Engine::ExecAlltoall(const Response& response,
     timeline_.ActivityStart(e.name, "RING_ALLTOALL");
     std::vector<uint8_t> cur(input, input + static_cast<size_t>(total) * esize);
     std::vector<uint8_t> nxt(cur.size());
+    Socket& next = ring_next_[ctx.channel];
+    Socket& prev = ring_prev_[ctx.channel];
     for (int step = 1; step < size_; ++step) {
       std::string err;
-      if (!SendRecvAll(ring_next_, cur.data(), cur.size(), ring_prev_,
+      if (!SendRecvAll(next, cur.data(), cur.size(), prev,
                        nxt.data(), nxt.size(), socket_timeout_sec_ * 1000,
                        &err)) {
         timeline_.ActivityEnd(e.name);
@@ -2305,6 +3057,8 @@ void Engine::ExecAlltoall(const Response& response,
             (rank_ - 1 + size_) % size_)));
         return;
       }
+      data_bytes_tx_.fetch_add(static_cast<int64_t>(cur.size()));
+      data_bytes_rx_.fetch_add(static_cast<int64_t>(nxt.size()));
       int src = (rank_ - step + size_) % size_;
       memcpy(hs->result.data() + src * block_bytes,
              nxt.data() + rank_ * block_bytes, block_bytes);

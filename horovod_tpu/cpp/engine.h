@@ -31,6 +31,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
+#include <functional>
 #include <memory>
 #include <mutex>
 #include <set>
@@ -64,6 +65,52 @@ struct HandleState {
   // operations.cc:796-856): buffered here, copied out by the caller.
   std::vector<uint8_t> result;
   std::vector<int64_t> result_shape;
+};
+
+// Small data-plane thread pool (HOROVOD_NUM_CHANNELS workers): drives the
+// per-channel ring shards of a sharded collective, executes independent
+// responses of one cycle concurrently, and lends idle workers to large
+// reductions.  Tasks must be data-plane leaves or channel drivers — the
+// only nested use is TrySubmitIfIdle (which never queues behind a busy
+// worker), so the pool cannot deadlock on itself.
+class DataPool {
+ public:
+  ~DataPool() { Stop(); }
+  void Start(int nthreads);
+  void Stop();
+  void Submit(std::function<void()> fn);
+  // Enqueue only if an idle worker can take the task right now; the
+  // caller runs it inline otherwise.  Safe to call from a pool task.
+  bool TrySubmitIfIdle(std::function<void()> fn);
+  int size() const { return static_cast<int>(threads_.size()); }
+
+ private:
+  void Loop();
+  std::vector<std::thread> threads_;
+  std::deque<std::function<void()>> q_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int idle_ = 0;
+  bool stop_ = false;
+};
+
+// Completion latch for a batch of pool tasks.
+class TaskLatch {
+ public:
+  explicit TaskLatch(int n) : n_(n) {}
+  void Done() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (--n_ <= 0) cv_.notify_all();
+  }
+  void Wait() {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_.wait(lk, [&] { return n_ <= 0; });
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int n_;
 };
 
 class Engine {
@@ -133,6 +180,25 @@ class Engine {
   // membership epoch than this rank's committed one (a delayed message
   // from a dead incarnation after an elastic resize).
   int64_t stale_epoch_msgs() const { return stale_epoch_msgs_.load(); }
+
+  // Data-plane observability.  `data_bytes_tx/rx` sum payload bytes this
+  // process moved over ring data sockets (all collective types, all
+  // channels); `wire_ns` is cumulative time threads spent progressing
+  // data sockets (poll/send/recv) and `reduce_ns` cumulative time inside
+  // reduction kernels — both sum ACROSS channels/threads, so either may
+  // exceed wall time when channels overlap.  `allreduce_bytes`/
+  // `allreduce_ns` sum ring-allreduce payload bytes and wall time; the
+  // Python stats() derives allreduce_bus_bw_bytes_per_sec =
+  // 2(N-1)/N · bytes / wall from them.  `num_channels` is the COMMITTED
+  // per-edge channel count (the coordinator's HOROVOD_NUM_CHANNELS wins
+  // at rendezvous so every rank wires the same fan-out).
+  int64_t data_bytes_tx() const { return data_bytes_tx_.load(); }
+  int64_t data_bytes_rx() const { return data_bytes_rx_.load(); }
+  int64_t reduce_ns() const { return reduce_ns_.load(); }
+  int64_t wire_ns() const { return wire_ns_.load(); }
+  int64_t allreduce_bytes() const { return allreduce_bytes_.load(); }
+  int64_t allreduce_ns() const { return allreduce_ns_.load(); }
+  int num_channels() const { return num_channels_; }
 
   // Why the engine aborted ("" while healthy or after a clean shutdown).
   // Safe to call from any thread: the background thread publishes
@@ -204,17 +270,98 @@ class Engine {
   ResponseList CoordinatorStep(std::vector<RequestList>& lists);
   Response BuildResponse(const std::string& name);
   void FuseResponses(std::vector<Response>& responses);
-  void PerformResponse(const Response& response);
+  // Which slice of the channel fan-out an execution owns: channels
+  // [channel, channel + nchannels).  The serial path passes the full
+  // range; a concurrent wave hands each response ONE channel so their
+  // wire streams live on disjoint socket pairs.  `channel` also indexes
+  // the fusion scratch slot, keeping concurrent fused batches off each
+  // other's buffers.
+  struct ExecCtx {
+    int channel = 0;
+    int nchannels = 1;
+    // Non-null when this response is one slice of a concurrent wave:
+    // an allreduce slice writes its wall time here instead of adding it
+    // to allreduce_ns_, and ExecuteResponses accounts the MAX across
+    // the wave's slices once — thread-summing would inflate
+    // allreduce_ns by the concurrency factor, and charging the whole
+    // wave's wall would pollute it with co-scheduled non-allreduce
+    // responses; either way the derived bus bandwidth would lie.
+    int64_t* wave_allreduce_wall_ns = nullptr;
+  };
+  // Execute one cycle's agreed responses.  Flat-ring worlds with
+  // multiple channels run independent responses concurrently in waves of
+  // num_channels_ (assignment by list index — identical on every rank,
+  // so cross-rank wire order stays deterministic); everything else
+  // (C == 1, hierarchical, single response) executes serially with the
+  // full channel range.
+  void ExecuteResponses(std::vector<Response>& responses);
+  void PerformResponse(const Response& response, const ExecCtx& ctx);
   void ExecAllreduce(const Response& response,
-                     std::vector<TensorTableEntry>& entries);
+                     std::vector<TensorTableEntry>& entries,
+                     const ExecCtx& ctx);
   void ExecAllgather(const Response& response,
-                     std::vector<TensorTableEntry>& entries);
+                     std::vector<TensorTableEntry>& entries,
+                     const ExecCtx& ctx);
   void ExecBroadcast(const Response& response,
-                     std::vector<TensorTableEntry>& entries);
+                     std::vector<TensorTableEntry>& entries,
+                     const ExecCtx& ctx);
   void ExecReducescatter(const Response& response,
-                         std::vector<TensorTableEntry>& entries);
+                         std::vector<TensorTableEntry>& entries,
+                         const ExecCtx& ctx);
   void ExecAlltoall(const Response& response,
-                    std::vector<TensorTableEntry>& entries);
+                    std::vector<TensorTableEntry>& entries,
+                    const ExecCtx& ctx);
+  // Ring allreduce sharded across the ctx's channels.  Channel shards
+  // slice WITHIN each ring segment (never re-segment the raw element
+  // range), so an element's segment id — and therefore the rank order
+  // its reduction applies in — is independent of the channel count:
+  // results are bit-identical for any fan-out, 1..N.
+  bool ChanneledRingAllreduce(uint8_t* base, int64_t count, DataType dtype,
+                              ReduceOp op, int vrank, const ExecCtx& ctx,
+                              const std::string& tname, std::string* err);
+  // One channel's chunk-pipelined ring phases over explicit per-segment
+  // counts/offsets (absolute element offsets into `base`).
+  bool RingReduceScatterPhaseCh(uint8_t* base,
+                                const std::vector<int64_t>& seg_count,
+                                const std::vector<int64_t>& seg_off,
+                                DataType dtype, ReduceOp op, int vrank,
+                                int ch, std::string* err);
+  bool RingAllgatherPhaseCh(uint8_t* base,
+                            const std::vector<int64_t>& seg_count,
+                            const std::vector<int64_t>& seg_off,
+                            size_t esize, int vrank, int ch,
+                            std::string* err);
+  // A set of channels' ENTIRE allreduces (reduce-scatter + allgather),
+  // each a chunk-granular streaming cascade, multiplexed in ONE poll
+  // loop: the send of chunk k at step s+1 becomes eligible the moment
+  // chunk k of step s is received (and, in the reduce-scatter half,
+  // reduced) — no per-step barrier anywhere, so a scheduling hiccup on
+  // one rank costs one chunk of pipeline depth, not a whole segment
+  // round — and one driver thread services whichever channel has work,
+  // so channel fan-out never forces thread fan-out (decisive on small
+  // hosts; big hosts split channels across pool drivers).  Values are
+  // bit-identical to the stepped phases: same segments, same reduction
+  // order per element; chunk edges only change WHEN a reduction runs,
+  // never what it computes.  Per-channel segment tables are indexed
+  // [channel][segment] with absolute element offsets into `base`.
+  struct ChannelSegs {
+    int ch = 0;  // global channel id (socket index)
+    std::vector<int64_t> seg_count, seg_off;
+  };
+  bool StreamingRingChannels(uint8_t* base,
+                             const std::vector<ChannelSegs>& channels,
+                             DataType dtype, ReduceOp op, int vrank,
+                             std::string* err);
+  // ReduceInto + reduce_ns accounting; splits reductions at or above
+  // max(2 MB, 2x the pipeline chunk) across idle pool workers (disjoint
+  // element ranges — bit-equal to serial; pipeline-chunk reduces stay
+  // serial because they already overlap the wire).
+  void ReduceIntoTimed(void* dst, const void* src, int64_t count,
+                       DataType dtype, ReduceOp op);
+  // Free the fusion scratch high-water allocations (idle for a while, or
+  // teardown); cheap no-op when nothing is held.
+  void ReleaseScratch();
+  void MaybeReleaseScratch();
   void FinishEntry(TensorTableEntry& e, const Status& s);
   void CheckForStalledTensors();
   void CloseSockets();
@@ -397,7 +544,12 @@ class Engine {
   Socket control_listener_;                // rank 0
   std::vector<Socket> worker_conns_;       // rank 0: [size-1] control conns
   Socket coordinator_conn_;                // rank != 0
-  Socket ring_next_, ring_prev_;           // data plane neighbors (global)
+  // Data-plane neighbors (global ring), one independent socket pair per
+  // channel (HOROVOD_NUM_CHANNELS; the committed count is broadcast in
+  // the rendezvous ASSIGN so every rank wires the same fan-out, and the
+  // channel handshake is epoch-stamped so an elastic re-rendezvous
+  // rewires every channel of the new incarnation only).
+  std::vector<Socket> ring_next_, ring_prev_;
   Socket data_listener_;
 
   // -- hierarchical (two-level) allreduce --
@@ -414,8 +566,32 @@ class Engine {
                              ReduceOp op, const std::string& name,
                              std::string* status_msg);
 
-  // -- fusion scratch --
-  std::vector<uint8_t> fusion_buffer_;
+  // -- data plane: channels / pool / chunking knobs --
+  // Committed per-edge channel count.  The env default is auto from core
+  // count (1 restores the single-socket path exactly); the coordinator's
+  // value is broadcast at rendezvous so all ranks agree.
+  int num_channels_ = 1;
+  // HOROVOD_SOCKET_BUF_BYTES: SO_SNDBUF/SO_RCVBUF for ring data sockets
+  // (0 = kernel default).  Bigger buffers keep the wire moving while
+  // userland reduces — the kernel-side half of wire/compute overlap.
+  int socket_buf_bytes_ = 0;
+  // HOROVOD_CHUNK_BYTES: ring-phase pipeline chunk (recv of chunk k+1
+  // overlaps the ReduceInto of chunk k); multiple of 8 so chunk edges
+  // align to every dtype.
+  int64_t chunk_bytes_ = 1 << 20;
+  // HOROVOD_CHANNEL_DRIVERS: how many threads actively drive the channel
+  // fan-out of ONE collective (default auto: one per core).  Channels
+  // above this count are multiplexed within a driver's poll loop, so
+  // adding channels never oversubscribes a small host.
+  int channel_drivers_ = 1;
+  DataPool pool_;
+
+  // -- fusion scratch (one slot per channel: a concurrent wave gives each
+  //    response its own buffer; slot 0 serves the serial path).  Capped
+  //    at HOROVOD_FUSION_THRESHOLD and released after a 2 s idle spell or
+  //    at teardown, so the high-water allocation is not retained forever. --
+  std::vector<std::vector<uint8_t>> fusion_buffers_;
+  std::chrono::steady_clock::time_point last_exec_time_;
 
   // -- execution stats --
   std::atomic<int64_t> exec_cycles_{0};
@@ -428,6 +604,12 @@ class Engine {
   std::atomic<int64_t> negotiation_bytes_rx_{0};
   std::atomic<int64_t> control_round_trips_{0};
   std::atomic<int64_t> stale_epoch_msgs_{0};
+  std::atomic<int64_t> data_bytes_tx_{0};
+  std::atomic<int64_t> data_bytes_rx_{0};
+  std::atomic<int64_t> reduce_ns_{0};
+  std::atomic<int64_t> wire_ns_{0};
+  std::atomic<int64_t> allreduce_bytes_{0};
+  std::atomic<int64_t> allreduce_ns_{0};
 
   // -- timeline --
   Timeline timeline_;

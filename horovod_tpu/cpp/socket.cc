@@ -43,6 +43,12 @@ void Socket::SetTimeouts(int timeout_sec) {
   ::setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
 }
 
+void Socket::SetBufSizes(int bytes) {
+  if (fd_ < 0 || bytes <= 0) return;
+  ::setsockopt(fd_, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes));
+  ::setsockopt(fd_, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes));
+}
+
 void Socket::EnableKeepalive() {
   if (fd_ < 0) return;
   int one = 1;
@@ -138,28 +144,14 @@ static void SetNoDelay(int fd) {
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
-namespace {
+NonblockGuard::NonblockGuard(int fd)
+    : fd_(fd), flags_(::fcntl(fd, F_GETFL, 0)) {
+  if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_ | O_NONBLOCK);
+}
 
-// Scoped O_NONBLOCK toggle: SendRecvAll multiplexes with poll and must not
-// block inside send/recv, and Accept must not block inside accept(2) when
-// the pending connection vanishes between poll and accept; the blocking
-// mode is restored on exit so the frame-based control plane keeps its
-// simple blocking reads.
-class NonblockGuard {
- public:
-  explicit NonblockGuard(int fd) : fd_(fd), flags_(::fcntl(fd, F_GETFL, 0)) {
-    if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_ | O_NONBLOCK);
-  }
-  ~NonblockGuard() {
-    if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_);
-  }
-
- private:
-  int fd_;
-  int flags_;
-};
-
-}  // namespace
+NonblockGuard::~NonblockGuard() {
+  if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_);
+}
 
 Socket Listen(const std::string& host, int port, int backlog,
               int* bound_port, std::string* error) {
@@ -301,8 +293,41 @@ Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
 bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
                  Socket& rcv, void* recv_buf, size_t rn,
                  int timeout_ms, std::string* err) {
+  return SendRecvChunked(snd, send_buf, sn, rcv, recv_buf, rn, /*chunk=*/0,
+                         /*on_chunk=*/nullptr, timeout_ms, err);
+}
+
+bool SendRecvChunked(Socket& snd, const void* send_buf, size_t sn,
+                     Socket& rcv, void* recv_buf, size_t rn, size_t chunk,
+                     const std::function<void(size_t, size_t)>& on_chunk,
+                     int timeout_ms, std::string* err, int64_t* wire_ns) {
   const char* sp = static_cast<const char*>(send_buf);
   char* rp = static_cast<char*>(recv_buf);
+  const size_t rtotal = rn;
+  // Receive bytes already handed to on_chunk; the poll loop fires the
+  // callback whenever a whole chunk (or the final partial one) is in.
+  size_t delivered = 0;
+  if (chunk == 0) chunk = rtotal;  // single callback at the end
+  auto t0 = std::chrono::steady_clock::now();
+  auto deliver_ready = [&] {
+    if (!on_chunk) return;
+    size_t done = rtotal - rn;
+    while (delivered < done &&
+           (done - delivered >= chunk || rn == 0)) {
+      size_t len = std::min(chunk, done - delivered);
+      if (wire_ns != nullptr) {
+        auto now = std::chrono::steady_clock::now();
+        *wire_ns += std::chrono::duration_cast<std::chrono::nanoseconds>(
+                        now - t0)
+                        .count();
+        on_chunk(delivered, len);
+        t0 = std::chrono::steady_clock::now();
+      } else {
+        on_chunk(delivered, len);
+      }
+      delivered += len;
+    }
+  };
   NonblockGuard g1(snd.fd());
   NonblockGuard g2(rcv.fd());
   while (sn > 0 || rn > 0) {
@@ -349,6 +374,7 @@ bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
       if (k > 0) {
         rp += k;
         rn -= static_cast<size_t>(k);
+        deliver_ready();
       } else if (k == 0) {
         *err = "recv from peer: connection closed (peer process exited?)";
         return false;
@@ -357,6 +383,11 @@ bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
         return false;
       }
     }
+  }
+  if (wire_ns != nullptr) {
+    auto now = std::chrono::steady_clock::now();
+    *wire_ns +=
+        std::chrono::duration_cast<std::chrono::nanoseconds>(now - t0).count();
   }
   return true;
 }

@@ -9,6 +9,7 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
 #include <string>
 #include <vector>
 
@@ -33,6 +34,11 @@ class Socket {
   // were invisible).  Timeout 0 = never time out.
   void SetTimeouts(int timeout_sec);
   void EnableKeepalive();
+  // SO_SNDBUF/SO_RCVBUF for data-plane sockets (HOROVOD_SOCKET_BUF_BYTES).
+  // Bigger buffers let the kernel keep the wire busy while userland is in
+  // a reduction kernel — the cheap half of wire/compute overlap.  0 = keep
+  // the kernel default.
+  void SetBufSizes(int bytes);
 
   // Blocking helpers; return false on error/EOF/timeout.
   bool SendAll(const void* data, size_t n);
@@ -60,6 +66,22 @@ class Socket {
   int fd_;
 };
 
+// Scoped O_NONBLOCK toggle: poll-multiplexed loops (SendRecvAll, the
+// engine's streaming cascade) must not block inside send/recv/accept;
+// the blocking mode is restored on destruction so the frame-based
+// control plane keeps its simple blocking reads.
+class NonblockGuard {
+ public:
+  explicit NonblockGuard(int fd);
+  ~NonblockGuard();
+  NonblockGuard(const NonblockGuard&) = delete;
+  NonblockGuard& operator=(const NonblockGuard&) = delete;
+
+ private:
+  int fd_;
+  int flags_;
+};
+
 // Full-duplex transfer: send `sn` bytes on `snd` while receiving `rn` bytes
 // from `rcv`, multiplexed with poll(2) on nonblocking fds.  This replaces
 // the thread-per-send pattern on the ring hot path (2(N-1) thread spawns
@@ -70,6 +92,23 @@ class Socket {
 bool SendRecvAll(Socket& snd, const void* send_buf, size_t sn,
                  Socket& rcv, void* recv_buf, size_t rn,
                  int timeout_ms, std::string* err);
+
+// SendRecvAll with chunk-pipelined receive processing: every time the
+// receive side completes another `chunk` bytes (and once more for the
+// final partial chunk), `on_chunk(offset, len)` is invoked from the same
+// thread BEFORE the poll loop resumes.  While the callback runs (e.g. a
+// ReduceInto of chunk k), the kernel keeps draining/filling both socket
+// buffers, so wire time overlaps compute time without any extra thread —
+// the ring-phase analogue of HierarchicalAllreduce's chunked local chain.
+// `chunk == 0` (or >= rn) degenerates to one callback after the full
+// receive.  When non-null, `wire_ns` accumulates time spent progressing
+// the sockets (poll/send/recv, callback time excluded) so callers can
+// split a collective's wall time into wire vs. reduce.
+bool SendRecvChunked(Socket& snd, const void* send_buf, size_t sn,
+                     Socket& rcv, void* recv_buf, size_t rn, size_t chunk,
+                     const std::function<void(size_t, size_t)>& on_chunk,
+                     int timeout_ms, std::string* err,
+                     int64_t* wire_ns = nullptr);
 
 // Listen on host:port (port 0 = ephemeral). Returns listening socket and
 // fills *bound_port.

@@ -50,9 +50,10 @@ int Timeline::TensorPid(const std::string& name) {
 }
 
 void Timeline::WriteEvent(int pid, char phase, const std::string& category,
-                          const std::string& op_name) {
-  std::fprintf(file_, "{\"ph\": \"%c\", \"ts\": %lld, \"pid\": %d",
-               phase, static_cast<long long>(NowUs()), pid);
+                          const std::string& op_name, int tid) {
+  std::fprintf(file_, "{\"ph\": \"%c\", \"ts\": %lld, \"pid\": %d, "
+               "\"tid\": %d",
+               phase, static_cast<long long>(NowUs()), pid, tid);
   if (!category.empty()) {
     std::fprintf(file_, ", \"cat\": \"%s\"", category.c_str());
   }
@@ -113,6 +114,19 @@ void Timeline::ActivityEnd(const std::string& name) {
   std::lock_guard<std::recursive_mutex> lk(mu_);
   if (file_ == nullptr) return;
   WriteEvent(TensorPid(name), 'E', "ACTIVITY");
+}
+
+void Timeline::ActivityStartCh(const std::string& name,
+                               const std::string& activity, int tid) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'B', "ACTIVITY", activity, tid);
+}
+
+void Timeline::ActivityEndCh(const std::string& name, int tid) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'E', "ACTIVITY", "", tid);
 }
 
 void Timeline::End(const std::string& name, DataType dtype,

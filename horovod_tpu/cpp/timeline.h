@@ -32,6 +32,13 @@ class Timeline {
   void Start(const std::string& name);                    // top-level op
   void ActivityStart(const std::string& name, const std::string& activity);
   void ActivityEnd(const std::string& name);
+  // Per-channel activity spans: each data-plane channel gets its own
+  // trace "thread" (tid) under the tensor's pid, so concurrent channel
+  // shards render as parallel tracks instead of corrupting the main
+  // track's B/E nesting (tid 0 stays reserved for the op-level spans).
+  void ActivityStartCh(const std::string& name, const std::string& activity,
+                       int tid);
+  void ActivityEndCh(const std::string& name, int tid);
   void End(const std::string& name, DataType dtype, const std::string& shape);
 
   ~Timeline();
@@ -40,7 +47,7 @@ class Timeline {
   int64_t NowUs() const;
   int TensorPid(const std::string& name);
   void WriteEvent(int pid, char phase, const std::string& category,
-                  const std::string& op_name = "");
+                  const std::string& op_name = "", int tid = 0);
   void FlushIfDue();
 
   FILE* file_ = nullptr;

@@ -138,7 +138,14 @@ class NativeEngine:
                         "horovod_negotiation_bytes_rx",
                         "horovod_control_round_trips",
                         "horovod_stale_epoch_msgs",
-                        "horovod_epoch"):
+                        "horovod_epoch",
+                        "horovod_data_bytes_tx",
+                        "horovod_data_bytes_rx",
+                        "horovod_reduce_ns",
+                        "horovod_wire_ns",
+                        "horovod_allreduce_bytes",
+                        "horovod_allreduce_ns",
+                        "horovod_num_channels"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
                 fn.restype = ctypes.c_int64
@@ -302,14 +309,34 @@ class NativeEngine:
         ``control_round_trips`` counts coordinator exchanges that carried
         negotiation payload (idle heartbeats excluded) — divide its delta
         by the step count to verify steady state runs at ~1 round trip
-        per step."""
+        per step.
+
+        Data plane (multi-channel rings, HOROVOD_NUM_CHANNELS):
+        ``data_bytes_tx``/``_rx`` sum payload bytes this process moved
+        over ring data sockets (all collective types, all channels);
+        ``wire_ns`` is cumulative thread-time progressing data sockets
+        and ``reduce_ns`` cumulative thread-time inside reduction
+        kernels — both sum ACROSS channels, so either may exceed wall
+        time when channels overlap (that's the point);
+        ``allreduce_bytes``/``allreduce_ns`` sum ring-allreduce payload
+        and wall time, and ``allreduce_bus_bw_bytes_per_sec`` is the
+        derived cumulative bus bandwidth 2(N-1)/N · bytes / wall (the
+        NCCL busbw convention — comparable across world sizes);
+        ``num_channels`` is the committed per-edge channel fan-out."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_stale_epoch_msgs", None),
+        if getattr(getattr(self._lib, "horovod_wire_ns", None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the execution/control-plane "
-                "counters — rebuild it with `make -C horovod_tpu/cpp`")
+                "libhorovod_core.so predates the execution/control-plane/"
+                "data-plane counters — rebuild it with "
+                "`make -C horovod_tpu/cpp`")
+        size = self._lib.horovod_size()
+        ar_bytes = self._lib.horovod_allreduce_bytes()
+        ar_ns = self._lib.horovod_allreduce_ns()
+        bus_bw = 0.0
+        if ar_ns > 0 and size > 1:
+            bus_bw = (ar_bytes * 2.0 * (size - 1) / size) / (ar_ns / 1e9)
         return {
             "cycles": self._lib.horovod_exec_cycles(),
             "responses": self._lib.horovod_responses_executed(),
@@ -325,6 +352,14 @@ class NativeEngine:
                 self._lib.horovod_control_round_trips(),
             "stale_epoch_msgs":
                 self._lib.horovod_stale_epoch_msgs(),
+            "data_bytes_tx": self._lib.horovod_data_bytes_tx(),
+            "data_bytes_rx": self._lib.horovod_data_bytes_rx(),
+            "reduce_ns": self._lib.horovod_reduce_ns(),
+            "wire_ns": self._lib.horovod_wire_ns(),
+            "allreduce_bytes": ar_bytes,
+            "allreduce_ns": ar_ns,
+            "allreduce_bus_bw_bytes_per_sec": bus_bw,
+            "num_channels": self._lib.horovod_num_channels(),
         }
 
     # -- handle API --

@@ -501,6 +501,158 @@ def scenario_stale_epoch(rank, size, eng):
     assert eng.epoch() >= 1
 
 
+def _parity_cases(rank, size):
+    """Deterministic per-rank payloads covering every wire dtype, odd and
+    prime element counts SMALLER than channels*size (empty channel slices
+    and segments), plus buffers big enough to actually shard across the
+    channel fan-out (>= kMinBytesPerChannel per channel)."""
+    rng = np.random.default_rng(1000 + rank)
+    cases = []
+    dtypes = [np.float32, np.float64, np.int32, np.int64, np.uint8,
+              np.int8, np.uint16, np.int16, np.float16]
+    try:
+        import ml_dtypes
+
+        dtypes.append(ml_dtypes.bfloat16)
+    except ImportError:
+        pass
+    # bfloat16 registers as a structured ('V') dtype in numpy, so "is
+    # this a float" must go through the dtype NAME, not kind — with the
+    # kind check alone the bf16 payloads silently degrade to small
+    # integers that never round, and the parity test passes vacuously.
+    def is_float(dt):
+        return np.dtype(dt).kind == "f" or np.dtype(dt).name == "bfloat16"
+
+    ops = ["sum", "min", "max"]
+    for d, dt in enumerate(dtypes):
+        for n in (1, 3, 7, 13, 61):
+            if is_float(dt):
+                arr = rng.standard_normal(n).astype(dt)
+            else:
+                arr = rng.integers(0, 7, n).astype(dt)
+            cases.append((arr, ops[(d + n) % 3]))
+    # prod stays in range on tiny values
+    cases.append(((rng.integers(1, 3, 17)).astype(np.float32), "prod"))
+    cases.append(((rng.integers(1, 3, 5)).astype(np.int64), "prod"))
+    cases.append((rng.integers(0, 2, 97) > 0, "sum"))   # bool or
+    cases.append((rng.integers(0, 2, 11) > 0, "min"))   # bool and
+    # Large enough to engage real multi-channel sharding (fp32 4 MB ->
+    # 4 channels; 16-bit floats 1 MB -> 2) and the chunk pipeline.
+    cases.append((rng.standard_normal(1 << 20).astype(np.float32), "sum"))
+    cases.append((rng.standard_normal(1 << 19).astype(np.float16), "sum"))
+    try:
+        import ml_dtypes
+
+        cases.append(
+            (rng.standard_normal(1 << 19).astype(ml_dtypes.bfloat16),
+             "sum"))
+    except ImportError:
+        pass
+    cases.append((rng.integers(0, 100, 200003).astype(np.int32), "sum"))
+    return cases
+
+
+def _parity_run(eng, cases, tag):
+    outs = []
+    for i, (arr, op) in enumerate(cases):
+        outs.append(eng.allreduce(arr.copy(), name=f"par.{tag}.{i}",
+                                  red_op=op))
+    # Fused burst: same dtype back-to-back so the coordinator fuses them
+    # into one ring collective over the shared fusion buffer.
+    handles = [
+        eng.enqueue_allreduce(
+            np.asarray(cases[0][0], np.float32).copy() + i,
+            name=f"par.{tag}.fused.{i}")
+        for i in range(9)
+    ]
+    outs.extend(eng.synchronize(h) for h in handles)
+    return outs
+
+
+def scenario_channels_parity(rank, size, eng):
+    # Bit-exactness of the multi-channel data plane: the run under the
+    # test-set HOROVOD_NUM_CHANNELS (>1: streaming cascade, sharded rings)
+    # must be BIT-IDENTICAL to channels=1 (the stepped legacy path) for
+    # every dtype/op — channel shards slice within ring segments, so the
+    # per-element reduction order is fan-out-independent by construction.
+    cases = _parity_cases(rank, size)
+    multi = _parity_run(eng, cases, "n")
+    stats = eng.stats()
+    assert stats["num_channels"] == int(
+        os.environ.get("HOROVOD_NUM_CHANNELS", "0") or 0), stats
+    basics.shutdown()
+    os.environ["HOROVOD_NUM_CHANNELS"] = "1"
+    basics.init()
+    single = _parity_run(eng, cases, "1")
+    assert eng.stats()["num_channels"] == 1
+    for i, (m, s) in enumerate(zip(multi, single)):
+        assert m.dtype == s.dtype and m.shape == s.shape, (i, m.shape)
+        assert m.tobytes() == s.tobytes(), (
+            f"case {i}: channels=N differs from channels=1 "
+            f"(dtype {m.dtype})")
+    # Spot-check against numpy for the order-independent ops (min/max are
+    # bitwise order-free; integer sums are exact).  Every rank's payload
+    # is deterministic, so each rank rebuilds all peers' inputs locally.
+    peer_cases = [cases if r == rank else _parity_cases(r, size)
+                  for r in range(size)]
+    for i, (arr, op) in enumerate(cases):
+        floatish = (np.dtype(arr.dtype).kind == "f"
+                    or np.dtype(arr.dtype).name == "bfloat16")
+        if op not in ("min", "max") and floatish:
+            continue  # rounding-order-sensitive: parity covers these
+        ref_in = [np.asarray(peer_cases[r][i][0]) for r in range(size)]
+        if np.dtype(arr.dtype).kind == "b":
+            # Wire semantics: sum/max = logical or, min/prod = logical and.
+            stack = np.stack(ref_in)
+            ref = stack.any(0) if op in ("sum", "max") else stack.all(0)
+            assert np.array_equal(single[i], ref), (i, op)
+            continue
+        stack = np.stack([np.asarray(a, np.float64) for a in ref_in])
+        ref = {"sum": stack.sum(0), "min": stack.min(0),
+               "max": stack.max(0), "prod": stack.prod(0)}[op]
+        got = np.asarray(single[i], np.float64)
+        assert np.allclose(got, ref), (i, op, arr.dtype)
+
+
+def scenario_channels_stats(rank, size, eng):
+    # Data-plane counters: an 8 MB allreduce must move ~2(N-1)/N of its
+    # payload per rank over the ring sockets, split wall time into
+    # wire/reduce, and yield a positive derived bus bandwidth.
+    before = eng.stats()
+    n = (8 << 20) // 4
+    x = np.ones(n, dtype=np.float32)
+    out = eng.allreduce(x, name="dp.stats")
+    assert np.allclose(out, float(size))
+    after = eng.stats()
+    nbytes = n * 4
+    expect_wire = nbytes * 2 * (size - 1) / size
+    dtx = after["data_bytes_tx"] - before["data_bytes_tx"]
+    drx = after["data_bytes_rx"] - before["data_bytes_rx"]
+    # Ring segment remainders make the exact figure off by < 1%.
+    assert abs(dtx - expect_wire) < 0.02 * expect_wire + 4096, (
+        dtx, expect_wire)
+    assert abs(drx - expect_wire) < 0.02 * expect_wire + 4096, (
+        drx, expect_wire)
+    assert after["wire_ns"] > before["wire_ns"]
+    assert after["reduce_ns"] > before["reduce_ns"]
+    assert after["allreduce_bytes"] - before["allreduce_bytes"] == nbytes
+    assert after["allreduce_ns"] > before["allreduce_ns"]
+    assert after["allreduce_bus_bw_bytes_per_sec"] > 0
+    want_ch = int(os.environ.get("HOROVOD_NUM_CHANNELS", "0") or 0)
+    if want_ch:
+        assert after["num_channels"] == want_ch, after
+
+
+def scenario_channels_big(rank, size, eng):
+    # A few 8 MB allreduces: enough payload that every configured channel
+    # carries a shard (timeline shows the per-channel RING_CH tracks).
+    n = (8 << 20) // 4
+    for i in range(3):
+        x = np.full(n, float(rank + i), dtype=np.float32)
+        out = eng.allreduce(x, name=f"dp.big.{i}")
+        assert np.allclose(out, sum(r + i for r in range(size))), out[0]
+
+
 SCENARIOS = {
     "allreduce": scenario_allreduce,
     "fused": scenario_fused,
@@ -526,6 +678,9 @@ SCENARIOS = {
     "cache_restart": scenario_cache_restart,
     "cache_fault_reinit": scenario_cache_fault_reinit,
     "stale_epoch": scenario_stale_epoch,
+    "channels_parity": scenario_channels_parity,
+    "channels_stats": scenario_channels_stats,
+    "channels_big": scenario_channels_big,
     "all": None,
 }
 

@@ -1,0 +1,94 @@
+"""Multi-channel data-plane tests (HOROVOD_NUM_CHANNELS et al.).
+
+The pipelined data plane shards a collective across N independent socket
+pairs per ring edge and streams chunk-granular reduce/forward cascades
+over them.  These tests pin down its one non-negotiable property — the
+results are BIT-IDENTICAL to the single-channel path for every wire
+dtype and reduction op, fused and unfused, at awkward element counts —
+plus the observability counters, the per-channel timeline tracks, and
+the tuning knobs' plumbing.  Fault/elastic interactions with channels>1
+live in test_fault_tolerance.py (``fault`` marker, hard-timeout gate).
+"""
+
+import json
+
+import pytest
+
+from tests.test_native_engine import run_workers
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_channels_bitwise_parity(n):
+    """channels=4 vs channels=1, bitwise, across every dtype (incl.
+    fp16/bf16/bool), sum/min/max/prod, odd and prime counts smaller than
+    channels*size, fused bursts, and multi-MB sharded buffers — plus a
+    numpy cross-check for the order-independent cases.  The worker runs
+    both configurations in-process (shutdown + re-init) and compares raw
+    bytes."""
+    run_workers(n, "channels_parity",
+                extra_env={"HOROVOD_NUM_CHANNELS": "4"}, timeout=300)
+
+
+def test_channels_parity_with_tiny_chunks():
+    """An adversarial chunk size (8 KB forces hundreds of pipeline chunks
+    per segment) must not change a single bit either."""
+    run_workers(2, "channels_parity",
+                extra_env={"HOROVOD_NUM_CHANNELS": "3",
+                           "HOROVOD_CHUNK_BYTES": "8192"}, timeout=300)
+
+
+def test_channels_parity_multi_driver():
+    """Force more driver threads than the auto policy would pick on a
+    small box: channels split across pool drivers instead of multiplexing
+    in one poll loop, same bits."""
+    run_workers(2, "channels_parity",
+                extra_env={"HOROVOD_NUM_CHANNELS": "4",
+                           "HOROVOD_CHANNEL_DRIVERS": "4"}, timeout=300)
+
+
+def test_data_plane_stats_counters():
+    """data_bytes_tx/rx track ~2(N-1)/N of the payload per rank, the
+    wire/reduce split moves, and the derived bus bandwidth is positive."""
+    run_workers(2, "channels_stats",
+                extra_env={"HOROVOD_NUM_CHANNELS": "3"})
+
+
+def test_socket_buf_knob_accepted():
+    """HOROVOD_SOCKET_BUF_BYTES plumbs through to working collectives."""
+    run_workers(2, "allreduce",
+                extra_env={"HOROVOD_SOCKET_BUF_BYTES": "4194304"})
+
+
+def test_mixed_stress_concurrent_responses():
+    """40 mixed-type collectives in one burst with 3 channels: waves of
+    independent responses execute CONCURRENTLY on disjoint channels and
+    every value is correct."""
+    run_workers(4, "mixed_stress",
+                extra_env={"HOROVOD_NUM_CHANNELS": "3"})
+
+
+def test_fused_multichannel():
+    run_workers(3, "fused", extra_env={"HOROVOD_NUM_CHANNELS": "4"})
+
+
+def test_restart_rewires_all_channels():
+    """shutdown + re-init under channels>1: the epoch-stamped channel
+    handshake must rewire every channel of the new incarnation."""
+    run_workers(3, "restart", extra_env={"HOROVOD_NUM_CHANNELS": "4"})
+
+
+def test_multichannel_timeline_per_channel_tracks(tmp_path):
+    """With 2 channels the timeline carries a RING_CH<k> activity span
+    per channel on its own trace tid, alongside the op-level
+    RING_ALLREDUCE span."""
+    path = tmp_path / "timeline.json"
+    run_workers(2, "channels_big",
+                extra_env={"HOROVOD_NUM_CHANNELS": "2",
+                           "HOROVOD_TIMELINE": str(path)})
+    text = path.read_text()
+    assert "RING_ALLREDUCE" in text
+    assert "RING_CH0" in text and "RING_CH1" in text
+    events = json.loads(text.rstrip().rstrip(",") + "]")
+    tids = {e.get("tid") for e in events if str(e.get("name", ""))
+            .startswith("RING_CH")}
+    assert len(tids) == 2, tids  # one trace track per channel

@@ -88,6 +88,25 @@ def test_injected_fault_mid_rank():
                 extra_env={**FAULT_ENV, "HOROVOD_FAULT_INJECT": "1:4:exit"})
 
 
+def test_worker_death_mid_multichannel_allreduce_aborts_cleanly():
+    """Killing a peer while a CHANNELED (4 socket pairs per edge,
+    streaming cascade) allreduce is in flight must produce the existing
+    clean abort with rank attribution on every survivor — a dead peer
+    EOFs every channel, and the first failed channel aborts the whole op
+    — never a hang of the driver poll loop."""
+    run_workers(3, "worker_death", expected_rc={2: 31},
+                extra_env={**FAULT_ENV, "HOROVOD_NUM_CHANNELS": "4"})
+
+
+def test_injected_fault_multichannel_aborts_all_survivors():
+    """drop-conn fault injection under channels=4: the abrupt loss of all
+    of a rank's channel sockets surfaces as the prompt coordinator abort
+    naming the culprit."""
+    run_workers(3, "fault_steps", timeout=90,
+                extra_env={**FAULT_ENV, "HOROVOD_NUM_CHANNELS": "4",
+                           "HOROVOD_FAULT_INJECT": "2:3:drop-conn"})
+
+
 def test_abort_recovery_starts_with_empty_cache():
     """drop-conn abort while the negotiation cache is HOT, then in-process
     shutdown + re-Init: every rank must come back with an EMPTY cache (the
@@ -202,6 +221,39 @@ def test_relaunched_worker_rejoins_and_world_grows_back():
     survivors = [ok for ok in oks if ok[0] != "1"]
     assert {ok[4] for ok in survivors} == {"2,3"}, oks
     assert b"is waiting to join" in p.stdout, out
+
+
+def test_elastic_shrink_rewires_all_channels():
+    """Shrink-to-survivors with a 4-channel data plane: the re-rendezvous
+    must rewire EVERY channel of the new epoch (the channel handshake is
+    epoch-stamped, so a stale incarnation's connect can never occupy a
+    channel slot) and the shrunken world's results stay exact."""
+    p = _run_elastic_membership_job(
+        3, "2:10:exit", extra_env={"HOROVOD_NUM_CHANNELS": "4"})
+    out = p.stdout.decode() + p.stderr.decode()
+    assert p.returncode == 0, out
+    oks = _ok_lines(p)
+    assert len(oks) == 2, out
+    assert {ok[2] for ok in oks} == {"2"}, oks
+    assert int(oks[0][3]) >= 2, oks                # epoch advanced
+    assert len({ok[5] for ok in oks}) == 1, oks    # identical final loss
+
+
+def test_elastic_rejoin_rewires_all_channels():
+    """Worker rejoin mid-run under channels=4: the grow re-rendezvous
+    admits the candidate and wires the full channel fan-out for the new
+    epoch on every member."""
+    p = _run_elastic_membership_job(
+        3, "1:10:exit", restarts=2, relaunch_delay=6.0,
+        extra_env={"HOROVOD_NUM_CHANNELS": "4",
+                   "HOROVOD_TEST_STEP_SEC": "0.3",
+                   "HOROVOD_TEST_TOTAL_STEPS": "40"})
+    out = p.stdout.decode() + p.stderr.decode()
+    assert p.returncode == 0, out
+    oks = _ok_lines(p)
+    assert len(oks) == 3, out                      # everyone finished
+    assert {ok[2] for ok in oks} == {"3"}, oks     # back at size 3
+    assert len({ok[5] for ok in oks}) == 1, oks    # identical final loss
 
 
 def test_shrink_below_min_size_terminates_cleanly():
