@@ -135,22 +135,17 @@ def _engine_setup():
 
 def _measure_bus_bw_mb_s(basics, eng, nbytes: int, iters: int) -> float:
     """Bus bandwidth over `iters` allreduces from the engine's own
-    allreduce byte/wall counters (NCCL busbw convention)."""
+    allreduce byte/wall counters (NCCL busbw convention), via the
+    stats_delta helper the autotuner scores trials with."""
     import numpy as np
 
     n = max(1, nbytes // 4)
     x = np.ones(n, dtype=np.float32)
     eng.allreduce(x.copy(), name="sweep.warm")
-    s0 = eng.stats()
+    before = eng.stats()
     for i in range(iters):
         eng.synchronize(eng.enqueue_allreduce(x.copy(), name="sweep.t"))
-    s1 = eng.stats()
-    size = basics.size()
-    d_bytes = s1["allreduce_bytes"] - s0["allreduce_bytes"]
-    d_ns = s1["allreduce_ns"] - s0["allreduce_ns"]
-    if d_ns <= 0:
-        return 0.0
-    return (d_bytes * 2.0 * (size - 1) / size) / (d_ns / 1e9) / 1e6
+    return eng.stats_delta(before)["allreduce_bus_bw_bytes_per_sec"] / 1e6
 
 
 def _sweep_worker() -> None:
@@ -201,6 +196,142 @@ def _gate_worker() -> None:
     if basics.rank() == 0:
         for multi, single in pairs:
             print(f"GATE_PAIR {multi:.1f} {single:.1f}", flush=True)
+    basics.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# autotune workers (online knob search; see docs/autotune.md)
+# ---------------------------------------------------------------------------
+
+def _converge_autotuner(basics, eng, step_bytes: int, max_steps: int = 5000):
+    """Drive allreduce traffic until rank 0's tuner converges; the stop
+    is broadcast-driven so every rank exits on the same step.  Returns
+    rank 0's tuner (None elsewhere)."""
+    import numpy as np
+
+    from horovod_tpu.autotune import get_tuner
+
+    tuner = get_tuner() if basics.rank() == 0 else None
+    if basics.rank() == 0:
+        assert tuner is not None, "HOROVOD_AUTOTUNE=1 did not start a tuner"
+    x = np.ones(max(1, step_bytes // 4), dtype=np.float32)
+    keep, steps = 1, 0
+    while keep:
+        eng.synchronize(eng.enqueue_allreduce(x.copy(), name="at.bench.t"))
+        steps += 1
+        if basics.rank() == 0:
+            keep = 0 if (tuner.converged or steps >= max_steps) else 1
+        flag = eng.broadcast(np.asarray([keep], dtype="int8"), root_rank=0,
+                             name="at.bench.ctl")
+        keep = int(flag[0])
+    if basics.rank() == 0:
+        assert tuner.converged, f"tuner did not converge in {steps} steps"
+    return tuner
+
+
+def _apply_config_all(basics, eng, cfg: dict, last_tt: int) -> int:
+    """rank 0 queues a TUNE; EVERY rank waits for its own application
+    (the frame lands on all ranks at the same cycle boundary), so the
+    next measurement runs under the new config everywhere.  Returns the
+    new tune_trials watermark."""
+    if basics.rank() == 0:
+        assert eng.autotune_set(
+            chunk_bytes=cfg.get("chunk_bytes", 0),
+            fusion_threshold=cfg.get("fusion_threshold", 0),
+            cycle_time_ms=cfg.get("cycle_time_ms", 0),
+            wave_width=cfg.get("wave_width", 0))
+    deadline = time.time() + 20
+    while eng.stats()["tune_trials"] <= last_tt:
+        assert time.time() < deadline, "TUNE frame never applied"
+        time.sleep(0.002)
+    return eng.stats()["tune_trials"]
+
+
+#: Static chunk-size grid the gate compares the committed config
+#: against (the sweep dimension PR 4 measured the big busbw swings on).
+_GATE_GRID = [256 << 10, 1 << 20, 4 << 20]
+
+
+def _autotune_worker() -> None:
+    """Bench body: converge the online search, then measure the committed
+    config's 16 MB bus bandwidth (same methodology as the static sweep
+    numbers it prints next to)."""
+    import json as _json
+
+    from horovod_tpu.autotune import stop_autotuner
+
+    basics, eng = _engine_setup()
+    tuner = _converge_autotuner(basics, eng, step_bytes=4 << 20)
+    if basics.rank() == 0:
+        # Freeze the regression watcher: an ambient-load dip during the
+        # measurement could otherwise re-open the search and flip knobs
+        # underneath it (the gate worker does the same).
+        stop_autotuner()
+    bw = _measure_bus_bw_mb_s(basics, eng, 16 << 20, 5)
+    if basics.rank() == 0:
+        print(f"AUTOTUNE_BUS_MB_S {bw:.1f} TRIALS {len(tuner.trace)} "
+              f"CONFIG {_json.dumps(tuner.committed, sort_keys=True)}",
+              flush=True)
+    basics.shutdown()
+
+
+def _autotune_gate_worker() -> None:
+    """CI gate body: converge, stop the tuner (so the regression watcher
+    cannot fight the measurement flips), then interleave rounds of the
+    committed config against each static grid point — alternation means
+    machine drift hits both sides equally, exactly like the data-plane
+    gate."""
+    import json as _json
+
+    from horovod_tpu.autotune import stop_autotuner
+
+    basics, eng = _engine_setup()
+    tuner = _converge_autotuner(basics, eng, step_bytes=4 << 20)
+    committed = dict(tuner.committed) if basics.rank() == 0 else None
+    max_trials = int(os.environ.get("HOROVOD_AUTOTUNE_MAX_TRIALS", "32"))
+    if basics.rank() == 0:
+        assert len(tuner.trace) <= max_trials, (len(tuner.trace), max_trials)
+        stop_autotuner()
+    # Ship the committed config so every rank drives the same schedule.
+    import numpy as np
+
+    keys = ("chunk_bytes", "fusion_threshold", "cycle_time_ms",
+            "wave_width")
+    payload = np.zeros(len(keys), dtype=np.int64)
+    if basics.rank() == 0:
+        payload = np.asarray([committed.get(k, 0) for k in keys],
+                             dtype=np.int64)
+    got = eng.broadcast(payload, root_rank=0, name="at.gate.cfg")
+    committed = {k: int(v) for k, v in zip(keys, got)}
+    base = {k: int(v) for k, v in eng.stats()["config"].items()
+            if k in keys}
+    rounds = int(os.environ.get("BENCH_GATE_ROUNDS", "3"))
+    nbytes = 16 << 20
+    tt = eng.stats()["tune_trials"]
+    for _ in range(rounds):
+        # The committed config is sampled at BOTH ends of the round (the
+        # statics sandwiched between): taking max-of-3 statics against a
+        # single auto sample would bias the ratio down on a noisy box,
+        # and a monotone drift (the box settling after the convergence
+        # phase) would otherwise load entirely onto whichever side runs
+        # first.
+        tt = _apply_config_all(basics, eng, committed, tt)
+        auto_bw = _measure_bus_bw_mb_s(basics, eng, nbytes, 4)
+        static_bws = []
+        for chunk in _GATE_GRID:
+            tt = _apply_config_all(basics, eng, {**base,
+                                                 "chunk_bytes": chunk}, tt)
+            static_bws.append(_measure_bus_bw_mb_s(basics, eng, nbytes, 4))
+        tt = _apply_config_all(basics, eng, committed, tt)
+        auto_bw = max(auto_bw, _measure_bus_bw_mb_s(basics, eng, nbytes, 4))
+        if basics.rank() == 0:
+            print(f"AUTOGATE_ROUND auto={auto_bw:.1f} "
+                  f"static_best={max(static_bws):.1f}", flush=True)
+    if basics.rank() == 0:
+        print(f"AUTOGATE_TRIALS {len(tuner.trace)} MAX {max_trials}",
+              flush=True)
+        print(f"AUTOGATE_CONFIG {_json.dumps(committed, sort_keys=True)}",
+              flush=True)
     basics.shutdown()
 
 
@@ -326,7 +457,34 @@ def main() -> None:
     m = re.search(r"LATENCY_MS ([\d.]+)", out)
     result["allreduce_small_latency_ms"] = (
         {"2": float(m.group(1))} if m else {})
+
+    # Online-autotuned 16 MB bus bandwidth next to the static numbers,
+    # plus the config the search committed (docs/autotune.md).
+    autotuned: dict = {}
+    autotune_cfg: dict = {}
+    for n in (2, 4):
+        out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
+                             "--autotune-worker"], timeout=300,
+                         extra_env=_AUTOTUNE_ENV)
+        m = re.search(
+            r"AUTOTUNE_BUS_MB_S ([\d.]+) TRIALS (\d+) CONFIG (.*)", out)
+        if m:
+            autotuned[str(n)] = float(m.group(1))
+            autotune_cfg[str(n)] = json.loads(m.group(3))
+    result["allreduce_bus_bw_mb_s_autotuned"] = autotuned
+    result["autotune_committed_config"] = autotune_cfg
     print(json.dumps(result))
+
+
+#: Shared env for the autotune bench/gate runs: small fixed-bytes
+#: windows so the full search converges in seconds of traffic, and a
+#: pinned seed so the trial schedule is reproducible run to run.
+_AUTOTUNE_ENV = {
+    "HOROVOD_AUTOTUNE": "1",
+    "HOROVOD_AUTOTUNE_SEED": "7",
+    "HOROVOD_AUTOTUNE_WINDOW_BYTES": str(8 << 20),
+    "HOROVOD_AUTOTUNE_TRIAL_TIMEOUT_SEC": "20",
+}
 
 
 def gate() -> None:
@@ -374,6 +532,59 @@ def gate() -> None:
     print("DATA-PLANE GATE PASSED")
 
 
+def autotune_gate() -> None:
+    """CI autotune gate at 2 AND 4 ranks: the search must converge
+    within HOROVOD_AUTOTUNE_MAX_TRIALS (the worker asserts it), and the
+    committed config's 16 MB bus bandwidth must reach the gate ratio of
+    the BEST static grid point, judged on the best of interleaved
+    rounds — same regression-floor convention as the data-plane gate
+    (this box's loopback is CPU-ceilinged and ambient-load-noisy; both
+    sides usually tie at ~1.0, and the floor catches a search that
+    commits a genuinely broken config).  HOROVOD_AUTOTUNE_GATE_RATIO
+    overrides the 0.85 default on capable hosts."""
+    threshold = float(os.environ.get("HOROVOD_AUTOTUNE_GATE_RATIO", "0.85"))
+    env = {
+        **_AUTOTUNE_ENV,
+        # chunk + wave only: the full 4-knob schedule buys the gate
+        # nothing but wall time (fusion/cycle barely move single-tensor
+        # busbw), and the grid it is judged against is the chunk axis.
+        "HOROVOD_AUTOTUNE_KNOBS": "chunk_bytes,wave_width",
+        "BENCH_GATE_ROUNDS": "3",
+    }
+    failed = False
+    for n in (2, 4):
+        out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
+                             "--autotune-gate-worker"], timeout=420,
+                         extra_env=env)
+        rounds = [(float(a), float(s)) for a, s in re.findall(
+            r"AUTOGATE_ROUND auto=([\d.]+) static_best=([\d.]+)", out)]
+        trials = re.search(r"AUTOGATE_TRIALS (\d+) MAX (\d+)", out)
+        cfg = re.search(r"AUTOGATE_CONFIG (.*)", out)
+        if not rounds or trials is None:
+            print(f"AUTOTUNE GATE FAILED at {n} ranks: no measurements "
+                  f"produced\n{out}")
+            sys.exit(1)
+        print(f"[{n} ranks] converged in {trials.group(1)} trials "
+              f"(cap {trials.group(2)}); committed "
+              f"{cfg.group(1) if cfg else '?'}")
+        ratios = []
+        for a, s in rounds:
+            ratio = a / s if s > 0 else 0.0
+            ratios.append(ratio)
+            print(f"[{n} ranks] round: autotuned {a:.0f} MB/s vs "
+                  f"best-static {s:.0f} MB/s (x{ratio:.2f})")
+        best = max(ratios) if ratios else 0.0
+        print(f"[{n} ranks] best ratio x{best:.2f}, "
+              f"threshold x{threshold:.2f} (judged on best)")
+        if best < threshold:
+            failed = True
+    if failed:
+        print("AUTOTUNE GATE FAILED: the committed config did not reach "
+              "the static-grid floor in any round")
+        sys.exit(1)
+    print("AUTOTUNE GATE PASSED")
+
+
 if __name__ == "__main__":
     if "--tf-worker" in sys.argv:
         _tf_worker()
@@ -383,6 +594,12 @@ if __name__ == "__main__":
         _latency_worker()
     elif "--gate-worker" in sys.argv:
         _gate_worker()
+    elif "--autotune-worker" in sys.argv:
+        _autotune_worker()
+    elif "--autotune-gate-worker" in sys.argv:
+        _autotune_gate_worker()
+    elif "--autotune-gate" in sys.argv:
+        autotune_gate()
     elif "--gate" in sys.argv:
         gate()
     else:

@@ -79,6 +79,20 @@ PALLAS_AXON_POOL_IPS= timeout -k 15 420 \
     python -m pytest "tests/test_data_plane.py::test_channels_bitwise_parity[4]" -q
 PALLAS_AXON_POOL_IPS= timeout -k 15 420 python bench_engine.py --gate
 
+echo "== autotune gate (online knob search vs static grid, hard timeout) =="
+# Online autotuner (HOROVOD_AUTOTUNE=1): the search must converge within
+# HOROVOD_AUTOTUNE_MAX_TRIALS at 2 and 4 ranks, and the committed config's
+# busbw must clear >= 0.85x the best static grid point, judged best-of-
+# interleaved rounds (regression floor, same convention as the data-plane
+# gate — this box's loopback is CPU-ceilinged and ambient-load-noisy; set
+# HOROVOD_AUTOTUNE_GATE_RATIO higher on capable hosts).  The hard timeout
+# is the wedge detector: a trial that hangs the world fails fast — it
+# must exceed the SUM of the two serial per-run subprocess budgets
+# (2 x 420 s), or a legitimately slow-but-progressing pair of runs gets
+# SIGTERMed mid-measurement.
+PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
+    python bench_engine.py --autotune-gate
+
 echo "== multichip sharding dry run =="
 PALLAS_AXON_POOL_IPS= python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8) OK')"
 

@@ -1,0 +1,171 @@
+"""The resolved engine knob table: env -> default -> effective.
+
+One place that mirrors the native engine's env resolution (engine.cc
+``Engine::Init``) so ``python -m horovod_tpu.run --print-config`` and the
+consolidated table in docs/performance.md can show the value the engine
+would actually use — clamps, auto-from-cores defaults and all — without
+starting a world.  ``stats()["config"]`` is the live counterpart: it
+reports the values currently in force (post-autotune) from the running
+engine itself.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, List, NamedTuple, Optional
+
+__all__ = ["KNOBS", "resolved_config", "format_table"]
+
+
+def _cores() -> int:
+    return os.cpu_count() or 1
+
+
+def _clamp(v: int, lo: int, hi: int) -> int:
+    return max(lo, min(hi, v))
+
+
+class Knob(NamedTuple):
+    env: str
+    default: str                      # human-readable default
+    resolve: Callable[[Optional[str]], str]  # raw env value -> effective
+    doc: str
+
+
+def _int_env(raw: Optional[str], dflt: int) -> int:
+    if raw is None or raw == "":
+        return dflt
+    try:
+        return int(raw)
+    except ValueError:
+        return dflt
+
+
+def _num_channels(raw):
+    v = _int_env(raw, 0)
+    if v <= 0:
+        v = min(4, max(1, _cores()))
+    return str(_clamp(v, 1, 16))
+
+
+def _channel_drivers(raw):
+    v = _int_env(raw, 0)
+    if v <= 0:
+        v = max(1, _cores())
+    return str(_clamp(v, 1, 16))
+
+
+def _chunk_bytes(raw):
+    v = max(4096, _int_env(raw, 1 << 20))
+    return str(v & ~7)
+
+
+def _wave_width(raw, environ=os.environ):
+    v = _int_env(raw, 0)
+    if v <= 0:
+        return _num_channels(environ.get("HOROVOD_NUM_CHANNELS"))
+    return str(_clamp(v, 1, 16))
+
+
+#: Every performance/robustness knob the engine reads, in the order the
+#: docs table presents them.  Live-tunable knobs (autotune may rewrite
+#: them at runtime) are marked in the doc string.
+KNOBS: List[Knob] = [
+    Knob("HOROVOD_NUM_CHANNELS", "auto: min(4, cores)", _num_channels,
+         "socket pairs per ring edge (wiring-time; probed by "
+         "autotune.startup_probe)"),
+    Knob("HOROVOD_CHANNEL_DRIVERS", "auto: cores", _channel_drivers,
+         "poll-loop threads driving the channel fan-out (wiring-time; "
+         "probed by autotune.startup_probe)"),
+    Knob("HOROVOD_CHUNK_BYTES", "1048576", _chunk_bytes,
+         "ring pipeline chunk, 8-aligned (live-tunable)"),
+    Knob("HOROVOD_FUSION_THRESHOLD", "67108864",
+         lambda raw: str(_int_env(raw, 64 << 20)),
+         "max fused allreduce batch bytes (live-tunable)"),
+    Knob("HOROVOD_CYCLE_TIME", "5",
+         lambda raw: str(max(1, _int_env(raw, 5))),
+         "idle-heartbeat upper bound on a negotiation cycle, ms "
+         "(live-tunable)"),
+    Knob("HOROVOD_WAVE_WIDTH", "auto: num_channels", _wave_width,
+         "concurrent responses per execution wave (live-tunable)"),
+    Knob("HOROVOD_CACHE_CAPACITY", "1024",
+         lambda raw: str(_clamp(max(0, _int_env(raw, 1024)), 0, 1 << 20)),
+         "negotiation response-cache slots (0 disables)"),
+    Knob("HOROVOD_SOCKET_BUF_BYTES", "0 (kernel default)",
+         lambda raw: str(_int_env(raw, 0)),
+         "SO_SNDBUF/SO_RCVBUF on ring data sockets"),
+    Knob("HOROVOD_SOCKET_TIMEOUT_SEC", "120",
+         lambda raw: str(_int_env(raw, 120)),
+         "no-progress bound per transport op (0 disables)"),
+    Knob("HOROVOD_CONTROL_PATIENCE_SEC", "max(600, size*30)",
+         lambda raw: raw if raw else "max(600, size*30)",
+         "idle allowance for control frames"),
+    Knob("HOROVOD_FAULT_TIMEOUT_SEC", "0 (off)",
+         lambda raw: str(_int_env(raw, 0)),
+         "hard failure-detection bound (caps the two above)"),
+    Knob("HOROVOD_STALL_WARNING_SEC", "60",
+         lambda raw: str(_int_env(raw, 60)),
+         "stalled-tensor warning cadence"),
+    Knob("HOROVOD_HIERARCHICAL_ALLREDUCE", "0",
+         lambda raw: str(_int_env(raw, 0)),
+         "two-level allreduce (needs a homogeneous block layout)"),
+    Knob("HOROVOD_ELASTIC", "0", lambda raw: str(_int_env(raw, 0)),
+         "in-place elastic membership"),
+    Knob("HOROVOD_AUTOTUNE", "0", lambda raw: str(_int_env(raw, 0)),
+         "online knob search over the live data plane (docs/autotune.md)"),
+    Knob("HOROVOD_AUTOTUNE_SEED", "0",
+         lambda raw: str(_int_env(raw, 0)),
+         "deterministic trial-schedule seed"),
+    Knob("HOROVOD_AUTOTUNE_WINDOW_BYTES", "67108864",
+         lambda raw: str(_int_env(raw, 64 << 20)),
+         "allreduce bytes per scoring window"),
+    Knob("HOROVOD_AUTOTUNE_MAX_TRIALS", "32",
+         lambda raw: str(_int_env(raw, 32)),
+         "hard cap on trials (search commits best-so-far at the cap)"),
+    Knob("HOROVOD_AUTOTUNE_TRIAL_TIMEOUT_SEC", "30",
+         lambda raw: str(_int_env(raw, 30)),
+         "a trial whose window never fills is discarded after this"),
+    Knob("HOROVOD_AUTOTUNE_STATE_FILE", "(unset)",
+         lambda raw: raw or "(unset)",
+         "warm-start file: a relaunch skips straight to the committed "
+         "config"),
+]
+
+
+def resolved_config(environ=os.environ) -> List[dict]:
+    """Rows of {env, set, default, effective, doc} for every knob."""
+    rows = []
+    for knob in KNOBS:
+        raw = environ.get(knob.env)
+        # The wave default depends on ANOTHER knob's resolution
+        # (num_channels), so it alone needs the full environ.
+        if knob.resolve is _wave_width:
+            effective = _wave_width(raw, environ)
+        else:
+            effective = knob.resolve(raw)
+        rows.append({
+            "env": knob.env,
+            "set": raw if raw is not None else "",
+            "default": knob.default,
+            "effective": effective,
+            "doc": knob.doc,
+        })
+    return rows
+
+
+def format_table(environ=os.environ) -> str:
+    """The --print-config rendering: one aligned row per knob."""
+    rows = resolved_config(environ)
+    w_env = max(len(r["env"]) for r in rows)
+    w_set = max(len("env"), max(len(r["set"]) for r in rows))
+    w_dflt = max(len("default"), max(len(r["default"]) for r in rows))
+    w_eff = max(len("effective"), max(len(r["effective"]) for r in rows))
+    lines = [f"{'knob':<{w_env}}  {'env':<{w_set}}  "
+             f"{'default':<{w_dflt}}  {'effective':<{w_eff}}  description"]
+    lines.append("-" * len(lines[0]))
+    for r in rows:
+        lines.append(
+            f"{r['env']:<{w_env}}  {r['set']:<{w_set}}  "
+            f"{r['default']:<{w_dflt}}  {r['effective']:<{w_eff}}  "
+            f"{r['doc']}")
+    return "\n".join(lines)

@@ -1,0 +1,101 @@
+"""Autotune state persistence (``HOROVOD_AUTOTUNE_STATE_FILE``).
+
+One small JSON document holding the committed config and the probe's
+wiring choices, so a relaunch warm-starts: the live knobs are re-applied
+via one TUNE frame instead of re-running the search, and the
+channels/drivers choice is injected into the env *before* the engine
+wires its rings (those two knobs cannot change without re-wiring).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Optional
+
+__all__ = ["load_state", "save_state", "apply_wiring_warm_start"]
+
+_VERSION = 1
+
+#: Live-tunable knob names a committed config may carry.
+LIVE_KNOBS = ("chunk_bytes", "fusion_threshold", "cycle_time_ms",
+              "wave_width")
+#: Wiring-time knobs the startup micro-probe may pin.
+WIRING_KNOBS = {"num_channels": "HOROVOD_NUM_CHANNELS",
+                "channel_drivers": "HOROVOD_CHANNEL_DRIVERS"}
+
+
+def load_state(path: str) -> Optional[dict]:
+    """Parse a state file; None when missing, corrupt, or from another
+    format version (a bad file must degrade to a cold search, never
+    crash init)."""
+    if not path:
+        return None
+    try:
+        with open(path, "r") as f:
+            state = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(state, dict) or state.get("version") != _VERSION:
+        return None
+    committed = state.get("committed")
+    if not isinstance(committed, dict):
+        return None
+    clean = {k: int(v) for k, v in committed.items()
+             if k in LIVE_KNOBS and isinstance(v, (int, float)) and v > 0}
+    if not clean:
+        return None
+    state["committed"] = clean
+    # Sanitize wiring with the same discipline: a hand-edited entry like
+    # "two" must degrade the wiring warm start, not crash init.
+    wiring = state.get("wiring")
+    state["wiring"] = {
+        k: int(v) for k, v in wiring.items()
+        if k in WIRING_KNOBS and isinstance(v, (int, float)) and v > 0
+    } if isinstance(wiring, dict) else {}
+    return state
+
+
+def save_state(path: str, committed: dict, score: Optional[float],
+               seed: int, wiring: Optional[dict] = None) -> None:
+    """Atomic write (tmp + rename) so a relaunch racing a save never
+    reads a torn file."""
+    if not path:
+        return
+    state = {
+        "version": _VERSION,
+        "committed": {k: int(v) for k, v in committed.items()
+                      if k in LIVE_KNOBS},
+        "score": score,
+        "seed": int(seed),
+    }
+    if wiring:
+        state["wiring"] = {k: int(v) for k, v in wiring.items()
+                          if k in WIRING_KNOBS}
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(prefix=".autotune.", dir=d)
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def apply_wiring_warm_start(environ=os.environ) -> Optional[dict]:
+    """Pre-init: inject the state file's probed channels/drivers into the
+    env so the engine wires the committed fan-out straight away.  An
+    explicit user env value always wins over the state file."""
+    state = load_state(environ.get("HOROVOD_AUTOTUNE_STATE_FILE", ""))
+    if state is None:
+        return None
+    wiring = state.get("wiring") or {}
+    for knob, env_name in WIRING_KNOBS.items():
+        value = wiring.get(knob)
+        if value and not environ.get(env_name):
+            environ[env_name] = str(int(value))
+    return state

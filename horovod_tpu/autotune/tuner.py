@@ -1,0 +1,429 @@
+"""The online autotuner: coordinator-driven knob search over the live
+data plane.
+
+One daemon thread on the coordinator (rank 0), started by
+``basics.init()`` under ``HOROVOD_AUTOTUNE=1``:
+
+* it proposes a trial config through the native ``horovod_autotune_set``
+  C API — the engine broadcasts it in the next cycle's **epoch-stamped
+  TUNE frame**, and every rank applies it atomically between negotiation
+  cycles (a TUNE from a dead incarnation is dropped by the engine's
+  structural stale-epoch rejection, like any other control frame);
+* it scores each trial from ``stats_delta`` counter windows — bus
+  bandwidth over a **fixed-bytes** window of allreduce traffic, so fast
+  configs are not penalized with shorter measurements;
+* the trial schedule is a seeded coordinate descent
+  (:mod:`horovod_tpu.autotune.search`) — deterministic for a fixed
+  ``HOROVOD_AUTOTUNE_SEED``;
+* on convergence it commits the best config (one final TUNE with the
+  commit flag), persists it to ``HOROVOD_AUTOTUNE_STATE_FILE``, and
+  keeps watching: a **sustained** regression (several consecutive
+  completed windows far below the committed score) restarts the search.
+
+All of it is observation + between-cycle knob flips: the tuned knobs are
+numerics-neutral by the PR 4 bit-exactness guarantee, so a trial can be
+slow but never wrong.
+
+``startup_probe`` handles the two knobs a TUNE frame cannot reach —
+``HOROVOD_NUM_CHANNELS`` / ``HOROVOD_CHANNEL_DRIVERS`` require
+(re)wiring — with a short collective micro-probe reusing the bench sweep
+machinery (shutdown + re-init per candidate, rank 0's verdict broadcast
+through the engine itself).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.autotune.search import CoordinateSearch, ladder
+from horovod_tpu.autotune.store import load_state, save_state
+
+__all__ = ["Autotuner", "start_autotuner", "stop_autotuner", "get_tuner",
+           "startup_probe", "default_space"]
+
+
+def _env_int(name: str, dflt: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else dflt
+    except ValueError:
+        return dflt
+
+
+def _env_float(name: str, dflt: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else dflt
+    except ValueError:
+        return dflt
+
+
+def default_space(num_channels: int) -> Dict[str, List[int]]:
+    """Log-scaled ladders for the live-tunable knobs.  The wave ladder is
+    bounded by the committed channel fan-out (waves cannot exceed it).
+    ``HOROVOD_AUTOTUNE_KNOBS`` (comma list) restricts which knobs are
+    swept — tests and the CI gate use it to keep schedules short."""
+    space: Dict[str, List[int]] = {
+        "chunk_bytes": ladder(64 << 10, 4 << 20),
+        "fusion_threshold": ladder(8 << 20, 128 << 20),
+        "cycle_time_ms": [1, 2, 4, 8],
+        "wave_width": ladder(1, max(1, num_channels)),
+    }
+    only = os.environ.get("HOROVOD_AUTOTUNE_KNOBS", "")
+    if only:
+        keep = {k.strip() for k in only.split(",") if k.strip()}
+        space = {k: v for k, v in space.items() if k in keep}
+    return space
+
+
+#: Committed config of the last converged search in this process: an
+#: in-place elastic resize restarts the tuner (shutdown + re-init), and
+#: the new incarnation re-applies this under the new epoch instead of
+#: re-searching — the state file is the cross-process equivalent.
+_LAST_COMMITTED: Optional[Dict[str, int]] = None
+_LAST_SCORE: Optional[float] = None
+
+
+class Autotuner(threading.Thread):
+    """See the module docstring.  Public observability (read from the
+    main thread, e.g. by tests and ``bench_engine.py``):
+
+    * ``trace`` — list of ``{"config", "score"}`` per finished trial;
+    * ``committed`` — the committed config dict (None mid-search);
+    * ``converged`` — True once committed;
+    * ``epoch`` — the membership epoch the tuner is operating under.
+    """
+
+    def __init__(self, engine):
+        super().__init__(name="hvd-autotune", daemon=True)
+        self._eng = engine
+        self._lib = engine._lib
+        self._stop_evt = threading.Event()
+        self.seed = _env_int("HOROVOD_AUTOTUNE_SEED", 0)
+        self.window_bytes = _env_int("HOROVOD_AUTOTUNE_WINDOW_BYTES",
+                                     64 << 20)
+        self.max_trials = _env_int("HOROVOD_AUTOTUNE_MAX_TRIALS", 32)
+        self.trial_timeout = _env_int("HOROVOD_AUTOTUNE_TRIAL_TIMEOUT_SEC",
+                                      30)
+        self.reprobe_ratio = _env_float("HOROVOD_AUTOTUNE_REPROBE_RATIO",
+                                        0.5)
+        self.reprobe_windows = _env_int("HOROVOD_AUTOTUNE_REPROBE_WINDOWS",
+                                        3)
+        self.state_file = os.environ.get("HOROVOD_AUTOTUNE_STATE_FILE", "")
+        self.trace: List[dict] = []
+        self.committed: Optional[Dict[str, int]] = None
+        self.committed_score: Optional[float] = None
+        self.epoch: int = 0
+        self._converged = threading.Event()
+        self.planned: List[tuple] = []
+
+    # -- public surface ----------------------------------------------------
+
+    @property
+    def converged(self) -> bool:
+        return self._converged.is_set()
+
+    def wait_converged(self, timeout: Optional[float] = None) -> bool:
+        return self._converged.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    # -- engine liveness / plumbing ---------------------------------------
+
+    def _alive(self) -> bool:
+        if self._stop_evt.is_set():
+            return False
+        try:
+            if not self._lib.horovod_is_initialized():
+                return False
+            return self._eng.abort_reason() == ""
+        except Exception:
+            return False
+
+    def _sleep(self, sec: float) -> None:
+        self._stop_evt.wait(sec)
+
+    def _apply(self, cfg: Dict[str, int], commit: bool) -> bool:
+        """Queue a TUNE and wait until it has APPLIED (tune_trials moved)
+        so the scoring window never starts under the previous config.
+
+        The wait has no timer of its own: TUNE application is not
+        traffic-dependent (QueueTune wakes the cycle loop, an idle
+        heartbeat carries the frame), so the only things that can delay
+        it are the engine's own stalls — and those end in the engine's
+        failure detectors firing (`_alive` goes false) or an epoch move.
+        A private deadline here would misread a legitimately slow cycle
+        (a big collective may hold the loop up to the socket timeout) as
+        failure and restart the whole search, unbounding the trial count
+        and breaking the deterministic-schedule contract."""
+        before = self._lib.horovod_tune_trials()
+        epoch0 = self._eng.epoch()
+        ok = self._eng.autotune_set(
+            chunk_bytes=cfg.get("chunk_bytes", 0),
+            fusion_threshold=cfg.get("fusion_threshold", 0),
+            cycle_time_ms=cfg.get("cycle_time_ms", 0),
+            wave_width=cfg.get("wave_width", 0),
+            commit=commit)
+        if not ok:
+            return False
+        while self._alive() and self._eng.epoch() == epoch0:
+            if self._lib.horovod_tune_trials() > before:
+                return True
+            self._sleep(0.002)
+        return False
+
+    def _score_window(self) -> Optional[float]:
+        """Bus bandwidth (bytes/s) over the next fixed-bytes window of
+        allreduce traffic; None when the window never filled (idle world,
+        wedged trial, epoch change) — the trial is discarded, the engine
+        keeps cycling, nothing wedges."""
+        base = self._eng.stats()
+        epoch0 = self._eng.epoch()
+        deadline = time.monotonic() + self.trial_timeout
+        while self._alive() and time.monotonic() < deadline:
+            if self._eng.epoch() != epoch0:
+                return None  # resized mid-window: measurement is garbage
+            delta = self._eng.stats_delta(base)
+            if delta["allreduce_bytes"] >= self.window_bytes:
+                bw = delta["allreduce_bus_bw_bytes_per_sec"]
+                return bw if bw > 0 else None
+            self._sleep(0.01)
+        return None
+
+    # -- the search --------------------------------------------------------
+
+    def run(self) -> None:  # noqa: C901 — one explicit state machine
+        try:
+            self.epoch = self._eng.epoch()
+            warm = self._warm_config()
+            if warm is not None:
+                if self._apply(warm, commit=True):
+                    self.committed = dict(warm)
+                    self.committed_score = _LAST_SCORE
+                    self._converged.set()
+                    self._monitor()
+                return
+            while self._alive():
+                if self._search_once():
+                    self._monitor()
+                    return
+        except Exception:
+            # A tuner bug must never take the training process down; the
+            # engine simply keeps running its current config.
+            import traceback
+            traceback.print_exc()
+
+    def _warm_config(self) -> Optional[Dict[str, int]]:
+        if os.environ.get("HOROVOD_AUTOTUNE_FORCE_SEARCH", "") not in \
+                ("", "0"):
+            return None
+        state = load_state(self.state_file)
+        if state is not None:
+            global _LAST_SCORE
+            _LAST_SCORE = state.get("score")
+            return state["committed"]
+        if _LAST_COMMITTED is not None:
+            return dict(_LAST_COMMITTED)
+        return None
+
+    def _search_once(self) -> bool:
+        """One full search under the current epoch.  Returns True when it
+        committed; False when the epoch moved underneath it (the caller
+        restarts the search under the new epoch)."""
+        self.epoch = self._eng.epoch()
+        base = {k: int(v) for k, v in self._eng.stats()["config"].items()
+                if k in ("chunk_bytes", "fusion_threshold",
+                         "cycle_time_ms", "wave_width")}
+        space = default_space(self._eng.stats()["config"]["num_channels"])
+        search = CoordinateSearch(space, seed=self.seed, base=base,
+                                  max_trials=self.max_trials)
+        self.planned = search.planned_schedule()
+        while self._alive():
+            if self._eng.epoch() != self.epoch:
+                return False  # world resized: restart under the new epoch
+            cfg = search.propose()
+            if cfg is None:
+                break
+            if not self._apply(cfg, commit=False):
+                # Engine gone -> True (stop quietly); epoch moved -> False
+                # (the caller restarts the search under the new epoch).
+                return self._alive() is False
+            score = self._score_window()
+            search.observe(score)
+            self.trace.append({"config": dict(cfg), "score": score})
+        if not self._alive():
+            return True  # stop requested; don't loop
+        committed = search.best
+        if self._apply(committed, commit=True):
+            global _LAST_COMMITTED, _LAST_SCORE
+            self.committed = dict(committed)
+            self.committed_score = search.best_score
+            _LAST_COMMITTED = dict(committed)
+            _LAST_SCORE = search.best_score
+            save_state(self.state_file, committed, search.best_score,
+                       self.seed,
+                       wiring={
+                           "num_channels":
+                               self._eng.stats()["config"]["num_channels"],
+                           "channel_drivers":
+                               self._eng.stats()["config"]
+                               ["channel_drivers"],
+                       })
+            self._converged.set()
+        return True
+
+    def _monitor(self) -> None:
+        """Post-commit regression watch: several consecutive COMPLETED
+        windows below reprobe_ratio x the baseline re-open the search
+        (workload or host conditions changed); idle/timed-out windows
+        never count — an idle trainer is not a regression.
+
+        The baseline is an EWMA over the monitor's own non-regressing
+        windows, seeded from the FIRST completed one — not from the
+        search's best trial score, which is a max over noisy windows (a
+        peak, not a typical value: loopback busbw on a loaded host
+        swings well over 2x), and never ratcheted to a maximum: a
+        transient fast window nudges it up a fraction and later normal
+        windows pull it back, so noise cannot inflate the baseline until
+        ordinary throughput reads as a phantom regression and the tuner
+        churns full searches through live training."""
+        bad = 0
+        baseline: Optional[float] = None
+        while self._alive():
+            if self._eng.epoch() != self.epoch:
+                # Resized world: re-assert the committed config under the
+                # new epoch (the engine re-read env defaults at re-init).
+                # The old baseline is void — busbw scales with the size.
+                self.epoch = self._eng.epoch()
+                if self.committed is not None:
+                    self._apply(self.committed, commit=True)
+                bad = 0
+                baseline = None
+                continue
+            score = self._score_window()
+            if score is None:
+                bad = 0
+                continue
+            if baseline is None or baseline <= 0:
+                baseline = score
+                self.committed_score = score
+                continue
+            if score < self.reprobe_ratio * baseline:
+                # Regressing windows only count — folding them into the
+                # EWMA would decay the baseline toward the regressed
+                # level and mask a persistent shift.
+                bad += 1
+            else:
+                bad = 0
+                baseline += 0.2 * (score - baseline)
+                self.committed_score = baseline
+            if bad >= self.reprobe_windows:
+                self._converged.clear()
+                self.committed = None
+                bad = 0
+                baseline = None
+                while self._alive():
+                    if self._search_once():
+                        break
+                if not self.converged:
+                    return
+
+
+# -- process-wide lifecycle (driven by basics.init/shutdown) ---------------
+
+_TUNER: Optional[Autotuner] = None
+_TUNER_LOCK = threading.Lock()
+
+
+def start_autotuner(engine) -> Autotuner:
+    """Start (or restart) the coordinator's tuner thread; returns it."""
+    global _TUNER
+    with _TUNER_LOCK:
+        if (_TUNER is not None and _TUNER.is_alive()
+                and not _TUNER._stop_evt.is_set()):
+            return _TUNER
+        _TUNER = Autotuner(engine)
+        _TUNER.start()
+        return _TUNER
+
+
+def stop_autotuner(timeout: float = 5.0) -> None:
+    global _TUNER
+    with _TUNER_LOCK:
+        tuner = _TUNER
+    if tuner is None:
+        return
+    tuner.stop()
+    tuner.join(timeout)
+
+
+def get_tuner() -> Optional[Autotuner]:
+    """The live (or last) Autotuner of this process — rank 0 only."""
+    return _TUNER
+
+
+# -- startup micro-probe (wiring-time knobs) -------------------------------
+
+def startup_probe(candidates=None, nbytes: int = 4 << 20,
+                  iters: int = 4) -> Dict[str, int]:
+    """Collective: EVERY rank must call this, before training starts.
+
+    Measures allreduce bus bandwidth at each candidate
+    ``(num_channels, channel_drivers)`` wiring — 0 = auto — via
+    shutdown + re-init per candidate (the bench gate's alternation
+    machinery), then re-wires the world with rank 0's winner (its
+    verdict is broadcast through the engine, so every rank re-inits
+    with the same env and the rendezvous cannot split)."""
+    import numpy as np
+
+    from horovod_tpu.common.basics import basics
+    from horovod_tpu.runtime.engine import get_engine
+
+    eng = get_engine()
+    if candidates is None:
+        candidates = [(1, 0), (2, 0), (4, 0)]
+    # The online tuner must not mutate knobs mid-probe; and if a
+    # candidate re-init fails mid-probe, the exception must not leave
+    # the env pinned to the failing candidate — a caller that catches
+    # and re-inits would silently wire a fan-out the user never chose.
+    saved = {k: os.environ.get(k)
+             for k in ("HOROVOD_NUM_CHANNELS", "HOROVOD_CHANNEL_DRIVERS")}
+    os.environ["HOROVOD_AUTOTUNE_SUSPEND"] = "1"
+    try:
+        x = np.ones(max(1, nbytes // 4), dtype=np.float32)
+        scores = []
+        for ch, dr in candidates:
+            os.environ["HOROVOD_NUM_CHANNELS"] = str(ch) if ch else ""
+            os.environ["HOROVOD_CHANNEL_DRIVERS"] = str(dr) if dr else ""
+            basics.shutdown()
+            basics.init()
+            eng.allreduce(x.copy(), name="autotune.probe.warm")
+            before = eng.stats()
+            for _ in range(iters):
+                eng.synchronize(eng.enqueue_allreduce(
+                    x.copy(), name="autotune.probe.t"))
+            scores.append(
+                eng.stats_delta(before)["allreduce_bus_bw_bytes_per_sec"])
+        best = int(np.argmax(np.asarray(scores)))
+        pick = eng.broadcast(
+            np.asarray(list(candidates[best]), dtype=np.int64),
+            root_rank=0, name="autotune.probe.pick")
+        ch, dr = int(pick[0]), int(pick[1])
+    except BaseException:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        raise
+    finally:
+        os.environ.pop("HOROVOD_AUTOTUNE_SUSPEND", None)
+    os.environ["HOROVOD_NUM_CHANNELS"] = str(ch) if ch else ""
+    os.environ["HOROVOD_CHANNEL_DRIVERS"] = str(dr) if dr else ""
+    basics.shutdown()
+    basics.init()  # the online tuner (if enabled) restarts here
+    return {"num_channels": ch, "channel_drivers": dr}

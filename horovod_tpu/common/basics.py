@@ -268,6 +268,16 @@ class HorovodBasics:
 
             self._load_native()
             if self._lib is not None:
+                if os.environ.get("HOROVOD_AUTOTUNE", "0") not in ("", "0"):
+                    # Warm start for the WIRING-time knobs: the state
+                    # file's probed channels/drivers must land in the env
+                    # before horovod_init wires the rings (explicit user
+                    # env values win inside the helper).
+                    from horovod_tpu.autotune.store import (
+                        apply_wiring_warm_start,
+                    )
+
+                    apply_wiring_warm_start(os.environ)
                 addr = coordinator or os.environ.get("HOROVOD_COORDINATOR", "")
                 ret = self._lib.horovod_init(
                     self._rank,
@@ -297,12 +307,37 @@ class HorovodBasics:
                     self._rank = int(self._lib.horovod_rank())
                     self._size = int(self._lib.horovod_size())
             self._initialized = True
+            self._maybe_start_autotuner()
             if not self._atexit_registered:
                 # Reference registers shutdown via atexit (common/__init__.py:69).
                 atexit.register(self.shutdown)
                 self._atexit_registered = True
 
+    def _maybe_start_autotuner(self) -> None:
+        """Start the online autotuner thread on the coordinator when
+        HOROVOD_AUTOTUNE=1 (default 0: no thread, no TUNE frames — the
+        untuned path is behaviorally untouched).  The probe's re-init
+        churn sets HOROVOD_AUTOTUNE_SUSPEND so mid-probe worlds are
+        never tuned underneath the measurement."""
+        if self._lib is None or self._size <= 1 or self._rank != 0:
+            return
+        if os.environ.get("HOROVOD_AUTOTUNE", "0") in ("", "0"):
+            return
+        if os.environ.get("HOROVOD_AUTOTUNE_SUSPEND", "") not in ("", "0"):
+            return
+        from horovod_tpu.autotune.tuner import start_autotuner
+        from horovod_tpu.runtime.engine import get_engine
+
+        start_autotuner(get_engine())
+
     def shutdown(self) -> None:
+        # Stop the tuner BEFORE taking the lock and the engine down: its
+        # thread only reads counters/queues frames, but it must not race
+        # the native shutdown with a TUNE proposal.
+        if os.environ.get("HOROVOD_AUTOTUNE", "0") not in ("", "0"):
+            from horovod_tpu.autotune.tuner import stop_autotuner
+
+            stop_autotuner()
         with self._lock:
             if not self._initialized:
                 return

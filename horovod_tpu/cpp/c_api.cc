@@ -126,6 +126,43 @@ int64_t horovod_num_channels() {
   return static_cast<int64_t>(Engine::Get().num_channels());
 }
 
+// Effective (currently in-force) knob values for stats()["config"]:
+// post-autotune, not the env defaults — chunk/fusion/cycle/wave are
+// live-tunable, the rest report the committed wiring-time resolution.
+int64_t horovod_chunk_bytes() { return Engine::Get().chunk_bytes(); }
+int64_t horovod_fusion_threshold() {
+  return Engine::Get().fusion_threshold();
+}
+int64_t horovod_cycle_time_ms() {
+  return static_cast<int64_t>(Engine::Get().cycle_time_ms());
+}
+int64_t horovod_wave_width() {
+  return static_cast<int64_t>(Engine::Get().wave_width());
+}
+int64_t horovod_channel_drivers() {
+  return static_cast<int64_t>(Engine::Get().channel_drivers());
+}
+int64_t horovod_cache_capacity() { return Engine::Get().cache_capacity(); }
+int64_t horovod_socket_buf_bytes() {
+  return static_cast<int64_t>(Engine::Get().socket_buf_bytes());
+}
+
+// TUNE frames applied on this rank; zero under HOROVOD_AUTOTUNE=0 (the
+// observable proof that the default path never sees a TUNE frame).
+int64_t horovod_tune_trials() { return Engine::Get().tune_trials(); }
+
+// Online-autotuner proposal (coordinator only): queue a knob config for
+// the next cycle's epoch-stamped TUNE broadcast; every rank applies it
+// between cycles.  Values <= 0 leave that knob unchanged; commit != 0
+// marks the search's final config.  Returns 0 queued, -1 when not
+// initialized or not the coordinator.
+int horovod_autotune_set(int64_t chunk_bytes, int64_t fusion_threshold,
+                         int64_t cycle_time_ms, int64_t wave_width,
+                         int commit) {
+  return Engine::Get().QueueTune(chunk_bytes, fusion_threshold,
+                                 cycle_time_ms, wave_width, commit != 0);
+}
+
 // Why the engine aborted, copied into buf (truncated to buflen-1); empty
 // while the engine is healthy or after a clean shutdown.  Lets callers
 // attach the culprit rank to enqueues attempted AFTER the abort, whose

@@ -369,8 +369,8 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   shutdown_requested_.store(false);
 
   // Knobs (reference operations.cc:1556-1618).
-  cycle_time_ms_ = static_cast<int>(EnvInt64("HOROVOD_CYCLE_TIME", 5));
-  if (cycle_time_ms_ < 1) cycle_time_ms_ = 1;
+  cycle_time_ms_.store(
+      std::max(1, static_cast<int>(EnvInt64("HOROVOD_CYCLE_TIME", 5))));
   cache_capacity_ = EnvInt64("HOROVOD_CACHE_CAPACITY", 1024);
   if (cache_capacity_ < 0) cache_capacity_ = 0;
   // Slot ids must stay under the wire format's bitvector bound
@@ -382,7 +382,8 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   // assigns slots from scratch, and a replayed stale slot id would
   // execute the wrong response.  Teardown also clears (belt + braces).
   ClearCacheState();
-  fusion_threshold_ = EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  fusion_threshold_.store(
+      EnvInt64("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024));
   // Data-plane fan-out: HOROVOD_NUM_CHANNELS independent socket pairs per
   // ring edge (1 restores the single-socket path; default auto from the
   // core count — parallel channels need cores to drive them, and past ~4
@@ -395,11 +396,29 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     num_channels_ = std::min(4, std::max(1, static_cast<int>(hc)));
   }
   if (num_channels_ > 16) num_channels_ = 16;
+  // Concurrent-response wave width: default = the channel fan-out
+  // (exactly the pre-autotune behavior); the coordinator's resolved
+  // value is committed at rendezvous next to the channel count so wave
+  // grouping agrees across ranks, and TUNE frames may retune it live.
+  {
+    int wave = static_cast<int>(EnvInt64("HOROVOD_WAVE_WIDTH", 0));
+    if (wave <= 0) wave = num_channels_;
+    wave_width_.store(std::min(16, std::max(1, wave)));
+  }
   socket_buf_bytes_ =
       static_cast<int>(EnvInt64("HOROVOD_SOCKET_BUF_BYTES", 0));
-  chunk_bytes_ = EnvInt64("HOROVOD_CHUNK_BYTES", 1 << 20);
-  if (chunk_bytes_ < 4096) chunk_bytes_ = 4096;
-  chunk_bytes_ &= ~int64_t{7};  // multiple of 8: aligns to every dtype
+  {
+    int64_t chunk = EnvInt64("HOROVOD_CHUNK_BYTES", 1 << 20);
+    if (chunk < 4096) chunk = 4096;
+    chunk_bytes_.store(chunk & ~int64_t{7});  // 8-aligned for every dtype
+  }
+  // A previous incarnation's unshipped TUNE proposal must not leak into
+  // the new world (tune_trials_ stays process-cumulative like every
+  // other counter).
+  {
+    std::lock_guard<std::mutex> lk(tune_mu_);
+    tune_pending_.store(false);
+  }
   channel_drivers_ =
       static_cast<int>(EnvInt64("HOROVOD_CHANNEL_DRIVERS", 0));
   if (channel_drivers_ <= 0) {
@@ -872,8 +891,12 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     w.u8(hierarchical_ ? 1 : 0);
     // The coordinator's data-plane fan-out is THE fan-out: every member
     // wires exactly this many channels per ring edge, so a rank whose
-    // env disagrees cannot deadlock the channel accepts.
+    // env disagrees cannot deadlock the channel accepts.  The wave width
+    // rides along for the same reason: concurrent responses pick
+    // channels by list index, so mismatched wave grouping would pair
+    // different responses on one socket.
     w.i32(num_channels_);
+    w.i32(wave_width_.load());
     for (int i = 0; i < new_size; ++i) {
       w.str((*peer_hosts)[i]);
       w.i32((*peer_ports)[i]);
@@ -973,8 +996,10 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     int32_t new_size = r.i32();
     uint8_t hier = r.u8();
     int32_t committed_channels = r.i32();
+    int32_t committed_wave = r.i32();
     if (!r.ok() || new_size < 1 || new_rank < 0 || new_rank >= new_size ||
-        committed_channels < 1 || committed_channels > 16) {
+        committed_channels < 1 || committed_channels > 16 ||
+        committed_wave < 1 || committed_wave > 16) {
       lasterr = "bad membership assignment frame";
       break;
     }
@@ -990,6 +1015,7 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     }
     hierarchical_ = hier != 0;
     num_channels_ = committed_channels;
+    wave_width_.store(committed_wave);
     if (new_rank != worker_id_ || new_size != world_size_) {
       std::fprintf(stderr,
                    "horovod_tpu worker id %d: joined membership epoch %lld "
@@ -1221,7 +1247,7 @@ static bool HasPayload(const RequestList& l) {
 
 static bool HasPayload(const ResponseList& l) {
   return !l.responses.empty() || !l.cached_slots.empty() ||
-         !l.evict_slots.empty() || l.shutdown || l.abort;
+         !l.evict_slots.empty() || l.shutdown || l.abort || l.tune;
 }
 
 bool Engine::RunLoopOnce() {
@@ -1251,8 +1277,10 @@ bool Engine::RunLoopOnce() {
   // allreduce negotiates in one control round trip, not in >= 5 ms.
   {
     std::unique_lock<std::mutex> lk(mu_);
-    cycle_cv_.wait_for(lk, std::chrono::milliseconds(cycle_time_ms_), [&] {
+    cycle_cv_.wait_for(lk, std::chrono::milliseconds(cycle_time_ms_.load()),
+                       [&] {
       return !message_queue_.empty() || shutdown_requested_.load() ||
+             tune_pending_.load() ||  // idle world ships TUNE promptly
              fault_hang_.load() || fault_drop_.load();
     });
   }
@@ -1292,6 +1320,10 @@ bool Engine::RunLoopOnce() {
     FuseResponses(responses);
     if (!responses.empty()) exec_cycles_.fetch_add(1);
     ExecuteResponses(responses);
+    // World of one: no frame flows, so drain + apply the pending TUNE
+    // locally at the same between-cycles point the wire path uses.
+    ResponseList local_tune;
+    if (DrainPendingTune(&local_tune)) ApplyTune(local_tune);
     return !my_list.shutdown;
   }
 
@@ -1348,6 +1380,11 @@ bool Engine::RunLoopOnce() {
       }
     }
     ResponseList response_list = CoordinatorStep(lists);
+    // Piggyback a queued autotune proposal on this cycle's broadcast;
+    // every rank (the coordinator included) applies it after executing
+    // the cycle's responses, so the knobs flip atomically between
+    // cycles on the whole world.
+    DrainPendingTune(&response_list);
     Writer w;
     SerializeResponseList(response_list, &w);
     for (int r = 1; r < size_; ++r) {
@@ -1378,6 +1415,7 @@ bool Engine::RunLoopOnce() {
     ExecuteResponses(response_list.responses);
     if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
     if (executed_any) exec_cycles_.fetch_add(1);
+    if (response_list.tune) ApplyTune(response_list);
     if (!stall_check_disabled_) CheckForStalledTensors();
     return !response_list.shutdown;
   }
@@ -1476,7 +1514,75 @@ bool Engine::RunLoopOnce() {
   ExecuteResponses(response_list.responses);
   if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
   if (executed_any) exec_cycles_.fetch_add(1);
+  if (response_list.tune) ApplyTune(response_list);
   return !response_list.shutdown;
+}
+
+// ---------------------------------------------------------------------------
+// Online autotune (TUNE broadcast)
+// ---------------------------------------------------------------------------
+
+int Engine::QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
+                      int64_t cycle_time_ms, int64_t wave_width,
+                      bool commit) {
+  if (!initialized_.load() || shut_down_.load()) return -1;
+  // Only the coordinator may propose: TUNE rides its response broadcast.
+  if (size_ > 1 && rank_ != 0) return -1;
+  std::lock_guard<std::mutex> lk(tune_mu_);
+  pending_tune_.trial_id = tune_trial_seq_.fetch_add(1) + 1;
+  pending_tune_.chunk_bytes = chunk_bytes;
+  pending_tune_.fusion_threshold = fusion_threshold;
+  pending_tune_.cycle_time_ms = static_cast<int32_t>(cycle_time_ms);
+  pending_tune_.wave_width = static_cast<int32_t>(wave_width);
+  pending_tune_.commit = commit;
+  tune_pending_.store(true);
+  cycle_cv_.notify_one();  // an idle world still ships the frame promptly
+  return 0;
+}
+
+bool Engine::DrainPendingTune(ResponseList* out) {
+  std::lock_guard<std::mutex> lk(tune_mu_);
+  if (!tune_pending_.load()) return false;
+  out->tune = true;
+  out->tune_commit = pending_tune_.commit;
+  out->tune_trial_id = pending_tune_.trial_id;
+  out->tune_chunk_bytes = pending_tune_.chunk_bytes;
+  out->tune_fusion_threshold = pending_tune_.fusion_threshold;
+  out->tune_cycle_time_ms = pending_tune_.cycle_time_ms;
+  out->tune_wave_width = pending_tune_.wave_width;
+  tune_pending_.store(false);
+  return true;
+}
+
+void Engine::ApplyTune(const ResponseList& list) {
+  // Runs between cycles on the background thread of every rank, after
+  // the carrying cycle's responses executed — no collective is in
+  // flight, so the knob flip can never split one op across configs.
+  // Clamps mirror Init exactly: every rank computes identical effective
+  // values from the identical broadcast.
+  if (list.tune_chunk_bytes > 0) {
+    int64_t chunk = std::max<int64_t>(4096, list.tune_chunk_bytes);
+    chunk_bytes_.store(chunk & ~int64_t{7});
+  }
+  if (list.tune_fusion_threshold > 0) {
+    fusion_threshold_.store(list.tune_fusion_threshold);
+  }
+  if (list.tune_cycle_time_ms > 0) {
+    cycle_time_ms_.store(std::max(1, static_cast<int>(
+        list.tune_cycle_time_ms)));
+  }
+  if (list.tune_wave_width > 0) {
+    wave_width_.store(std::min(16, std::max(1, static_cast<int>(
+        list.tune_wave_width))));
+  }
+  tune_trials_.fetch_add(1);
+  char desc[160];
+  std::snprintf(desc, sizeof(desc),
+                "chunk=%lld,fusion=%lld,cycle=%d,wave=%d",
+                static_cast<long long>(chunk_bytes_.load()),
+                static_cast<long long>(fusion_threshold_.load()),
+                cycle_time_ms_.load(), wave_width_.load());
+  timeline_.TuneTrial(desc, list.tune_commit);
 }
 
 // Request types whose responses are pure functions of the validated
@@ -1955,7 +2061,11 @@ Response Engine::BuildResponse(const std::string& name) {
 // Consecutive same-dtype allreduces merge into one response executed as a
 // single ring collective over the fusion buffer.
 void Engine::FuseResponses(std::vector<Response>& responses) {
-  if (fusion_threshold_ <= 0) return;
+  // One load per call: a TUNE can only land between cycles, but stats
+  // readers race this, and a single snapshot keeps the merge self-
+  // consistent regardless.
+  const int64_t fusion_threshold = fusion_threshold_.load();
+  if (fusion_threshold <= 0) return;
   auto entry_bytes = [this](const std::string& name) -> int64_t {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = tensor_table_.find(name);
@@ -1981,7 +2091,7 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
             entry_dtype(resp.tensor_names[0])) {
       int64_t total = 0;
       for (auto& n : fused.back().tensor_names) total += entry_bytes(n);
-      if (total + entry_bytes(resp.tensor_names[0]) <= fusion_threshold_) {
+      if (total + entry_bytes(resp.tensor_names[0]) <= fusion_threshold) {
         fused.back().tensor_names.push_back(resp.tensor_names[0]);
         fused.back().cache_slots.push_back(resp.cache_slots[0]);
         continue;
@@ -2012,10 +2122,15 @@ void Engine::ExecuteResponses(std::vector<Response>& responses) {
   // rank r+1's channel c about the same response).  The hierarchical
   // local/cross rings are single pairs, so that topology executes
   // serially, as does C == 1 — exactly the pre-channel path.
-  const int C =
+  const int fanout =
       (size_ > 1 && !hierarchical_ && pool_.size() > 0) ? num_channels_ : 1;
+  // Wave width: how many independent responses run concurrently, each on
+  // one disjoint channel.  Capped by the channel fan-out; live-tuned via
+  // TUNE frames (every rank applies the same value at the same cycle
+  // boundary, so cross-rank channel assignment stays in lockstep).
+  const int C = std::min(fanout, wave_width_.load());
   if (C <= 1 || responses.size() <= 1) {
-    ExecCtx all{0, std::max(1, C)};
+    ExecCtx all{0, std::max(1, fanout)};
     for (auto& resp : responses) PerformResponse(resp, all);
     last_exec_time_ = std::chrono::steady_clock::now();
     return;
@@ -2026,7 +2141,7 @@ void Engine::ExecuteResponses(std::vector<Response>& responses) {
         static_cast<int>(std::min<size_t>(C, responses.size() - base));
     if (wave == 1) {
       // Lone trailing response: give it the full fan-out.
-      PerformResponse(responses[base], ExecCtx{0, C, nullptr});
+      PerformResponse(responses[base], ExecCtx{0, fanout, nullptr});
       continue;
     }
     std::vector<int64_t> slice_walls(wave, 0);
@@ -2079,7 +2194,8 @@ void Engine::ReduceIntoTimed(void* dst, const void* src, int64_t count,
   // already overlapped with the wire, and splitting them again just buys
   // latch traffic; only the big monolithic reduces (hierarchical chain
   // relays, oversized chunks) benefit.
-  const int64_t kParallelCut = std::max<int64_t>(2 << 20, chunk_bytes_ * 2);
+  const int64_t kParallelCut =
+      std::max<int64_t>(2 << 20, chunk_bytes_.load() * 2);
   if (bytes >= kParallelCut && pool_.size() > 0 && count >= 4) {
     int parts = std::min<int64_t>(pool_.size() + 1, bytes / (kParallelCut / 2));
     parts = std::min(parts, 4);
@@ -2259,7 +2375,7 @@ bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
   std::unique_ptr<uint8_t[]> tmp(
       new uint8_t[static_cast<size_t>(max_seg) * esize]);
   const size_t chunk =
-      static_cast<size_t>(chunk_bytes_) / esize * esize;  // dtype-aligned
+      static_cast<size_t>(chunk_bytes_.load()) / esize * esize;  // aligned
   const int timeout_ms = socket_timeout_sec_ * 1000;
   for (int step = 0; step < size_ - 1; ++step) {
     int send_seg = (vrank - step + 2 * size_) % size_;
@@ -2343,7 +2459,7 @@ bool Engine::StreamingRingChannels(uint8_t* base,
     }
   }
   const size_t chunk =
-      static_cast<size_t>(chunk_bytes_) / esize * esize;  // dtype-aligned
+      static_cast<size_t>(chunk_bytes_.load()) / esize * esize;  // aligned
 
   // Per-channel cascade state.
   struct ChState {
@@ -2814,7 +2930,7 @@ void Engine::ExecAllreduce(const Response& response,
       // High-water cap: a one-off oversized batch (> the fusion
       // threshold) must not pin its allocation for the process lifetime.
       if (static_cast<int64_t>(fusion_buffer.capacity()) >
-          fusion_threshold_) {
+          fusion_threshold_.load()) {
         std::vector<uint8_t>().swap(fusion_buffer);
       }
     }

@@ -200,6 +200,33 @@ class Engine {
   int64_t allreduce_ns() const { return allreduce_ns_.load(); }
   int num_channels() const { return num_channels_; }
 
+  // Effective (currently in-force) values of the live-tunable knobs plus
+  // the wiring-time ones, for stats()["config"]: post-TUNE, not the env
+  // default — an operator reading stats sees what the engine is actually
+  // running with.
+  int64_t chunk_bytes() const { return chunk_bytes_.load(); }
+  int64_t fusion_threshold() const { return fusion_threshold_.load(); }
+  int cycle_time_ms() const { return cycle_time_ms_.load(); }
+  int wave_width() const { return wave_width_.load(); }
+  int channel_drivers() const { return channel_drivers_; }
+  int64_t cache_capacity() const { return cache_capacity_; }
+  int socket_buf_bytes() const { return socket_buf_bytes_; }
+  // TUNE frames applied on this rank (process-cumulative, like every
+  // other counter).  Zero under HOROVOD_AUTOTUNE=0 — the observable
+  // proof that the default path never sees a TUNE frame.
+  int64_t tune_trials() const { return tune_trials_.load(); }
+
+  // Online autotuner entry point (coordinator only, any thread): queue a
+  // knob config to broadcast in the next cycle's TUNE frame.  Every rank
+  // — the coordinator included — applies it AFTER that cycle's responses
+  // execute, i.e. atomically between negotiation cycles; the frame
+  // carries the membership epoch, so a TUNE from a dead incarnation is
+  // structurally dropped.  Values <= 0 leave the knob unchanged;
+  // `commit` marks the search's final config (timeline/observability).
+  // Returns 0 queued, -1 when not initialized or not the coordinator.
+  int QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
+                int64_t cycle_time_ms, int64_t wave_width, bool commit);
+
   // Why the engine aborted ("" while healthy or after a clean shutdown).
   // Safe to call from any thread: the background thread publishes
   // abort_reason_ before its shut_down_ release-store, and this reads it
@@ -382,16 +409,24 @@ class Engine {
   std::thread background_;
 
   // -- knobs (reference operations.h:53-58 env vars) --
+  // The four LIVE-TUNABLE knobs (cycle_time_ms_, fusion_threshold_,
+  // chunk_bytes_ below, wave_width_ below) are atomics: the online
+  // autotuner rewrites them between negotiation cycles (ApplyTune, on
+  // the background thread) while API threads read them for
+  // stats()["config"].  Execution reads happen-after the apply via the
+  // cycle structure (a TUNE lands only when no responses are in
+  // flight), so relaxed loads are sufficient everywhere.
+  //
   // Upper bound on a negotiation cycle's idle wait, NOT a floor: the
   // background loop waits on cycle_cv_ and wakes immediately when work
   // is enqueued (or shutdown/fault is requested), so single-tensor
   // latency is bounded by the control round trip, not by this knob.
-  int cycle_time_ms_ = 5;
+  std::atomic<int> cycle_time_ms_{5};
   // HOROVOD_CACHE_CAPACITY: max live negotiation-cache slots (0 disables
   // the cache entirely — every cycle uses the full-Request path).
   int64_t cache_capacity_ = 1024;
   bool cache_enabled_ = false;               // capacity > 0 && size > 1
-  int64_t fusion_threshold_ = 64 * 1024 * 1024;
+  std::atomic<int64_t> fusion_threshold_{64 * 1024 * 1024};
   bool stall_check_disabled_ = false;
   int stall_warning_sec_ = 60;
   // No-progress bound for any single transport operation
@@ -577,8 +612,16 @@ class Engine {
   int socket_buf_bytes_ = 0;
   // HOROVOD_CHUNK_BYTES: ring-phase pipeline chunk (recv of chunk k+1
   // overlaps the ReduceInto of chunk k); multiple of 8 so chunk edges
-  // align to every dtype.
-  int64_t chunk_bytes_ = 1 << 20;
+  // align to every dtype.  Live-tunable (see the knobs comment above).
+  std::atomic<int64_t> chunk_bytes_{1 << 20};
+  // Concurrent-response wave width: how many independent responses of
+  // one cycle execute at once on disjoint channels (<= num_channels_).
+  // The committed value is broadcast in the rendezvous ASSIGN next to
+  // the channel count — waves pick channels by response index, so a
+  // cross-rank mismatch would pair different responses on the same
+  // socket.  Live-tunable thereafter (TUNE frames apply on every rank at
+  // the same cycle boundary, which preserves the agreement).
+  std::atomic<int> wave_width_{1};
   // HOROVOD_CHANNEL_DRIVERS: how many threads actively drive the channel
   // fan-out of ONE collective (default auto: one per core).  Channels
   // above this count are multiplexed within a driver's poll loop, so
@@ -592,6 +635,33 @@ class Engine {
   //    at teardown, so the high-water allocation is not retained forever. --
   std::vector<std::vector<uint8_t>> fusion_buffers_;
   std::chrono::steady_clock::time_point last_exec_time_;
+
+  // -- online autotune (TUNE broadcast) --
+  // Pending proposal queued by QueueTune (API thread) and drained into
+  // the next cycle's ResponseList by the coordinator's background loop.
+  struct TuneSpec {
+    int64_t trial_id = 0;
+    int64_t chunk_bytes = 0;
+    int64_t fusion_threshold = 0;
+    int32_t cycle_time_ms = 0;
+    int32_t wave_width = 0;
+    bool commit = false;
+  };
+  std::mutex tune_mu_;
+  // Atomic so the cycle gate's wait predicate can see a pending TUNE
+  // without taking tune_mu_ under mu_ — QueueTune's notify is only
+  // effective because the woken predicate re-checks this flag.
+  std::atomic<bool> tune_pending_{false};
+  TuneSpec pending_tune_;
+  std::atomic<int64_t> tune_trial_seq_{0};
+  // Coordinator/background-loop side: move the pending proposal (if
+  // any) into the cycle's outgoing ResponseList; returns true when the
+  // frame now carries a TUNE.
+  bool DrainPendingTune(ResponseList* out);
+  // Apply a received (or locally drained, size==1) TUNE between cycles:
+  // clamp exactly like Init so every rank lands on identical effective
+  // values, bump tune_trials_, and record the trial on the timeline.
+  void ApplyTune(const ResponseList& list);
 
   // -- execution stats --
   std::atomic<int64_t> exec_cycles_{0};
@@ -610,6 +680,7 @@ class Engine {
   std::atomic<int64_t> wire_ns_{0};
   std::atomic<int64_t> allreduce_bytes_{0};
   std::atomic<int64_t> allreduce_ns_{0};
+  std::atomic<int64_t> tune_trials_{0};
 
   // -- timeline --
   Timeline timeline_;

@@ -134,6 +134,17 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
   for (const auto& s : list.responses) SerializeResponse(s, w);
   SerializeSlotList(list.cached_slots, w);
   SerializeSlotList(list.evict_slots, w);
+  // TUNE payload behind a flag byte: the steady-state (and autotune-off)
+  // frame grows by exactly one byte.
+  w->u8(list.tune ? 1 : 0);
+  if (list.tune) {
+    w->u8(list.tune_commit ? 1 : 0);
+    w->i64(list.tune_trial_id);
+    w->i64(list.tune_chunk_bytes);
+    w->i64(list.tune_fusion_threshold);
+    w->i32(list.tune_cycle_time_ms);
+    w->i32(list.tune_wave_width);
+  }
 }
 
 bool ParseResponseList(Reader* r, ResponseList* out) {
@@ -149,6 +160,15 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
   }
   if (!ParseSlotList(r, &out->cached_slots)) return false;
   if (!ParseSlotList(r, &out->evict_slots)) return false;
+  out->tune = r->u8() != 0;
+  if (out->tune) {
+    out->tune_commit = r->u8() != 0;
+    out->tune_trial_id = r->i64();
+    out->tune_chunk_bytes = r->i64();
+    out->tune_fusion_threshold = r->i64();
+    out->tune_cycle_time_ms = r->i32();
+    out->tune_wave_width = r->i32();
+  }
   return r->ok();
 }
 

@@ -155,6 +155,23 @@ struct ResponseList {
   // assignments from the same frame (a freed slot may be reassigned in
   // the very cycle it was evicted).
   std::vector<uint32_t> evict_slots;
+  // Online-autotuner TUNE broadcast (piggybacks on the regular cycle
+  // frame, like `abort`): when `tune` is set, every receiver applies the
+  // carried knob values AFTER executing this cycle's responses — i.e.
+  // atomically between negotiation cycles, so no collective ever runs
+  // under a mixed config across ranks.  The frame inherits the epoch
+  // stamp above, so a TUNE from a dead incarnation of the world is
+  // structurally dropped (and counted in stale_epoch_msgs) like any
+  // other stale control frame.  A value <= 0 means "leave that knob
+  // unchanged"; `tune_commit` marks the search's final (committed)
+  // config for the timeline and observability.
+  bool tune = false;
+  bool tune_commit = false;
+  int64_t tune_trial_id = 0;
+  int64_t tune_chunk_bytes = 0;
+  int64_t tune_fusion_threshold = 0;
+  int32_t tune_cycle_time_ms = 0;
+  int32_t tune_wave_width = 0;
 };
 
 // Flat byte-buffer serialization (host byte order; in-cluster only).

@@ -129,6 +129,24 @@ void Timeline::ActivityEndCh(const std::string& name, int tid) {
   WriteEvent(TensorPid(name), 'E', "ACTIVITY", "", tid);
 }
 
+void Timeline::TuneTrial(const std::string& config, bool commit) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  int pid = TensorPid("autotune");
+  if (tune_span_open_) {
+    WriteEvent(pid, 'E', "AUTOTUNE", "", 1);
+    tune_span_open_ = false;
+  }
+  if (commit) {
+    WriteEvent(pid, 'X', "AUTOTUNE", "TUNE_COMMIT(" + config + ")", 1);
+    return;
+  }
+  WriteEvent(pid, 'X', "AUTOTUNE", "TUNE_TRIAL(" + config + ")", 1);
+  // The scoring-window span: open until the next trial/commit applies.
+  WriteEvent(pid, 'B', "AUTOTUNE", "TUNE_TRIAL(" + config + ")", 1);
+  tune_span_open_ = true;
+}
+
 void Timeline::End(const std::string& name, DataType dtype,
                    const std::string& shape) {
   std::lock_guard<std::recursive_mutex> lk(mu_);

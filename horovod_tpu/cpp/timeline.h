@@ -39,6 +39,14 @@ class Timeline {
   void ActivityStartCh(const std::string& name, const std::string& activity,
                        int tid);
   void ActivityEndCh(const std::string& name, int tid);
+  // Online-autotuner trials live on one dedicated trace "process"
+  // (pid "autotune"): each applied trial writes an instantaneous
+  // TUNE_TRIAL(config...) marker plus a span that covers its scoring
+  // window — the span ends when the NEXT trial (or the commit) applies,
+  // so a trace visually shows which trial's window hurt.  `commit`
+  // closes the open span and drops a TUNE_COMMIT marker instead of
+  // opening a new window.
+  void TuneTrial(const std::string& config, bool commit);
   void End(const std::string& name, DataType dtype, const std::string& shape);
 
   ~Timeline();
@@ -52,6 +60,7 @@ class Timeline {
 
   FILE* file_ = nullptr;
   std::recursive_mutex mu_;
+  bool tune_span_open_ = false;
   std::unordered_map<std::string, int> tensor_pids_;
   std::chrono::steady_clock::time_point start_;
   std::chrono::steady_clock::time_point last_flush_;

@@ -58,7 +58,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m horovod_tpu.run",
         description="Launch N coordinated worker processes.")
-    parser.add_argument("-np", "--num-proc", type=int, required=True,
+    # Required unless --print-config short-circuits (validated below —
+    # argparse's required= cannot express "required for the launch path").
+    parser.add_argument("-np", "--num-proc", type=int, default=None,
                         help="processes on this host")
     parser.add_argument("--coordinator", default=None,
                         help="host:port of rank 0's coordinator "
@@ -86,9 +88,21 @@ def main(argv=None) -> int:
                         help="supervisor mode: wait SEC before relaunching "
                              "a dead worker (forces an elastic shrink "
                              "before the rejoin; mainly for tests)")
+    parser.add_argument("--print-config", action="store_true",
+                        help="dump the full resolved engine knob table "
+                             "(env -> default -> effective) and exit; "
+                             "mirrors the table in docs/performance.md")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run (prefix with --)")
     args = parser.parse_args(argv)
+
+    if args.print_config:
+        from horovod_tpu.autotune import format_table
+
+        print(format_table())
+        return 0
+    if args.num_proc is None:
+        parser.error("the following arguments are required: -np/--num-proc")
 
     command = args.command
     if command and command[0] == "--":

@@ -145,7 +145,15 @@ class NativeEngine:
                         "horovod_wire_ns",
                         "horovod_allreduce_bytes",
                         "horovod_allreduce_ns",
-                        "horovod_num_channels"):
+                        "horovod_num_channels",
+                        "horovod_chunk_bytes",
+                        "horovod_fusion_threshold",
+                        "horovod_cycle_time_ms",
+                        "horovod_wave_width",
+                        "horovod_channel_drivers",
+                        "horovod_cache_capacity",
+                        "horovod_socket_buf_bytes",
+                        "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
                 fn.restype = ctypes.c_int64
@@ -158,6 +166,14 @@ class NativeEngine:
             lib.horovod_abort_reason.restype = None
         except AttributeError:
             pass  # stale .so: abort_reason() degrades to ""
+        try:
+            lib.horovod_autotune_set.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int,
+            ]
+            lib.horovod_autotune_set.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: the autotuner refuses to start
 
     # -- naming (auto names must be identical across ranks, which holds when
     #    ranks enqueue in the same program order — same contract as the
@@ -322,14 +338,20 @@ class NativeEngine:
         and wall time, and ``allreduce_bus_bw_bytes_per_sec`` is the
         derived cumulative bus bandwidth 2(N-1)/N · bytes / wall (the
         NCCL busbw convention — comparable across world sizes);
-        ``num_channels`` is the committed per-edge channel fan-out."""
+        ``num_channels`` is the committed per-edge channel fan-out.
+
+        Autotune (HOROVOD_AUTOTUNE): ``tune_trials`` counts TUNE frames
+        applied on this rank (0 with autotuning off — the observable
+        proof the default path never sees one), and ``config`` reports
+        every EFFECTIVE knob value currently in force — post-tuning, not
+        the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_wire_ns", None),
+        if getattr(getattr(self._lib, "horovod_tune_trials", None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
                 "libhorovod_core.so predates the execution/control-plane/"
-                "data-plane counters — rebuild it with "
+                "data-plane/autotune counters — rebuild it with "
                 "`make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
         ar_bytes = self._lib.horovod_allreduce_bytes()
@@ -360,7 +382,59 @@ class NativeEngine:
             "allreduce_ns": ar_ns,
             "allreduce_bus_bw_bytes_per_sec": bus_bw,
             "num_channels": self._lib.horovod_num_channels(),
+            "tune_trials": self._lib.horovod_tune_trials(),
+            "config": {
+                "num_channels": self._lib.horovod_num_channels(),
+                "channel_drivers": self._lib.horovod_channel_drivers(),
+                "chunk_bytes": self._lib.horovod_chunk_bytes(),
+                "fusion_threshold": self._lib.horovod_fusion_threshold(),
+                "cycle_time_ms": self._lib.horovod_cycle_time_ms(),
+                "wave_width": self._lib.horovod_wave_width(),
+                "cache_capacity": self._lib.horovod_cache_capacity(),
+                "socket_buf_bytes": self._lib.horovod_socket_buf_bytes(),
+            },
         }
+
+    def stats_delta(self, since: dict) -> dict:
+        """Counter deltas since a previous :meth:`stats` snapshot.
+
+        Every cumulative counter comes back as ``now - since`` (a key
+        missing from ``since`` counts from 0), with
+        ``allreduce_bus_bw_bytes_per_sec`` recomputed FROM THE DELTA —
+        the bandwidth of exactly the window between the two snapshots,
+        which is what the autotuner scores trials with and what bench/
+        tests previously hand-rolled.  Non-cumulative keys (``config``,
+        ``num_channels``) carry the CURRENT value."""
+        now = self.stats()
+        delta: dict = {}
+        for k, v in now.items():
+            if k in ("config", "num_channels",
+                     "allreduce_bus_bw_bytes_per_sec"):
+                delta[k] = v
+                continue
+            delta[k] = v - since.get(k, 0)
+        size = self._lib.horovod_size()
+        bus_bw = 0.0
+        if delta["allreduce_ns"] > 0 and size > 1:
+            bus_bw = (delta["allreduce_bytes"] * 2.0 * (size - 1) / size) \
+                / (delta["allreduce_ns"] / 1e9)
+        delta["allreduce_bus_bw_bytes_per_sec"] = bus_bw
+        return delta
+
+    def autotune_set(self, *, chunk_bytes: int = 0,
+                     fusion_threshold: int = 0, cycle_time_ms: int = 0,
+                     wave_width: int = 0, commit: bool = False) -> bool:
+        """Queue a TUNE proposal (coordinator only): the engine
+        broadcasts it in the next cycle's epoch-stamped frame and every
+        rank applies it between cycles.  Values <= 0 leave that knob
+        unchanged.  Returns False when the engine refused (not
+        initialized, not the coordinator, or a stale prebuilt .so)."""
+        fn = getattr(self._lib, "horovod_autotune_set", None)
+        if getattr(fn, "restype", None) is not ctypes.c_int:
+            return False
+        return fn(int(chunk_bytes), int(fusion_threshold),
+                  int(cycle_time_ms), int(wave_width),
+                  1 if commit else 0) == 0
 
     # -- handle API --
 

@@ -1,0 +1,276 @@
+"""Worker body for the multi-process online-autotuner tests.
+
+Same harness as tests/native_worker.py (N real processes, the engine's
+own TCP rendezvous, jax-free): run as ``python autotune_worker.py
+<scenario>`` with identity in HOROVOD_* env vars.  The live scenarios
+coordinate their stop through an engine broadcast — rank 0 (which hosts
+the tuner thread) decides, everyone follows — so no rank ever allreduces
+into a world the coordinator already left.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import (  # noqa: E402
+    HorovodInternalError,
+    get_engine,
+)
+
+_MiB = 262144  # float32 elements in 1 MiB
+
+
+def _driven_loop(rank, eng, tuner, max_steps=5000, extra_done=None):
+    """Allreduce until rank 0 says stop (tuner converged); returns the
+    step count.  Every step's value is asserted, so a tuning trial that
+    corrupted data would fail here, not just run slow."""
+    size = basics.size()
+    expected = size * (size + 1) / 2.0
+    keep, steps = 1, 0
+    while keep:
+        x = np.full(_MiB, float(rank + 1), dtype=np.float32)
+        out = eng.synchronize(eng.enqueue_allreduce(x, name="at.t"))
+        assert np.allclose(out, expected), (steps, out[0])
+        steps += 1
+        if rank == 0:
+            done = (tuner is not None and tuner.converged
+                    and (extra_done is None or extra_done()))
+            keep = 0 if (done or steps >= max_steps) else 1
+        flag = eng.broadcast(np.asarray([keep], dtype=np.int8), root_rank=0,
+                             name="at.ctl")
+        keep = int(flag[0])
+    return steps
+
+
+def scenario_disabled(rank, size, eng):
+    # HOROVOD_AUTOTUNE unset (the default): behaviorally untouched — no
+    # TUNE frame ever reaches any rank (tune_trials stays 0 everywhere),
+    # the effective config is exactly the env/default resolution, and
+    # integer collectives are bit-exact.
+    from horovod_tpu.autotune import get_tuner
+
+    assert get_tuner() is None, "tuner thread started with autotune off"
+    before = eng.stats()
+    assert before["tune_trials"] == 0
+    for i in range(30):
+        x = (np.arange(1024, dtype=np.int64) + rank + i)
+        out = eng.allreduce(x)
+        exp = size * np.arange(1024, dtype=np.int64) \
+            + size * (size - 1) // 2 + size * i
+        assert np.array_equal(out, exp), i  # bit-for-bit, not allclose
+    after = eng.stats()
+    assert after["tune_trials"] == 0, after["tune_trials"]
+    cfg = after["config"]
+    assert cfg["chunk_bytes"] == 1 << 20, cfg
+    assert cfg["cycle_time_ms"] == int(os.environ["HOROVOD_CYCLE_TIME"]), cfg
+    assert cfg["fusion_threshold"] == 64 << 20, cfg
+    assert cfg["wave_width"] == cfg["num_channels"], cfg
+
+
+def scenario_live(rank, size, eng):
+    # The full online search: deterministic trial schedule for the fixed
+    # seed, convergence within the trial cap, committed config in force
+    # on EVERY rank (stats()["config"]), values correct throughout.
+    from horovod_tpu.autotune import (
+        CoordinateSearch,
+        default_space,
+        get_tuner,
+    )
+
+    tuner = get_tuner() if rank == 0 else None
+    if rank == 0:
+        assert tuner is not None, "HOROVOD_AUTOTUNE=1 must start the tuner"
+    steps = _driven_loop(rank, eng, tuner)
+    stats = eng.stats()
+    if rank == 0:
+        assert tuner.converged, f"no convergence after {steps} steps"
+        max_trials = int(os.environ.get("HOROVOD_AUTOTUNE_MAX_TRIALS", "32"))
+        assert len(tuner.trace) <= max_trials, len(tuner.trace)
+        # Deterministic schedule: what ran is exactly what an independent
+        # search object plans from the same (space, seed).
+        planned = CoordinateSearch(
+            default_space(stats["config"]["num_channels"]),
+            seed=int(os.environ.get("HOROVOD_AUTOTUNE_SEED", "0")),
+            max_trials=max_trials).planned_schedule()
+        assert tuner.planned == planned, (tuner.planned, planned)
+        assert len(tuner.trace) == len(planned), (len(tuner.trace),
+                                                 len(planned))
+        for (knob, value), trial in zip(planned, tuner.trace):
+            assert trial["config"][knob] == value, (knob, value, trial)
+        committed = tuner.committed
+        assert committed is not None
+    # EVERY rank's effective config must equal the committed one (the
+    # TUNE broadcast reached them all): ship rank 0's committed values
+    # through the engine and compare locally.
+    keys = ("chunk_bytes", "fusion_threshold", "cycle_time_ms",
+            "wave_width")
+    payload = np.zeros(len(keys), dtype=np.int64)
+    if rank == 0:
+        payload = np.asarray([committed[k] for k in keys], dtype=np.int64)
+    got = eng.broadcast(payload, root_rank=0, name="at.committed")
+    cfg = eng.stats()["config"]
+    for k, v in zip(keys, got):
+        assert cfg[k] == int(v), (k, cfg[k], int(v))
+    assert eng.stats()["tune_trials"] >= 1
+
+
+def scenario_warm(rank, size, eng):
+    # Cold half of the state-file story: converge, commit — the tuner
+    # persists HOROVOD_AUTOTUNE_STATE_FILE.  (scenario_warm_restart runs
+    # in FRESH processes against that file.)
+    from horovod_tpu.autotune import get_tuner
+
+    tuner = get_tuner() if rank == 0 else None
+    _driven_loop(rank, eng, tuner)
+    if rank == 0:
+        assert tuner.converged and tuner.committed is not None
+        assert os.path.exists(os.environ["HOROVOD_AUTOTUNE_STATE_FILE"])
+
+
+def scenario_warm_restart(rank, size, eng):
+    # Warm start: a relaunch against the state file skips the search
+    # entirely — zero trials, committed config (and the probed wiring)
+    # applied straight away.
+    from horovod_tpu.autotune import get_tuner, load_state
+
+    state = load_state(os.environ["HOROVOD_AUTOTUNE_STATE_FILE"])
+    assert state is not None
+    tuner = get_tuner() if rank == 0 else None
+    if rank == 0:
+        assert tuner.wait_converged(30), "warm start did not commit"
+        assert tuner.trace == [], f"warm start ran trials: {tuner.trace}"
+
+    def _applied():
+        cfg = eng.stats()["config"]
+        return all(cfg[k] == v for k, v in state["committed"].items())
+
+    # Keep the world allreducing until rank 0 has seen the committed
+    # TUNE take hold (the loop is broadcast-driven, so every rank exits
+    # on the same step), then verify it took hold HERE too — the frame
+    # reached all ranks in the same cycle.
+    _driven_loop(rank, eng, tuner, max_steps=500,
+                 extra_done=_applied if rank == 0 else None)
+    cfg = eng.stats()["config"]
+    assert all(cfg[k] == v for k, v in state["committed"].items()), (
+        cfg, state["committed"])
+    # The state file's probed wiring was injected into the env before
+    # init, so the committed fan-out is live from the first cycle.
+    wiring = state.get("wiring") or {}
+    if "num_channels" in wiring:
+        assert cfg["num_channels"] == wiring["num_channels"], (
+            cfg, wiring)
+
+
+def scenario_epoch(rank, size, eng):
+    # Epoch safety: converge, then shutdown + re-init IN PROCESS (every
+    # rendezvous commit bumps the membership epoch — the same path an
+    # elastic shrink/rejoin takes).  The restarted tuner must re-apply
+    # the committed config under the NEW epoch without re-searching, and
+    # the world must stay healthy.
+    from horovod_tpu.autotune import get_tuner
+
+    tuner = get_tuner() if rank == 0 else None
+    _driven_loop(rank, eng, tuner)
+    committed = dict(tuner.committed) if rank == 0 else None
+    trials_before = len(tuner.trace) if rank == 0 else 0
+    epoch_before = basics.epoch()
+    tt_before = eng.stats()["tune_trials"]
+    basics.shutdown()
+    basics.init()
+    assert basics.epoch() > epoch_before, (basics.epoch(), epoch_before)
+    # Knobs were reset to env defaults by re-Init; the new tuner
+    # incarnation re-commits from process memory under the new epoch —
+    # without re-running the search.  The loop is broadcast-driven so
+    # every rank exits on the same step.
+    t2 = get_tuner() if rank == 0 else None
+
+    def _reapplied():
+        return t2 is not None and t2.converged and t2.trace == []
+
+    _driven_loop(rank, eng, t2, max_steps=500,
+                 extra_done=_reapplied if rank == 0 else None)
+    if rank == 0:
+        assert t2.committed == committed, (t2.committed, committed)
+        assert len(t2.trace) == 0, "re-init re-ran the search"
+        assert trials_before > 0
+        assert t2.epoch == basics.epoch(), (t2.epoch, basics.epoch())
+    # The committed TUNE was applied on THIS rank under the new epoch.
+    assert eng.stats()["tune_trials"] > tt_before
+    # No stale-epoch frames should have leaked through a clean re-init.
+    assert eng.stats()["stale_epoch_msgs"] == 0
+
+
+def scenario_stale(rank, size, eng):
+    # A dead incarnation's control frame arriving mid-tuning
+    # (HOROVOD_FAULT_INJECT=1:20:stale-epoch on worker id 1) must be
+    # structurally dropped + counted by the coordinator while the TUNE
+    # traffic keeps flowing — the search still converges and values stay
+    # correct.
+    from horovod_tpu.autotune import get_tuner
+
+    tuner = get_tuner() if rank == 0 else None
+    _driven_loop(rank, eng, tuner)
+    if rank == 0:
+        assert tuner.converged
+        s = eng.stats()
+        assert s["stale_epoch_msgs"] >= 1, s["stale_epoch_msgs"]
+
+
+def scenario_hang(rank, size, eng):
+    # A rank wedges mid-trial (HOROVOD_FAULT_INJECT hang +
+    # HOROVOD_FAULT_TIMEOUT_SEC): the coordinator's failure detector
+    # aborts the world; the trial is discarded with it and the tuner
+    # thread exits instead of wedging the process — every SURVIVING rank
+    # gets a clean HorovodInternalError and exits 0.  The wedged rank
+    # itself blocks in Wait forever (its background loop is frozen by
+    # design); SIGALRM's default action kills it, same discipline as
+    # native_worker's scenario_fault_steps.
+    from horovod_tpu.autotune import get_tuner
+
+    frank = int(os.environ["HOROVOD_FAULT_INJECT"].split(":")[0])
+    if rank == frank:
+        import signal
+
+        signal.alarm(25)
+    tuner = get_tuner() if rank == 0 else None
+    try:
+        _driven_loop(rank, eng, tuner, max_steps=100000)
+    except HorovodInternalError as e:
+        if rank == 0 and tuner is not None:
+            tuner.join(20)
+            assert not tuner.is_alive(), "tuner thread wedged after abort"
+            assert not tuner.converged, \
+                "tuner committed a config from an aborted world"
+        print(f"worker rank={rank} got expected abort: {e}", flush=True)
+        return
+    raise AssertionError(f"rank {rank}: expected an abort, none came")
+
+
+SCENARIOS = {
+    "disabled": scenario_disabled,
+    "live": scenario_live,
+    "warm": scenario_warm,
+    "warm_restart": scenario_warm_restart,
+    "epoch": scenario_epoch,
+    "stale": scenario_stale,
+    "hang": scenario_hang,
+}
+
+
+def main():
+    scenario = sys.argv[1]
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    SCENARIOS[scenario](rank, size, eng)
+    basics.shutdown()
+    print(f"worker rank={rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

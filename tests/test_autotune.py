@@ -1,0 +1,182 @@
+"""Online-autotuner tests (coordinator-driven knob search, TUNE frames).
+
+Three layers:
+
+* pure-python determinism of the seeded coordinate-descent schedule and
+  the state-file round trip (no processes);
+* live multi-process searches at 2 and 4 ranks
+  (tests/autotune_worker.py bodies): convergence within the trial cap,
+  schedule determinism against an independently planned one, committed
+  config in force on every rank, and HOROVOD_AUTOTUNE=0 (the default)
+  bit-for-bit untouched;
+* lifecycle/fault: state-file warm start skips the search, the
+  committed config survives a shutdown + re-init (the elastic
+  resize path — new membership epoch, tuner re-commits without
+  re-searching), stale-epoch control frames are dropped + counted while
+  tuning, and a rank hanging mid-trial discards the trial and aborts
+  cleanly instead of wedging.
+"""
+
+import os
+import signal
+
+import pytest
+
+from tests.test_native_engine import run_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "autotune_worker.py")
+
+# Small fixed-bytes windows so a full default schedule (~18 trials)
+# finishes in seconds: the loop moves 1 MiB per allreduce, so every
+# trial scores over ~2 steps of traffic.
+TUNE_ENV = {
+    "HOROVOD_AUTOTUNE": "1",
+    "HOROVOD_AUTOTUNE_SEED": "7",
+    "HOROVOD_AUTOTUNE_WINDOW_BYTES": str(2 << 20),
+    "HOROVOD_AUTOTUNE_TRIAL_TIMEOUT_SEC": "20",
+}
+
+
+# -- pure-python determinism (no processes) --------------------------------
+
+def test_search_schedule_deterministic_for_seed():
+    from horovod_tpu.autotune import CoordinateSearch, default_space
+
+    space = default_space(4)
+    a = CoordinateSearch(space, seed=11).planned_schedule()
+    b = CoordinateSearch(space, seed=11).planned_schedule()
+    assert a == b
+    assert len(a) == sum(len(v) for v in space.values())
+    # A different seed permutes the knob order (ladders are per-knob
+    # contiguous either way).
+    c = CoordinateSearch(space, seed=12).planned_schedule()
+    assert sorted(a) == sorted(c)
+
+
+def test_search_coordinate_descent_commits_ladder_winners():
+    from horovod_tpu.autotune import CoordinateSearch
+
+    space = {"a": [1, 2, 4], "b": [10, 20]}
+    s = CoordinateSearch(space, seed=3)  # seed 3 sweeps b first, then a
+    fake = {("a", 1): 1.0, ("a", 2): 5.0, ("a", 4): 2.0,
+            ("b", 10): 1.0, ("b", 20): 3.0}
+    for knob, value in s.planned_schedule():
+        cfg = s.propose()
+        assert cfg[knob] == value
+        s.observe(fake[(knob, value)])
+    assert s.converged
+    assert s.best == {"a": 2, "b": 20}
+    # best_score is the score MEASURED AT the committed point: the last
+    # ladder's winning trial (a=2) ran with b already fixed at 20, so
+    # its config equals `best` — not a max over unrelated trials.
+    assert s.best_score == fake[("a", 2)]
+
+
+def test_search_discarded_trials_cannot_win():
+    from horovod_tpu.autotune import CoordinateSearch
+
+    s = CoordinateSearch({"a": [1, 2, 4]}, seed=0, base={"a": 1})
+    scores = {1: 1.0, 2: None, 4: 0.5}  # the best-looking trial timed out
+    while (cfg := s.propose()) is not None:
+        s.observe(scores[cfg["a"]])
+    assert s.best == {"a": 1}
+
+
+def test_search_max_trials_truncates_and_still_converges():
+    from horovod_tpu.autotune import CoordinateSearch, default_space
+
+    s = CoordinateSearch(default_space(4), seed=0, max_trials=5)
+    n = 0
+    while s.propose() is not None:
+        s.observe(1.0)
+        n += 1
+    assert n == 5 and s.converged
+
+
+def test_state_file_round_trip(tmp_path):
+    from horovod_tpu.autotune import load_state, save_state
+
+    path = str(tmp_path / "autotune.json")
+    committed = {"chunk_bytes": 1 << 20, "cycle_time_ms": 2,
+                 "fusion_threshold": 32 << 20, "wave_width": 2}
+    save_state(path, committed, 123.0, seed=7,
+               wiring={"num_channels": 2, "channel_drivers": 2})
+    state = load_state(path)
+    assert state["committed"] == committed
+    assert state["wiring"] == {"num_channels": 2, "channel_drivers": 2}
+    # Corruption degrades to a cold search, never a crash.
+    with open(path, "w") as f:
+        f.write("{not json")
+    assert load_state(path) is None
+    assert load_state(str(tmp_path / "missing.json")) is None
+
+
+# -- live searches ---------------------------------------------------------
+
+def test_autotune_off_is_untouched():
+    """HOROVOD_AUTOTUNE unset (the default): zero TUNE frames anywhere,
+    env-default effective config, bit-exact integer collectives."""
+    run_workers(2, "disabled", timeout=120, worker=WORKER)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_autotune_live_converges_deterministically(n):
+    """Full online search at 2 and 4 ranks: converges within the trial
+    cap, the executed schedule equals the seed's planned one, and the
+    committed config is in force on EVERY rank."""
+    run_workers(n, "live", timeout=240, worker=WORKER, extra_env=TUNE_ENV)
+
+
+def test_tune_trials_visible_in_timeline(tmp_path):
+    """TUNE_TRIAL(config) markers + per-scoring-window spans and the
+    final TUNE_COMMIT land on the dedicated autotune track."""
+    path = tmp_path / "timeline.json"
+    run_workers(2, "live", timeout=240, worker=WORKER,
+                extra_env={**TUNE_ENV, "HOROVOD_TIMELINE": str(path)})
+    text = path.read_text()
+    assert "TUNE_TRIAL(chunk=" in text
+    assert "TUNE_COMMIT(" in text
+
+
+def test_state_file_warm_start_skips_search(tmp_path):
+    """Converge once (state file written), then FRESH processes against
+    the same file: zero trials, committed config + probed wiring applied
+    straight away."""
+    env = {**TUNE_ENV,
+           "HOROVOD_AUTOTUNE_STATE_FILE": str(tmp_path / "state.json")}
+    run_workers(2, "warm", timeout=240, worker=WORKER, extra_env=env)
+    run_workers(2, "warm_restart", timeout=120, worker=WORKER,
+                extra_env=env)
+
+
+def test_committed_config_survives_reinit_under_new_epoch():
+    """shutdown + re-init in the same processes (every rendezvous commit
+    bumps the membership epoch — the path an elastic shrink/rejoin
+    takes): the tuner re-commits the config under the new epoch without
+    re-running the search."""
+    run_workers(2, "epoch", timeout=300, worker=WORKER, extra_env=TUNE_ENV)
+
+
+@pytest.mark.fault
+def test_stale_tune_frames_dropped_while_tuning():
+    """A dead incarnation's control frame injected mid-search
+    (stale-epoch fault kind): structurally dropped + counted by the
+    coordinator while TUNE traffic keeps flowing — the search still
+    converges."""
+    run_workers(2, "stale", timeout=240, worker=WORKER,
+                extra_env={**TUNE_ENV,
+                           "HOROVOD_FAULT_INJECT": "1:20:stale-epoch"})
+
+
+@pytest.mark.fault
+def test_hang_mid_trial_discards_trial_no_wedge():
+    """A rank wedges mid-trial: the failure detector aborts the world
+    within HOROVOD_FAULT_TIMEOUT_SEC, the surviving rank's tuner thread
+    exits without committing, nothing hangs (the subprocess timeout is
+    the wedge detector)."""
+    run_workers(2, "hang", timeout=120, worker=WORKER,
+                extra_env={**TUNE_ENV,
+                           "HOROVOD_FAULT_INJECT": "1:25:hang",
+                           "HOROVOD_FAULT_TIMEOUT_SEC": "6"},
+                expected_rc={1: -signal.SIGALRM})
