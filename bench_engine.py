@@ -34,7 +34,17 @@ Prints ONE JSON line, e.g.::
      "control_round_trips_per_step_nocache": {"2": .., "4": ..},
      "allreduce_bus_bw_mb_s": {"2": {"4KB": .., ..}, "4": {..}},
      "allreduce_bus_bw_mb_s_1ch": {"2": {..}, "4": {..}},
-     "allreduce_small_latency_ms": {"2": ..}}
+     "allreduce_bus_bw_mb_s_shm": {"2": {..}, "4": {..}},
+     "allreduce_small_latency_ms": {"2": ..},
+     "allreduce_small_latency_ms_shm": {"2": ..},
+     "algo_threshold_sweep": {"256B": {"star": .., "ring": ..}, ..}}
+
+The TCP-plane keys (``allreduce_bus_bw_mb_s``/``_1ch`` and
+``allreduce_small_latency_ms``) pin ``HOROVOD_SHM_DISABLE=1`` so they
+stay comparable with the pre-shm trajectory; the ``_shm`` variants
+measure the default plane (shm flat ring + size-based algorithm
+selection), and ``algo_threshold_sweep`` interleaves the star and ring
+paths per payload size so the crossover is visible.
 
 ``bench.py`` merges these keys into the bench artifact under an
 ``engine_`` prefix; standalone use: ``python bench_engine.py``.
@@ -43,7 +53,10 @@ Prints ONE JSON line, e.g.::
 one 4-rank worker set alternates channels=4 / channels=1 in-process
 (shutdown + re-init between rounds, so slow machine drift hits both
 configs equally) on 16 MB allreduces and fails loudly when the median
-bandwidth ratio falls below the gate threshold.
+bandwidth ratio falls below the gate threshold.  ``--shm-gate`` is the
+shm analogue: alternate shm on / off in-process on the small-allreduce
+latency (2 ranks) and 16 MB bus bandwidth (4 ranks), judged as a
+regression floor on the best interleaved round.
 """
 
 from __future__ import annotations
@@ -196,6 +209,90 @@ def _gate_worker() -> None:
     if basics.rank() == 0:
         for multi, single in pairs:
             print(f"GATE_PAIR {multi:.1f} {single:.1f}", flush=True)
+    basics.shutdown()
+
+
+def _shm_gate_worker() -> None:
+    """Alternate shm ON / shm OFF in-process (re-init between rounds, so
+    ambient-load drift hits both transports): per round, the small-
+    allreduce latency and/or the 16 MB bus bandwidth under each —
+    BENCH_GATE_METRIC=lat|bw measures only the judged metric (the gate
+    judges one per world size; measuring the other would double the
+    wall time inside ci.sh's hard timeout).  The driver judges the
+    pairs."""
+    import numpy as np
+
+    basics, eng = _engine_setup()
+    metric = os.environ.get("BENCH_GATE_METRIC", "both")
+
+    def lat_ms(iters=100):
+        x = np.ones(1, dtype=np.float32)
+        for _ in range(5):
+            eng.allreduce(x.copy(), name="sg.w")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eng.synchronize(eng.enqueue_allreduce(x.copy(), name="sg.t"))
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    def bw_mb_s():
+        return _measure_bus_bw_mb_s(basics, eng, 16 << 20, 5)
+
+    rounds = int(os.environ.get("BENCH_GATE_ROUNDS", "3"))
+    pairs = []
+    for _ in range(rounds):
+        os.environ.pop("HOROVOD_SHM_DISABLE", None)
+        basics.shutdown()
+        basics.init()
+        assert eng.stats()["config"]["shm_enabled"], "shm did not engage"
+        s_lat = lat_ms() if metric != "bw" else 0.0
+        s_bw = bw_mb_s() if metric != "lat" else 0.0
+        os.environ["HOROVOD_SHM_DISABLE"] = "1"
+        basics.shutdown()
+        basics.init()
+        t_lat = lat_ms() if metric != "bw" else 0.0
+        t_bw = bw_mb_s() if metric != "lat" else 0.0
+        pairs.append((s_lat, t_lat, s_bw, t_bw))
+    if basics.rank() == 0:
+        for s_lat, t_lat, s_bw, t_bw in pairs:
+            print(f"SHM_GATE_PAIR lat {s_lat:.3f} {t_lat:.3f} "
+                  f"bw {s_bw:.1f} {t_bw:.1f}", flush=True)
+    basics.shutdown()
+
+
+def _algo_sweep_worker() -> None:
+    """Per-payload-size latency with the star path engaged (threshold
+    above every size) vs disabled (pure ring), interleaved in-process:
+    the table shows where the latency/bandwidth crossover actually sits
+    on this host."""
+    import numpy as np
+
+    basics, eng = _engine_setup()
+    sizes = [("256B", 256), ("4KB", 4 << 10), ("32KB", 32 << 10),
+             ("256KB", 256 << 10)]
+
+    def lat_ms(nbytes, iters=60):
+        x = np.ones(max(1, nbytes // 4), dtype=np.float32)
+        for _ in range(3):
+            eng.allreduce(x.copy(), name="as.w")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eng.synchronize(eng.enqueue_allreduce(x.copy(), name="as.t"))
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    rows = []
+    for label, nbytes in sizes:
+        os.environ["HOROVOD_ALGO_THRESHOLD"] = str(1 << 20)
+        basics.shutdown()
+        basics.init()
+        star = lat_ms(nbytes)
+        os.environ["HOROVOD_ALGO_THRESHOLD"] = "0"
+        basics.shutdown()
+        basics.init()
+        ring = lat_ms(nbytes)
+        rows.append((label, star, ring))
+    if basics.rank() == 0:
+        for label, star, ring in rows:
+            print(f"ALGO_SWEEP {label} {star:.3f} {ring:.3f}", flush=True)
     basics.shutdown()
 
 
@@ -430,33 +527,58 @@ def main() -> None:
     result["control_round_trips_per_step_nocache"] = rt_per_step_nocache
 
     # Data-plane size sweep: bus bandwidth with the channel fan-out vs the
-    # single-channel legacy path, 4 KB -> 64 MB at 2 and 4 ranks.
+    # single-channel legacy path (both pinned to the TCP plane for
+    # trajectory comparability) vs the default shm plane, 4 KB -> 64 MB
+    # at 2 and 4 ranks.
     sweep: dict = {}
     sweep_1ch: dict = {}
+    sweep_shm: dict = {}
     sizes = [("4KB", 4 << 10), ("64KB", 64 << 10), ("1MB", 1 << 20),
              ("16MB", 16 << 20), ("64MB", 64 << 20)]
     for n in (2, 4):
-        for dest, ch in ((sweep, "4"), (sweep_1ch, "1")):
+        for dest, env in ((sweep, {"HOROVOD_NUM_CHANNELS": "4",
+                                   "HOROVOD_SHM_DISABLE": "1"}),
+                          (sweep_1ch, {"HOROVOD_NUM_CHANNELS": "1",
+                                       "HOROVOD_SHM_DISABLE": "1"}),
+                          (sweep_shm, {"HOROVOD_NUM_CHANNELS": "4"})):
             per_size = dest.setdefault(str(n), {})
             for label, nbytes in sizes:
                 out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
                                      "--sweep-worker"],
-                                 extra_env={"HOROVOD_NUM_CHANNELS": ch,
+                                 extra_env={**env,
                                             "BENCH_SWEEP_BYTES": str(nbytes)})
                 m = re.search(r"SWEEP_BUS_MB_S ([\d.]+)", out)
                 if m:
                     per_size[label] = float(m.group(1))
     result["allreduce_bus_bw_mb_s"] = sweep
     result["allreduce_bus_bw_mb_s_1ch"] = sweep_1ch
+    result["allreduce_bus_bw_mb_s_shm"] = sweep_shm
 
-    # Single-allreduce latency on the single-channel path at 2 ranks (the
-    # PR 2 control-plane number; must not regress).
+    # Single-allreduce latency at 2 ranks: single-channel TCP (the PR 2
+    # control-plane number; must not regress) and the default shm plane
+    # (star path — the PR 6 gated metric).
+    lat: dict = {}
+    for key, env in (("allreduce_small_latency_ms",
+                      {"HOROVOD_NUM_CHANNELS": "1",
+                       "HOROVOD_SHM_DISABLE": "1"}),
+                     ("allreduce_small_latency_ms_shm", {})):
+        out = _run_ranks(2, [sys.executable, os.path.abspath(__file__),
+                             "--latency-worker"], extra_env=env)
+        m = re.search(r"LATENCY_MS ([\d.]+)", out)
+        lat[key] = {"2": float(m.group(1))} if m else {}
+    result["allreduce_small_latency_ms"] = lat["allreduce_small_latency_ms"]
+    result["allreduce_small_latency_ms_shm"] = \
+        lat["allreduce_small_latency_ms_shm"]
+
+    # Algorithm-threshold sweep at 2 ranks: star vs ring latency per
+    # payload size, interleaved in-process so drift hits both paths.
+    algo_sweep: dict = {}
     out = _run_ranks(2, [sys.executable, os.path.abspath(__file__),
-                         "--latency-worker"],
-                     extra_env={"HOROVOD_NUM_CHANNELS": "1"})
-    m = re.search(r"LATENCY_MS ([\d.]+)", out)
-    result["allreduce_small_latency_ms"] = (
-        {"2": float(m.group(1))} if m else {})
+                         "--algo-sweep-worker"], timeout=300)
+    for label, star, ring in re.findall(
+            r"ALGO_SWEEP (\S+) ([\d.]+) ([\d.]+)", out):
+        algo_sweep[label] = {"star": float(star), "ring": float(ring)}
+    result["algo_threshold_sweep"] = algo_sweep
 
     # Online-autotuned 16 MB bus bandwidth next to the static numbers,
     # plus the config the search committed (docs/autotune.md).
@@ -505,9 +627,13 @@ def gate() -> None:
     cores per rank, set HOROVOD_GATE_RATIO=1.5 to assert the genuine
     link-parallelism win (there the rounds are stable)."""
     threshold = float(os.environ.get("HOROVOD_GATE_RATIO", "0.85"))
+    # Pinned to the TCP plane: this gate was calibrated on it, and the
+    # channels-vs-single comparison stays meaningful there; the shm
+    # plane has its own gate (--shm-gate).
     out = _run_ranks(4, [sys.executable, os.path.abspath(__file__),
                          "--gate-worker"], timeout=420,
-                     extra_env={"BENCH_GATE_ROUNDS": "4"})
+                     extra_env={"BENCH_GATE_ROUNDS": "4",
+                                "HOROVOD_SHM_DISABLE": "1"})
     pairs = [(float(a), float(b)) for a, b in
              re.findall(r"GATE_PAIR ([\d.]+) ([\d.]+)", out)]
     if not pairs:
@@ -530,6 +656,55 @@ def gate() -> None:
               "not clear the threshold in any round")
         sys.exit(1)
     print("DATA-PLANE GATE PASSED")
+
+
+def shm_gate() -> None:
+    """CI shm gate: shm ON vs OFF, interleaved in-process per round —
+    small-allreduce latency at 2 ranks and 16 MB bus bandwidth at 4
+    ranks.  Judged as a REGRESSION FLOOR on the best interleaved round
+    (HOROVOD_SHM_GATE_RATIO, default 0.85), same convention as the
+    data-plane gate: this box's loopback CPU ceiling makes single-round
+    ratios swing with ambient load, while measured best-of rounds show
+    shm ~2x ahead on both metrics (latency 0.8 vs 1.7 ms, 16 MB busbw
+    ~1.0 vs ~0.5 GB/s under contention) — so a floor of 0.85 catches a
+    broken shm path (those rounds measure 0.3-0.6x) without flaking on
+    a quiet-box tie.  The bench JSON records both sides."""
+    threshold = float(os.environ.get("HOROVOD_SHM_GATE_RATIO", "0.85"))
+    failed = False
+    for n, metric in ((2, "lat"), (4, "bw")):
+        out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
+                             "--shm-gate-worker"], timeout=420,
+                         extra_env={"BENCH_GATE_ROUNDS": "3",
+                                    "BENCH_GATE_METRIC": metric})
+        pairs = [tuple(map(float, g)) for g in re.findall(
+            r"SHM_GATE_PAIR lat ([\d.]+) ([\d.]+) bw ([\d.]+) ([\d.]+)",
+            out)]
+        if not pairs:
+            print(f"SHM GATE FAILED at {n} ranks: no measurements "
+                  f"produced\n{out}")
+            sys.exit(1)
+        ratios = []
+        for s_lat, t_lat, s_bw, t_bw in pairs:
+            if metric == "lat":
+                # Latency: lower is better -> ratio = tcp / shm.
+                ratio = t_lat / s_lat if s_lat > 0 else 0.0
+                print(f"[{n} ranks] round: shm {s_lat:.3f} ms vs tcp "
+                      f"{t_lat:.3f} ms (x{ratio:.2f})")
+            else:
+                ratio = s_bw / t_bw if t_bw > 0 else 0.0
+                print(f"[{n} ranks] round: shm {s_bw:.0f} MB/s vs tcp "
+                      f"{t_bw:.0f} MB/s (x{ratio:.2f})")
+            ratios.append(ratio)
+        best = max(ratios)
+        print(f"[{n} ranks] best ratio x{best:.2f}, threshold "
+              f"x{threshold:.2f} (judged on best)")
+        if best < threshold:
+            failed = True
+    if failed:
+        print("SHM GATE FAILED: the shm plane did not clear the "
+              "regression floor in any round")
+        sys.exit(1)
+    print("SHM GATE PASSED")
 
 
 def autotune_gate() -> None:
@@ -594,6 +769,12 @@ if __name__ == "__main__":
         _latency_worker()
     elif "--gate-worker" in sys.argv:
         _gate_worker()
+    elif "--shm-gate-worker" in sys.argv:
+        _shm_gate_worker()
+    elif "--algo-sweep-worker" in sys.argv:
+        _algo_sweep_worker()
+    elif "--shm-gate" in sys.argv:
+        shm_gate()
     elif "--autotune-worker" in sys.argv:
         _autotune_worker()
     elif "--autotune-gate-worker" in sys.argv:

@@ -79,6 +79,24 @@ PALLAS_AXON_POOL_IPS= timeout -k 15 420 \
     python -m pytest "tests/test_data_plane.py::test_channels_bitwise_parity[4]" -q
 PALLAS_AXON_POOL_IPS= timeout -k 15 420 python bench_engine.py --gate
 
+echo "== shm gate (transport parity + latency/bandwidth floor, hard timeout) =="
+# Shared-memory hierarchical data plane: the shm flat ring (default on
+# one host) must be bit-identical to the pure-TCP plane across every
+# dtype/op at 4 ranks — including the small-tensor star path the default
+# HOROVOD_ALGO_THRESHOLD engages — and the interleaved shm-vs-tcp rounds
+# (small-allreduce latency @2 ranks, 16 MB busbw @4) must clear the
+# regression floor (see bench_engine.shm_gate: measured best-of rounds
+# put shm ~1.2-2x ahead on this box, but the loopback CPU ceiling makes
+# single rounds swing, so 0.85 is a floor, not the speedup target;
+# HOROVOD_SHM_GATE_RATIO overrides).  Hard timeouts double as the
+# spin-loop wedge detectors for the futex-free shm waits; the outer
+# bound covers BOTH sequential gate runs' 420 s per-run budgets, so a
+# slow-but-legitimate 2-rank run cannot starve the 4-rank one.
+PALLAS_AXON_POOL_IPS= timeout -k 15 420 \
+    python -m pytest "tests/test_data_plane.py::test_shm_bitwise_parity_vs_tcp[4]" \
+    "tests/test_data_plane.py::test_algo_threshold_parity[4]" -q
+PALLAS_AXON_POOL_IPS= timeout -k 15 900 python bench_engine.py --shm-gate
+
 echo "== autotune gate (online knob search vs static grid, hard timeout) =="
 # Online autotuner (HOROVOD_AUTOTUNE=1): the search must converge within
 # HOROVOD_AUTOTUNE_MAX_TRIALS at 2 and 4 ranks, and the committed config's

@@ -106,9 +106,22 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_STALL_WARNING_SEC", "60",
          lambda raw: str(_int_env(raw, 60)),
          "stalled-tensor warning cadence"),
-    Knob("HOROVOD_HIERARCHICAL_ALLREDUCE", "0",
+    Knob("HOROVOD_ALGO_THRESHOLD", "32768",
+         lambda raw: str(max(0, _int_env(raw, 32 << 10))),
+         "size-based algorithm crossover: allreduces at or under this "
+         "many bytes take the latency star path over shm (0 disables; "
+         "live-tunable)"),
+    Knob("HOROVOD_SHM_DISABLE", "0",
          lambda raw: str(_int_env(raw, 0)),
-         "two-level allreduce (needs a homogeneous block layout)"),
+         "1 = pure-TCP data plane (bit-identical; escape hatch for "
+         "broken /dev/shm)"),
+    Knob("HOROVOD_SHM_RING_BYTES", "2097152",
+         lambda raw: str(max(1 << 16, _int_env(raw, 2 << 20))),
+         "per-direction shm ring-buffer capacity"),
+    Knob("HOROVOD_HOST_KEY", "(hostname#boot-id)",
+         lambda raw: raw or "(hostname#boot-id)",
+         "co-location grouping override for rendezvous (two-level "
+         "hierarchy + shm edges form per host key)"),
     Knob("HOROVOD_ELASTIC", "0", lambda raw: str(_int_env(raw, 0)),
          "in-place elastic membership"),
     Knob("HOROVOD_AUTOTUNE", "0", lambda raw: str(_int_env(raw, 0)),

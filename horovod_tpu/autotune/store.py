@@ -18,9 +18,12 @@ __all__ = ["load_state", "save_state", "apply_wiring_warm_start"]
 
 _VERSION = 1
 
-#: Live-tunable knob names a committed config may carry.
+#: Live-tunable knob names a committed config may carry.  For
+#: ``algo_threshold`` 0 is a REAL value (small-tensor star path off), so
+#: the sanitizer below accepts >= 0 for it while the others need > 0.
 LIVE_KNOBS = ("chunk_bytes", "fusion_threshold", "cycle_time_ms",
-              "wave_width")
+              "wave_width", "algo_threshold")
+_ZERO_OK_KNOBS = ("algo_threshold",)
 #: Wiring-time knobs the startup micro-probe may pin.
 WIRING_KNOBS = {"num_channels": "HOROVOD_NUM_CHANNELS",
                 "channel_drivers": "HOROVOD_CHANNEL_DRIVERS"}
@@ -43,7 +46,8 @@ def load_state(path: str) -> Optional[dict]:
     if not isinstance(committed, dict):
         return None
     clean = {k: int(v) for k, v in committed.items()
-             if k in LIVE_KNOBS and isinstance(v, (int, float)) and v > 0}
+             if k in LIVE_KNOBS and isinstance(v, (int, float)) and
+             (v > 0 or (v == 0 and k in _ZERO_OK_KNOBS))}
     if not clean:
         return None
     state["committed"] = clean

@@ -71,6 +71,10 @@ def default_space(num_channels: int) -> Dict[str, List[int]]:
         "fusion_threshold": ladder(8 << 20, 128 << 20),
         "cycle_time_ms": [1, 2, 4, 8],
         "wave_width": ladder(1, max(1, num_channels)),
+        # Size-based algorithm crossover: 0 (star path off) plus a log
+        # ladder around the default 32 KB — the latency/bandwidth
+        # crossover is host-dependent, which is exactly why it's a knob.
+        "algo_threshold": [0] + ladder(8 << 10, 256 << 10),
     }
     only = os.environ.get("HOROVOD_AUTOTUNE_KNOBS", "")
     if only:
@@ -167,6 +171,7 @@ class Autotuner(threading.Thread):
             fusion_threshold=cfg.get("fusion_threshold", 0),
             cycle_time_ms=cfg.get("cycle_time_ms", 0),
             wave_width=cfg.get("wave_width", 0),
+            algo_threshold=cfg.get("algo_threshold", -1),
             commit=commit)
         if not ok:
             return False
@@ -237,7 +242,7 @@ class Autotuner(threading.Thread):
         self.epoch = self._eng.epoch()
         base = {k: int(v) for k, v in self._eng.stats()["config"].items()
                 if k in ("chunk_bytes", "fusion_threshold",
-                         "cycle_time_ms", "wave_width")}
+                         "cycle_time_ms", "wave_width", "algo_threshold")}
         space = default_space(self._eng.stats()["config"]["num_channels"])
         search = CoordinateSearch(space, seed=self.seed, base=base,
                                   max_trials=self.max_trials)

@@ -126,6 +126,33 @@ int64_t horovod_num_channels() {
   return static_cast<int64_t>(Engine::Get().num_channels());
 }
 
+// Shared-memory / hierarchy observability: payload bytes through shm
+// rings (also counted in data_bytes_*; shm is a transport of the same
+// data plane), bytes exchanged with co-located ranks, allreduce responses
+// per algorithm path (latency star vs. bandwidth ring), and the committed
+// host topology (host count x this rank's group size).
+int64_t horovod_shm_bytes_tx() { return Engine::Get().shm_bytes_tx(); }
+int64_t horovod_shm_bytes_rx() { return Engine::Get().shm_bytes_rx(); }
+int64_t horovod_intra_host_bytes() {
+  return Engine::Get().intra_host_bytes();
+}
+int64_t horovod_algo_small_count() {
+  return Engine::Get().algo_small_count();
+}
+int64_t horovod_algo_ring_count() {
+  return Engine::Get().algo_ring_count();
+}
+int64_t horovod_topology_hosts() {
+  return static_cast<int64_t>(Engine::Get().topology_hosts());
+}
+int64_t horovod_topology_local_ranks() {
+  return static_cast<int64_t>(Engine::Get().topology_local_ranks());
+}
+int64_t horovod_shm_enabled() {
+  return Engine::Get().shm_enabled() ? 1 : 0;
+}
+int64_t horovod_algo_threshold() { return Engine::Get().algo_threshold(); }
+
 // Effective (currently in-force) knob values for stats()["config"]:
 // post-autotune, not the env defaults — chunk/fusion/cycle/wave are
 // live-tunable, the rest report the committed wiring-time resolution.
@@ -153,14 +180,16 @@ int64_t horovod_tune_trials() { return Engine::Get().tune_trials(); }
 
 // Online-autotuner proposal (coordinator only): queue a knob config for
 // the next cycle's epoch-stamped TUNE broadcast; every rank applies it
-// between cycles.  Values <= 0 leave that knob unchanged; commit != 0
-// marks the search's final config.  Returns 0 queued, -1 when not
-// initialized or not the coordinator.
+// between cycles.  Values <= 0 leave that knob unchanged — EXCEPT
+// algo_threshold, where 0 is a real value (small path off) and "leave
+// unchanged" is < 0; commit != 0 marks the search's final config.
+// Returns 0 queued, -1 when not initialized or not the coordinator.
 int horovod_autotune_set(int64_t chunk_bytes, int64_t fusion_threshold,
                          int64_t cycle_time_ms, int64_t wave_width,
-                         int commit) {
+                         int64_t algo_threshold, int commit) {
   return Engine::Get().QueueTune(chunk_bytes, fusion_threshold,
-                                 cycle_time_ms, wave_width, commit != 0);
+                                 cycle_time_ms, wave_width, algo_threshold,
+                                 commit != 0);
 }
 
 // Why the engine aborted, copied into buf (truncated to buflen-1); empty

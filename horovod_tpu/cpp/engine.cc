@@ -353,6 +353,53 @@ static int64_t EnvInt64(const char* name, int64_t dflt) {
   return std::strtoll(v, nullptr, 10);
 }
 
+// Identity used for co-location grouping at rendezvous.  HOROVOD_HOST_KEY
+// overrides (tests fake multi-host topologies on one box with it);
+// otherwise hostname#boot-id — the boot id disambiguates containers that
+// share a hostname but not a kernel (where shm would silently not be
+// shared).
+static std::string HostKey() {
+  const char* k = std::getenv("HOROVOD_HOST_KEY");
+  if (k != nullptr && k[0] != '\0') return k;
+  char host[256] = {0};
+  ::gethostname(host, sizeof(host) - 1);
+  std::string key(host);
+  if (FILE* f = std::fopen("/proc/sys/kernel/random/boot_id", "r")) {
+    char b[64] = {0};
+    if (std::fgets(b, sizeof(b), f) != nullptr) {
+      for (char* p = b; *p; ++p) {
+        if (*p == '\n' || *p == '\r') *p = '\0';
+      }
+      key += "#";
+      key += b;
+    }
+    std::fclose(f);
+  }
+  return key;
+}
+
+// Derive this rank's group view (node id, members, leaders) from the
+// committed rank_host_ table — identical on every rank, so the shm edge
+// names and the two-level message pattern agree across the world.
+void Engine::AdoptTopology() {
+  const int n = size_;
+  if (static_cast<int>(rank_host_.size()) != n) rank_host_.assign(n, 0);
+  nnodes_ = 1;
+  for (auto g : rank_host_) nnodes_ = std::max(nnodes_, g + 1);
+  node_id_ = rank_host_[rank_];
+  group_members_.clear();
+  group_leaders_.assign(nnodes_, -1);
+  for (int r = 0; r < n; ++r) {
+    if (group_leaders_[rank_host_[r]] < 0) group_leaders_[rank_host_[r]] = r;
+    if (rank_host_[r] == node_id_) group_members_.push_back(r);
+  }
+  group_size_ = static_cast<int>(group_members_.size());
+  local_index_ = 0;
+  for (int i = 0; i < group_size_; ++i) {
+    if (group_members_[i] == rank_) local_index_ = i;
+  }
+}
+
 int Engine::Init(int rank, int size, int local_rank, int local_size,
                  const std::string& coordinator_addr) {
   if (initialized_.load()) return 0;
@@ -412,6 +459,25 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     if (chunk < 4096) chunk = 4096;
     chunk_bytes_.store(chunk & ~int64_t{7});  // 8-aligned for every dtype
   }
+  // Size-based algorithm selection: payloads at or under the threshold
+  // take the latency star path when shm star edges exist (0 disables; the
+  // coordinator's committed value is broadcast at rendezvous so every
+  // rank picks the same wire pattern, and TUNE frames retune it live).
+  {
+    int64_t at = EnvInt64("HOROVOD_ALGO_THRESHOLD", 32 << 10);
+    algo_threshold_.store(at < 0 ? 0 : at);
+  }
+  shm_ring_bytes_ = EnvInt64("HOROVOD_SHM_RING_BYTES", 2 << 20);
+  if (shm_ring_bytes_ < (1 << 16)) shm_ring_bytes_ = 1 << 16;
+  // HOROVOD_SHM_DISABLE=1: escape hatch back to the pure-TCP data plane
+  // (bit-identical — transport never changes values).  The coordinator's
+  // resolution (env AND a runtime /dev/shm probe) is committed at
+  // rendezvous; this env read only seeds the single-rank/world-of-one
+  // value.
+  shm_enabled_ = EnvInt64("HOROVOD_SHM_DISABLE", 0) == 0;
+  two_level_ = false;
+  shm_ring_active_ = false;
+  rank_host_.clear();
   // A previous incarnation's unshipped TUNE proposal must not leak into
   // the new world (tune_trials_ stays process-cumulative like every
   // other counter).
@@ -554,6 +620,9 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       last_error_ = "coordinator address host:port required for size > 1";
       return 1;
     }
+    // Job tag for shm segment names: the coordinator port is unique per
+    // live job on a host, and every name is additionally epoch-stamped.
+    shm_prefix_ = "hvd" + std::to_string(port) + "_";
     std::string err;
     const char* my_host_env = std::getenv("HOROVOD_HOST");
     std::string my_host = my_host_env ? my_host_env : "127.0.0.1";
@@ -589,10 +658,31 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     // elastic re-init may be smaller than the env identity.  A world
     // shrunk to one keeps its control listener open (a later candidate
     // triggers a grow re-rendezvous) but wires no rings.
+    // Derive the topology view from the committed grouping: identical on
+    // every rank (the table was broadcast), so leader tables, shm edge
+    // names and the two-level message pattern agree across the world.
+    AdoptTopology();
+    // Two-level collectives need BOTH a multi-group world and at least
+    // one group worth decomposing; shm must be committed because the
+    // intra-group phases run over shm edges.  Everything else (single
+    // host, one-rank-per-host, shm off) is a flat ring — over shm when
+    // the whole world is one group and shm is on, over TCP otherwise.
+    two_level_ = shm_enabled_ && nnodes_ > 1 && size_ > nnodes_;
+    if (!shm_enabled_ && nnodes_ > 1 && size_ > nnodes_ && rank_ == 0) {
+      // A hierarchical topology exists but the intra-group phases cannot
+      // run (shm off or unavailable on some host), so every rank joins
+      // the flat cross-network ring.  Loud, because the bandwidth cost
+      // is size_/nnodes_ extra ring participants per real link.
+      std::fprintf(stderr,
+                   "horovod_tpu: %d hosts x %d ranks committed but shared "
+                   "memory is %s — collectives fall back to the flat "
+                   "world-wide TCP ring (no per-host leaders).\n",
+                   nnodes_, size_ / nnodes_,
+                   EnvInt64("HOROVOD_SHM_DISABLE", 0) != 0
+                       ? "disabled (HOROVOD_SHM_DISABLE=1)"
+                       : "unavailable on at least one host");
+    }
     if (size_ > 1) {
-    node_id_ = rank_ / local_size_;
-    nnodes_ = local_size_ > 0 ? size_ / local_size_ : 1;
-
     // Ring wiring.  Each directed ring edge is its own TCP connection —
     // the GLOBAL ring opens num_channels_ independent connections per
     // edge (the data-plane fan-out; each channel later carries its own
@@ -614,21 +704,27 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     ring_prev_.clear();
     ring_next_.resize(num_channels_);
     ring_prev_.resize(num_channels_);
+    cross_next_.clear();
+    cross_prev_.clear();
     std::vector<Edge> outgoing, incoming;
     for (int32_t c = 0; c < num_channels_; ++c) {
       outgoing.push_back({(rank_ + 1) % size_, GLOBAL, c, &ring_next_[c]});
       incoming.push_back(
           {(rank_ - 1 + size_) % size_, GLOBAL, c, &ring_prev_[c]});
     }
-    if (hierarchical_) {
-      int L = local_size_, lr = local_rank_, base = node_id_ * L;
-      outgoing.push_back({base + (lr + 1) % L, LOCAL, 0, &local_next_});
-      incoming.push_back({base + (lr - 1 + L) % L, LOCAL, 0, &local_prev_});
-      if (lr == 0) {  // node leader: ring over one rank per node
-        outgoing.push_back(
-            {((node_id_ + 1) % nnodes_) * L, CROSS, 0, &cross_next_});
-        incoming.push_back({((node_id_ - 1 + nnodes_) % nnodes_) * L, CROSS,
-                            0, &cross_prev_});
+    if (two_level_ && local_index_ == 0 && nnodes_ > 1) {
+      // One leader per host participates in the inter-host ring, with the
+      // full channel fan-out (this is the hop that crosses a real
+      // network, so it gets the same sharded streaming cascade as the
+      // flat ring).
+      cross_next_.resize(num_channels_);
+      cross_prev_.resize(num_channels_);
+      for (int32_t c = 0; c < num_channels_; ++c) {
+        outgoing.push_back({group_leaders_[(node_id_ + 1) % nnodes_], CROSS,
+                            c, &cross_next_[c]});
+        incoming.push_back({group_leaders_[(node_id_ - 1 + nnodes_) %
+                                           nnodes_],
+                            CROSS, c, &cross_prev_[c]});
       }
     }
     for (auto& edge : outgoing) {
@@ -704,10 +800,8 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     std::vector<Socket*> data_socks;
     for (auto& s : ring_next_) data_socks.push_back(&s);
     for (auto& s : ring_prev_) data_socks.push_back(&s);
-    data_socks.push_back(&local_next_);
-    data_socks.push_back(&local_prev_);
-    data_socks.push_back(&cross_next_);
-    data_socks.push_back(&cross_prev_);
+    for (auto& s : cross_next_) data_socks.push_back(&s);
+    for (auto& s : cross_prev_) data_socks.push_back(&s);
     std::vector<Socket*> socks = data_socks;
     socks.push_back(&coordinator_conn_);
     for (Socket* s : socks) {
@@ -724,6 +818,18 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         c.SetTimeouts(socket_timeout_sec_);
         c.EnableKeepalive();
       }
+    }
+    // Shared-memory intra-host edges: the second channel kind.  Wired
+    // AFTER the TCP rings so a failure here can still use BroadcastAbort-
+    // free cleanup (init error on every rank via its own wiring timeout).
+    if (shm_enabled_ && group_size_ > 1) {
+      std::string shm_err;
+      if (!WireShmEdges(&shm_err)) {
+        last_error_ = "shm wiring: " + shm_err;
+        CloseShmEdges();
+        return 1;
+      }
+      shm_ring_active_ = true;
     }
     // Data-plane pool: one worker per channel drives channel shards,
     // concurrent responses, and large parallel reductions.
@@ -779,8 +885,10 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
   control_listener_.SetTimeouts(2);  // Accept honors SO_RCVTIMEO
   struct JoinInfo {
     std::string host;
+    std::string host_key;
     int data_port = 0;
     int32_t lr = 0, ls = 1;
+    uint8_t shm_ok = 0;
     Socket conn;
   };
   std::map<int, JoinInfo> joined;  // worker id → latest join (sorted)
@@ -812,14 +920,21 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     std::string peer_host = r.str();
     int32_t peer_port = r.i32();
     int32_t lr = r.i32(), ls = r.i32();
+    // Co-location fields (hostname#boot-id + a local /dev/shm probe
+    // verdict): the coordinator groups ranks by host key and commits the
+    // world-wide shm decision from the AND of every member's probe.
+    std::string peer_key = r.str();
+    uint8_t peer_shm = r.u8();
     if (!r.ok() || magic != kJoinMagic || id < 1 || id >= world_size_) {
       continue;  // not a join frame from this job
     }
     JoinInfo info;
     info.host = std::move(peer_host);
+    info.host_key = std::move(peer_key);
     info.data_port = peer_port;
     info.lr = lr;
     info.ls = ls;
+    info.shm_ok = peer_shm;
     info.conn = std::move(conn);
     joined[id] = std::move(info);
   }
@@ -846,28 +961,38 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
   peer_hosts->assign(new_size, "");
   peer_ports->assign(new_size, 0);
   std::vector<int32_t> peer_lr(new_size, 0), peer_ls(new_size, 1);
+  std::vector<std::string> peer_keys(new_size);
   std::vector<int> member_ids(new_size, 0);
   std::vector<Socket> conns(new_size);
+  bool shm_commit = shm_enabled_ && ShmAvailable();
   (*peer_hosts)[0] = my_host;
   (*peer_ports)[0] = data_port;
   peer_lr[0] = local_rank_;
   peer_ls[0] = local_size_;
+  peer_keys[0] = HostKey();
   int next_rank = 1;
   for (auto& kv : joined) {
     (*peer_hosts)[next_rank] = kv.second.host;
     (*peer_ports)[next_rank] = kv.second.data_port;
     peer_lr[next_rank] = kv.second.lr;
     peer_ls[next_rank] = kv.second.ls;
+    peer_keys[next_rank] = kv.second.host_key;
+    shm_commit = shm_commit && kv.second.shm_ok != 0;
     member_ids[next_rank] = kv.first;
     conns[next_rank] = std::move(kv.second.conn);
     ++next_rank;
   }
-  // Coordinator decides the two-level topology GLOBALLY (the reference's
-  // is_homogeneous check, operations.cc:1511-1525): every member must
-  // report the same local_size, block placement (local_rank == rank %
-  // local_size) under the NEW ranks, and the layout must span >1 node —
-  // a shrunken world that broke the block layout falls back to the flat
-  // ring automatically.
+  // Coordinator commits the host grouping GLOBALLY.  Default: group by
+  // the JOIN frames' host keys (hostname#boot-id — genuinely co-located
+  // ranks share one), ids assigned by first appearance in committed rank
+  // order so every rank derives identical leader tables.
+  // HOROVOD_HIERARCHICAL_ALLREDUCE=1 instead synthesizes a block grouping
+  // rank/local_size (the reference's is_homogeneous layout,
+  // operations.cc:1511-1525) — the way tests and single-host benches
+  // force a multi-group topology — provided every member reports the
+  // same local_size and block placement under the NEW ranks; a shrunken
+  // world that broke the layout falls back to host keys automatically.
+  std::vector<int32_t> groups(new_size, 0);
   bool want_hier = EnvInt64("HOROVOD_HIERARCHICAL_ALLREDUCE", 0) != 0;
   bool hier_ok = want_hier && local_size_ > 1 &&
                  new_size % local_size_ == 0 && new_size > local_size_;
@@ -879,27 +1004,52 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
                  "horovod_tpu: HOROVOD_HIERARCHICAL_ALLREDUCE ignored — "
                  "needs a homogeneous block layout (equal local_size > 1 "
                  "dividing size, local_rank == rank %% local_size on "
-                 "every rank); using the flat ring.\n");
+                 "every rank); grouping by host key instead.\n");
   }
-  hierarchical_ = hier_ok;
+  if (hier_ok) {
+    for (int i = 0; i < new_size; ++i) groups[i] = i / local_size_;
+  } else {
+    std::unordered_map<std::string, int32_t> key_ids;
+    for (int i = 0; i < new_size; ++i) {
+      auto it = key_ids.find(peer_keys[i]);
+      if (it == key_ids.end()) {
+        it = key_ids.emplace(peer_keys[i],
+                             static_cast<int32_t>(key_ids.size())).first;
+      }
+      groups[i] = it->second;
+    }
+  }
+  rank_host_ = groups;
+  shm_enabled_ = shm_commit;
+  // Crash-mid-wiring leftovers from dead incarnations: no current-epoch
+  // segment exists yet (members create edges only after ASSIGN), so
+  // everything under this job's prefix is stale.
+  if (shm_enabled_) ShmSweepStale(shm_prefix_);
   for (int r = 1; r < new_size; ++r) {
     Writer w;
     w.u8(0);  // ok
     w.i64(new_epoch);
     w.i32(r);  // assigned rank
     w.i32(new_size);
-    w.u8(hierarchical_ ? 1 : 0);
+    // Committed shm verdict (env escape hatch AND every member's runtime
+    // probe): per-rank fallback would desync the wire pattern, so the
+    // whole world runs shm or none of it does.
+    w.u8(shm_enabled_ ? 1 : 0);
     // The coordinator's data-plane fan-out is THE fan-out: every member
     // wires exactly this many channels per ring edge, so a rank whose
     // env disagrees cannot deadlock the channel accepts.  The wave width
     // rides along for the same reason: concurrent responses pick
     // channels by list index, so mismatched wave grouping would pair
-    // different responses on one socket.
+    // different responses on one socket.  The algorithm-selection
+    // crossover is committed here too — a size-based path split is a
+    // different wire pattern, so every rank must agree on the threshold.
     w.i32(num_channels_);
     w.i32(wave_width_.load());
+    w.i64(algo_threshold_.load());
     for (int i = 0; i < new_size; ++i) {
       w.str((*peer_hosts)[i]);
       w.i32((*peer_ports)[i]);
+      w.i32(groups[i]);
     }
     if (!conns[r].SendFrame(w.bytes())) {
       last_error_ = "rendezvous assign to worker id " +
@@ -965,6 +1115,10 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     w.i32(data_port);
     w.i32(local_rank_);
     w.i32(local_size_);
+    // Co-location identity + this host's shm capability: the coordinator
+    // groups by the key and ANDs the probes into the committed verdict.
+    w.str(HostKey());
+    w.u8(shm_enabled_ && ShmAvailable() ? 1 : 0);
     std::vector<uint8_t> frame;
     // The assignment legitimately takes as long as the slowest member's
     // arrival plus — elastic — the entire grow grace window the
@@ -994,28 +1148,37 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     int64_t new_epoch = r.i64();
     int32_t new_rank = r.i32();
     int32_t new_size = r.i32();
-    uint8_t hier = r.u8();
+    uint8_t shm_on = r.u8();
     int32_t committed_channels = r.i32();
     int32_t committed_wave = r.i32();
+    int64_t committed_algo = r.i64();
     if (!r.ok() || new_size < 1 || new_rank < 0 || new_rank >= new_size ||
         committed_channels < 1 || committed_channels > 16 ||
-        committed_wave < 1 || committed_wave > 16) {
+        committed_wave < 1 || committed_wave > 16 || committed_algo < 0) {
       lasterr = "bad membership assignment frame";
       break;
     }
     peer_hosts->assign(new_size, "");
     peer_ports->assign(new_size, 0);
+    rank_host_.assign(new_size, 0);
+    bool groups_ok = true;
     for (int i = 0; i < new_size; ++i) {
       (*peer_hosts)[i] = r.str();
       (*peer_ports)[i] = r.i32();
+      rank_host_[i] = r.i32();
+      // Group ids index leader tables (AdoptTopology) — an out-of-range
+      // id from a garbled frame must fail here like the fields above,
+      // not as an OOB write or a multi-GB nnodes_ allocation there.
+      groups_ok = groups_ok && rank_host_[i] >= 0 && rank_host_[i] < new_size;
     }
-    if (!r.ok()) {
+    if (!r.ok() || !groups_ok) {
       lasterr = "bad rendezvous table";
       break;
     }
-    hierarchical_ = hier != 0;
+    shm_enabled_ = shm_on != 0;
     num_channels_ = committed_channels;
     wave_width_.store(committed_wave);
+    algo_threshold_.store(committed_algo);
     if (new_rank != worker_id_ || new_size != world_size_) {
       std::fprintf(stderr,
                    "horovod_tpu worker id %d: joined membership epoch %lld "
@@ -1181,14 +1344,204 @@ std::string Engine::AbortReason() const {
 void Engine::CloseSockets() {
   for (auto& s : ring_next_) s.Close();
   for (auto& s : ring_prev_) s.Close();
-  local_next_.Close();
-  local_prev_.Close();
-  cross_next_.Close();
-  cross_prev_.Close();
+  for (auto& s : cross_next_) s.Close();
+  for (auto& s : cross_prev_) s.Close();
+  // shm edges ride along: Close() flips the shared `closed` word, so a
+  // peer blocked in a ring wait fails fast — the shm analogue of the EOF
+  // these socket closes propagate.
+  CloseShmEdges();
   coordinator_conn_.Close();
   for (auto& c : worker_conns_) c.Close();
   control_listener_.Close();
   data_listener_.Close();
+}
+
+// ---------------------------------------------------------------------------
+// Shared-memory edges (intra-host transport + hierarchy)
+// ---------------------------------------------------------------------------
+
+void Engine::CloseShmEdges() {
+  for (auto& r : shm_ring_tx_) r.Close();
+  for (auto& r : shm_ring_rx_) r.Close();
+  for (auto& e : shm_star_) {
+    e.tx.Close();
+    e.rx.Close();
+  }
+  shm_ring_tx_.clear();
+  shm_ring_rx_.clear();
+  shm_star_.clear();
+  shm_ring_active_ = false;
+}
+
+void Engine::CountShmBytes(int64_t tx, int64_t rx) {
+  if (tx > 0) {
+    shm_bytes_tx_.fetch_add(tx);
+    data_bytes_tx_.fetch_add(tx);
+  }
+  if (rx > 0) {
+    shm_bytes_rx_.fetch_add(rx);
+    data_bytes_rx_.fetch_add(rx);
+  }
+  if (tx + rx > 0) intra_host_bytes_.fetch_add(tx + rx);
+}
+
+void Engine::CountPortBytes(const RingPort& port, int64_t tx, int64_t rx) {
+  if (port.is_shm()) {
+    CountShmBytes(tx, rx);
+    return;
+  }
+  if (tx > 0) data_bytes_tx_.fetch_add(tx);
+  if (rx > 0) data_bytes_rx_.fetch_add(rx);
+}
+
+// Wire the group's shm edges for the committed epoch.  Name scheme (all
+// under the job prefix, all epoch-stamped so a dead incarnation can never
+// collide):  ring edge from group position i toward (i+1)%L on channel c:
+//   /<prefix>e<epoch>_g<gid>_r<i>_c<c>
+// star edge member i <-> leader, one ring per direction:
+//   /<prefix>e<epoch>_g<gid>_u<i>   (member produces, leader consumes)
+//   /<prefix>e<epoch>_g<gid>_d<i>   (leader produces, member consumes)
+// Creation order is deadlock-free: every process creates ALL its segments
+// first, then attaches (Attach retries until the creator's segment
+// appears), then waits for its own segments' attach confirmations and
+// unlinks the names — after wiring, /dev/shm holds nothing for this
+// group, so a SIGKILL cannot leak entries for wired edges.
+bool Engine::WireShmEdges(std::string* err) {
+  const int L = group_size_;
+  const int i = local_index_;
+  char tag[96];
+  std::snprintf(tag, sizeof(tag), "/%se%lld_g%d_", shm_prefix_.c_str(),
+                static_cast<long long>(epoch_.load()), node_id_);
+  // A crash DURING a previous wiring attempt on THIS host leaves named
+  // segments behind; the group leader sweeps everything under the job
+  // prefix that is not stamped with the current epoch (current-epoch
+  // names are live peers mid-wiring and must survive the sweep).
+  char keep[32];
+  std::snprintf(keep, sizeof(keep), "e%lld_",
+                static_cast<long long>(epoch_.load()));
+  if (i == 0) ShmSweepStale(shm_prefix_, keep);
+  const int64_t epoch = epoch_.load();
+  const uint64_t cap = static_cast<uint64_t>(shm_ring_bytes_);
+  auto name = [&](const char* kind, int idx, int ch) {
+    char buf[160];
+    if (ch >= 0) {
+      std::snprintf(buf, sizeof(buf), "%s%s%d_c%d", tag, kind, idx, ch);
+    } else {
+      std::snprintf(buf, sizeof(buf), "%s%s%d", tag, kind, idx);
+    }
+    return std::string(buf);
+  };
+  shm_ring_tx_.clear();
+  shm_ring_rx_.clear();
+  shm_star_.clear();
+  shm_ring_tx_.resize(num_channels_);
+  shm_ring_rx_.resize(num_channels_);
+  shm_star_.resize(i == 0 ? L : 1);
+  // 1. Create everything this rank produces.
+  for (int c = 0; c < num_channels_; ++c) {
+    if (!shm_ring_tx_[c].Create(name("r", i, c), cap, epoch, err)) {
+      return false;
+    }
+  }
+  if (i == 0) {
+    for (int m = 1; m < L; ++m) {
+      if (!shm_star_[m].tx.Create(name("d", m, -1), cap, epoch, err)) {
+        return false;
+      }
+    }
+  } else {
+    if (!shm_star_[0].tx.Create(name("u", i, -1), cap, epoch, err)) {
+      return false;
+    }
+  }
+  // 2. Attach everything this rank consumes (bounded by the rendezvous
+  // timeout: a peer death mid-wiring surfaces as a clean init error).
+  const int timeout_ms = rendezvous_timeout_sec_ * 1000;
+  const int prev = (i - 1 + L) % L;
+  for (int c = 0; c < num_channels_; ++c) {
+    if (!shm_ring_rx_[c].Attach(name("r", prev, c), epoch, timeout_ms,
+                                err)) {
+      return false;
+    }
+  }
+  if (i == 0) {
+    for (int m = 1; m < L; ++m) {
+      if (!shm_star_[m].rx.Attach(name("u", m, -1), epoch, timeout_ms,
+                                  err)) {
+        return false;
+      }
+    }
+  } else {
+    if (!shm_star_[0].rx.Attach(name("d", i, -1), epoch, timeout_ms, err)) {
+      return false;
+    }
+  }
+  // 3. Unlink-after-map: once the consumer confirmed its mapping the
+  // filesystem name — the only thing a kill could leak — goes away.
+  for (int c = 0; c < num_channels_; ++c) {
+    if (!shm_ring_tx_[c].UnlinkAfterAttach(timeout_ms)) {
+      *err = "peer never attached ring segment (died during wiring?)";
+      return false;
+    }
+  }
+  for (auto& e : shm_star_) {
+    if (e.tx.valid() && !e.tx.UnlinkAfterAttach(timeout_ms)) {
+      *err = "peer never attached star segment (died during wiring?)";
+      return false;
+    }
+  }
+  return true;
+}
+
+Engine::RingSpec Engine::TcpRingSpec() {
+  RingSpec spec;
+  spec.vrank = rank_;
+  spec.rsize = size_;
+  spec.span = "RING_CH";
+  spec.ports.resize(num_channels_);
+  for (int c = 0; c < num_channels_; ++c) {
+    spec.ports[c].next = &ring_next_[c];
+    spec.ports[c].prev = &ring_prev_[c];
+  }
+  return spec;
+}
+
+Engine::RingSpec Engine::ShmRingSpec() {
+  RingSpec spec;
+  spec.vrank = local_index_;
+  spec.rsize = group_size_;
+  spec.span = "SHM_CH";
+  spec.ports.resize(num_channels_);
+  for (int c = 0; c < num_channels_; ++c) {
+    spec.ports[c].shm_tx = &shm_ring_tx_[c];
+    spec.ports[c].shm_rx = &shm_ring_rx_[c];
+  }
+  return spec;
+}
+
+Engine::RingSpec Engine::CrossRingSpec() {
+  RingSpec spec;
+  spec.vrank = node_id_;
+  spec.rsize = nnodes_;
+  spec.span = "RING_CH";
+  spec.ports.resize(num_channels_);
+  for (int c = 0; c < num_channels_; ++c) {
+    spec.ports[c].next = &cross_next_[c];
+    spec.ports[c].prev = &cross_prev_[c];
+  }
+  return spec;
+}
+
+Engine::RingSpec Engine::FlatRingSpec() {
+  // One host group spanning the whole committed world: every flat ring
+  // edge is intra-host, so the shm rings carry it (group positions equal
+  // committed ranks, so vrank/rsize — and therefore the segment fold
+  // order — are IDENTICAL to the TCP spec's; transport never changes
+  // bits).  Anything else flat runs over TCP.
+  if (shm_ring_active_ && !two_level_ && group_size_ == size_) {
+    return ShmRingSpec();
+  }
+  return TcpRingSpec();
 }
 
 std::string Engine::TransportError(const std::string& op,
@@ -1524,7 +1877,7 @@ bool Engine::RunLoopOnce() {
 
 int Engine::QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
                       int64_t cycle_time_ms, int64_t wave_width,
-                      bool commit) {
+                      int64_t algo_threshold, bool commit) {
   if (!initialized_.load() || shut_down_.load()) return -1;
   // Only the coordinator may propose: TUNE rides its response broadcast.
   if (size_ > 1 && rank_ != 0) return -1;
@@ -1534,6 +1887,7 @@ int Engine::QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
   pending_tune_.fusion_threshold = fusion_threshold;
   pending_tune_.cycle_time_ms = static_cast<int32_t>(cycle_time_ms);
   pending_tune_.wave_width = static_cast<int32_t>(wave_width);
+  pending_tune_.algo_threshold = algo_threshold;
   pending_tune_.commit = commit;
   tune_pending_.store(true);
   cycle_cv_.notify_one();  // an idle world still ships the frame promptly
@@ -1550,6 +1904,7 @@ bool Engine::DrainPendingTune(ResponseList* out) {
   out->tune_fusion_threshold = pending_tune_.fusion_threshold;
   out->tune_cycle_time_ms = pending_tune_.cycle_time_ms;
   out->tune_wave_width = pending_tune_.wave_width;
+  out->tune_algo_threshold = pending_tune_.algo_threshold;
   tune_pending_.store(false);
   return true;
 }
@@ -1575,13 +1930,19 @@ void Engine::ApplyTune(const ResponseList& list) {
     wave_width_.store(std::min(16, std::max(1, static_cast<int>(
         list.tune_wave_width))));
   }
+  // 0 is a REAL value for the algorithm crossover (small path off), so
+  // "leave unchanged" is < 0 — matching the Init clamp (negatives → 0).
+  if (list.tune_algo_threshold >= 0) {
+    algo_threshold_.store(list.tune_algo_threshold);
+  }
   tune_trials_.fetch_add(1);
-  char desc[160];
+  char desc[192];
   std::snprintf(desc, sizeof(desc),
-                "chunk=%lld,fusion=%lld,cycle=%d,wave=%d",
+                "chunk=%lld,fusion=%lld,cycle=%d,wave=%d,algo=%lld",
                 static_cast<long long>(chunk_bytes_.load()),
                 static_cast<long long>(fusion_threshold_.load()),
-                cycle_time_ms_.load(), wave_width_.load());
+                cycle_time_ms_.load(), wave_width_.load(),
+                static_cast<long long>(algo_threshold_.load()));
   timeline_.TuneTrial(desc, list.tune_commit);
 }
 
@@ -2115,20 +2476,22 @@ static constexpr size_t kRelayChunk = 4u << 20;
 void Engine::ExecuteResponses(std::vector<Response>& responses) {
   if (responses.empty()) return;
   last_exec_time_ = std::chrono::steady_clock::now();
-  // Concurrency degree: the flat global ring has num_channels_ disjoint
-  // socket pairs, so up to that many INDEPENDENT responses can execute at
-  // once, each claiming one channel (assignment by list index — the list
-  // is identical on every rank, so rank r's channel c always talks to
-  // rank r+1's channel c about the same response).  The hierarchical
-  // local/cross rings are single pairs, so that topology executes
-  // serially, as does C == 1 — exactly the pre-channel path.
-  const int fanout =
-      (size_ > 1 && !hierarchical_ && pool_.size() > 0) ? num_channels_ : 1;
+  // Concurrency degree: the flat ring (TCP or shm — both wire
+  // num_channels_ disjoint port pairs) can run up to that many
+  // INDEPENDENT responses at once, each claiming one channel (assignment
+  // by list index — the list is identical on every rank, so rank r's
+  // channel c always talks to rank r+1's channel c about the same
+  // response).  The two-level topology executes serially — its star
+  // edges and leader gather are single-instance — but still hands the
+  // serial context the full channel range so the intra reduce-scatter
+  // and the leader cross ring shard across channels.
+  const int fanout = (size_ > 1 && pool_.size() > 0) ? num_channels_ : 1;
   // Wave width: how many independent responses run concurrently, each on
   // one disjoint channel.  Capped by the channel fan-out; live-tuned via
   // TUNE frames (every rank applies the same value at the same cycle
   // boundary, so cross-rank channel assignment stays in lockstep).
-  const int C = std::min(fanout, wave_width_.load());
+  const int C =
+      two_level_ ? 1 : std::min(fanout, wave_width_.load());
   if (C <= 1 || responses.size() <= 1) {
     ExecCtx all{0, std::max(1, fanout)};
     for (auto& resp : responses) PerformResponse(resp, all);
@@ -2282,42 +2645,13 @@ void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
   }
 }
 
-// Bandwidth-optimal ring allreduce: reduce-scatter + allgather over the
-// neighbor sockets.  Send and recv are multiplexed with poll (SendRecvAll)
-// so the ring never deadlocks on socket buffers and the hot path spawns no
-// threads (the round-1 design spent 2(N-1) thread creations per
-// collective).
-//
-// `vrank` is the rank used for segment arithmetic.  With vrank == rank,
-// after the reduce-scatter phase rank r owns the fully-reduced segment
-// (r + 1) mod size; ExecReducescatter passes vrank = rank - 1 so each rank
-// ends owning exactly segment `rank` (its scatter output).
-static bool RingReduceScatterPhase(uint8_t* base,
-                                   const std::vector<int64_t>& seg_count,
-                                   const std::vector<int64_t>& seg_off,
-                                   DataType dtype, ReduceOp op, int vrank,
-                                   int size, Socket& next, Socket& prev,
-                                   int timeout_ms, std::string* err) {
-  const size_t esize = DataTypeSize(dtype);
-  int64_t max_seg = 0;
-  for (auto c : seg_count) max_seg = std::max(max_seg, c);
-  std::vector<uint8_t> tmp(static_cast<size_t>(max_seg) * esize);
-  for (int step = 0; step < size - 1; ++step) {
-    int send_seg = (vrank - step + 2 * size) % size;
-    int recv_seg = (vrank - step - 1 + 2 * size) % size;
-    if (!SendRecvAll(next, base + seg_off[send_seg] * esize,
-                     static_cast<size_t>(seg_count[send_seg]) * esize, prev,
-                     tmp.data(),
-                     static_cast<size_t>(seg_count[recv_seg]) * esize,
-                     timeout_ms, err)) {
-      return false;
-    }
-    ReduceInto(base + seg_off[recv_seg] * esize, tmp.data(),
-               seg_count[recv_seg], dtype, op);
-  }
-  return true;
-}
-
+// Ring segment arithmetic, shared by every ring and by the star fold that
+// emulates it.  `vrank` is the rank used for segment bookkeeping: after
+// the reduce-scatter phase, (v)rank r owns the fully-reduced segment
+// (r + 1) mod size — so segment s is accumulated in ring order
+// s, s+1, ..., s+size-1 (mod size), the fold order StarFoldAllreduce
+// reproduces exactly.  ExecReducescatter passes vrank = rank - 1 so each
+// rank ends owning exactly segment `rank` (its scatter output).
 static void EvenSegments(int64_t count, int size,
                          std::vector<int64_t>* seg_count,
                          std::vector<int64_t>* seg_off) {
@@ -2331,43 +2665,81 @@ static void EvenSegments(int64_t count, int size,
   }
 }
 
-static bool RingAllreduce(void* data, int64_t count, DataType dtype,
-                          ReduceOp op, int rank, int size, Socket& next,
-                          Socket& prev, int timeout_ms, std::string* err) {
-  const size_t esize = DataTypeSize(dtype);
-  uint8_t* base = static_cast<uint8_t*>(data);
-  std::vector<int64_t> seg_count, seg_off;
-  EvenSegments(count, size, &seg_count, &seg_off);
-
-  if (!RingReduceScatterPhase(base, seg_count, seg_off, dtype, op, rank,
-                              size, next, prev, timeout_ms, err)) {
-    return false;
+// Transport-generic duplex chunked transfer on one ring port: the TCP
+// pair goes through the poll-multiplexed SendRecvChunked, an shm edge
+// through its ring-buffer twin — same callback contract, same timeout
+// semantics, so every phase below runs unchanged over either kind.
+bool Engine::PortSendRecvChunked(
+    const RingPort& port, const void* send_buf, size_t sn, void* recv_buf,
+    size_t rn, size_t chunk,
+    const std::function<void(size_t, size_t)>& on_chunk, int timeout_ms,
+    std::string* err, int64_t* wire_ns) {
+  if (port.is_shm()) {
+    return ShmSendRecvChunked(*port.shm_tx, send_buf, sn, *port.shm_rx,
+                              recv_buf, rn, chunk, on_chunk, timeout_ms,
+                              err, wire_ns);
   }
-  // Allgather: circulate the fully-reduced segments.
-  for (int step = 0; step < size - 1; ++step) {
-    int send_seg = (rank - step + 1 + size) % size;
-    int recv_seg = (rank - step + size) % size;
-    if (!SendRecvAll(next, base + seg_off[send_seg] * esize,
-                     static_cast<size_t>(seg_count[send_seg]) * esize, prev,
-                     base + seg_off[recv_seg] * esize,
-                     static_cast<size_t>(seg_count[recv_seg]) * esize,
-                     timeout_ms, err)) {
+  return SendRecvChunked(*port.next, send_buf, sn, *port.prev, recv_buf,
+                         rn, chunk, on_chunk, timeout_ms, err, wire_ns);
+}
+
+bool Engine::PortSendAll(const RingPort& port, const void* p, size_t n,
+                         std::string* err) {
+  if (port.is_shm()) {
+    std::string detail;
+    if (!port.shm_tx->WriteAll(p, n, socket_timeout_sec_ * 1000, &detail)) {
+      // "send" prefix so TransportError blames the ring-next neighbor,
+      // exactly like the TCP branch below.
+      *err = "send to peer: " + detail;
       return false;
     }
+    return true;
+  }
+  if (!port.next->SendAll(p, n)) {
+    *err = "send to peer: transport failure";
+    return false;
+  }
+  return true;
+}
+
+bool Engine::PortRecvAllPatient(const RingPort& port, void* p, size_t n,
+                                int patience_rounds, std::string* err) {
+  if (port.is_shm()) {
+    // Same patience contract as RecvAllPatient: `rounds` consecutive
+    // no-progress windows of one socket timeout each before giving up
+    // (0 timeout = wait forever, exactly like the disabled-socket-timeout
+    // TCP path).
+    int64_t ms = static_cast<int64_t>(std::max(1, patience_rounds)) *
+                 socket_timeout_sec_ * 1000;
+    std::string detail;
+    if (!port.shm_rx->ReadAll(p, n, static_cast<int>(ms), &detail)) {
+      *err = "recv from peer: " + detail;
+      return false;
+    }
+    return true;
+  }
+  if (!port.prev->RecvAllPatient(p, n, patience_rounds)) {
+    *err = "recv from peer: transport failure";
+    return false;
   }
   return true;
 }
 
 // One channel's reduce-scatter phase over explicit per-segment slices,
 // chunk-pipelined: the recv of chunk k+1 streams through the kernel
-// buffers while ReduceInto processes chunk k (SendRecvChunked fires the
-// reduction from the poll loop the moment a chunk's bytes are in).
+// buffers while ReduceInto processes chunk k (the chunked transfer fires
+// the reduction from its progress loop the moment a chunk's bytes are
+// in).  Runs over whichever ring `spec` describes — flat TCP, flat shm,
+// the intra-host shm ring, or the leader cross ring.
 bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
                                       const std::vector<int64_t>& seg_count,
                                       const std::vector<int64_t>& seg_off,
-                                      DataType dtype, ReduceOp op, int vrank,
-                                      int ch, std::string* err) {
+                                      DataType dtype, ReduceOp op,
+                                      const RingSpec& spec, int ch,
+                                      std::string* err) {
   const size_t esize = DataTypeSize(dtype);
+  const int rsize = spec.rsize;
+  const int vrank = spec.vrank;
   int64_t max_seg = 0;
   for (auto c : seg_count) max_seg = std::max(max_seg, c);
   // Raw allocation: vector's value-init would memset up to segment-size
@@ -2377,16 +2749,16 @@ bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
   const size_t chunk =
       static_cast<size_t>(chunk_bytes_.load()) / esize * esize;  // aligned
   const int timeout_ms = socket_timeout_sec_ * 1000;
-  for (int step = 0; step < size_ - 1; ++step) {
-    int send_seg = (vrank - step + 2 * size_) % size_;
-    int recv_seg = (vrank - step - 1 + 2 * size_) % size_;
+  for (int step = 0; step < rsize - 1; ++step) {
+    int send_seg = (vrank - step + 2 * rsize) % rsize;
+    int recv_seg = (vrank - step - 1 + 2 * rsize) % rsize;
     const size_t sn = static_cast<size_t>(seg_count[send_seg]) * esize;
     const size_t rn = static_cast<size_t>(seg_count[recv_seg]) * esize;
     uint8_t* rbase = base + seg_off[recv_seg] * esize;
     int64_t wns = 0;
-    bool ok = SendRecvChunked(
-        ring_next_[ch], base + seg_off[send_seg] * esize, sn, ring_prev_[ch],
-        tmp.get(), rn, chunk,
+    bool ok = PortSendRecvChunked(
+        spec.ports[ch], base + seg_off[send_seg] * esize, sn, tmp.get(), rn,
+        chunk,
         [&](size_t off, size_t len) {
           ReduceIntoTimed(rbase + off, tmp.get() + off,
                           static_cast<int64_t>(len / esize), dtype, op);
@@ -2394,8 +2766,8 @@ bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
         timeout_ms, err, &wns);
     wire_ns_.fetch_add(wns);
     if (!ok) return false;
-    data_bytes_tx_.fetch_add(static_cast<int64_t>(sn));
-    data_bytes_rx_.fetch_add(static_cast<int64_t>(rn));
+    CountPortBytes(spec.ports[ch], static_cast<int64_t>(sn),
+                   static_cast<int64_t>(rn));
   }
   return true;
 }
@@ -2404,23 +2776,26 @@ bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
 bool Engine::RingAllgatherPhaseCh(uint8_t* base,
                                   const std::vector<int64_t>& seg_count,
                                   const std::vector<int64_t>& seg_off,
-                                  size_t esize, int vrank, int ch,
+                                  size_t esize, const RingSpec& spec, int ch,
                                   std::string* err) {
   const int timeout_ms = socket_timeout_sec_ * 1000;
-  for (int step = 0; step < size_ - 1; ++step) {
-    int send_seg = (vrank - step + 1 + size_) % size_;
-    int recv_seg = (vrank - step + size_) % size_;
+  const int rsize = spec.rsize;
+  const int vrank = spec.vrank;
+  for (int step = 0; step < rsize - 1; ++step) {
+    int send_seg = (vrank - step + 1 + rsize) % rsize;
+    int recv_seg = (vrank - step + rsize) % rsize;
     const size_t sn = static_cast<size_t>(seg_count[send_seg]) * esize;
     const size_t rn = static_cast<size_t>(seg_count[recv_seg]) * esize;
     int64_t wns = 0;
-    bool ok = SendRecvChunked(ring_next_[ch], base + seg_off[send_seg] * esize,
-                              sn, ring_prev_[ch],
-                              base + seg_off[recv_seg] * esize, rn,
-                              /*chunk=*/0, nullptr, timeout_ms, err, &wns);
+    bool ok = PortSendRecvChunked(spec.ports[ch],
+                                  base + seg_off[send_seg] * esize, sn,
+                                  base + seg_off[recv_seg] * esize, rn,
+                                  /*chunk=*/0, nullptr, timeout_ms, err,
+                                  &wns);
     wire_ns_.fetch_add(wns);
     if (!ok) return false;
-    data_bytes_tx_.fetch_add(static_cast<int64_t>(sn));
-    data_bytes_rx_.fetch_add(static_cast<int64_t>(rn));
+    CountPortBytes(spec.ports[ch], static_cast<int64_t>(sn),
+                   static_cast<int64_t>(rn));
   }
   return true;
 }
@@ -2437,10 +2812,11 @@ bool Engine::RingAllgatherPhaseCh(uint8_t* base,
 // without any headers.
 bool Engine::StreamingRingChannels(uint8_t* base,
                                    const std::vector<ChannelSegs>& channels,
-                                   DataType dtype, ReduceOp op, int vrank,
-                                   std::string* err) {
+                                   DataType dtype, ReduceOp op,
+                                   const RingSpec& spec, std::string* err) {
   const size_t esize = DataTypeSize(dtype);
-  const int N = size_;
+  const int N = spec.rsize;
+  const int vrank = spec.vrank;
   const int nsteps = 2 * (N - 1);
   const int last_rs = N - 2;  // steps [0, last_rs] reduce; rest allgather
   // Step schedule (segment ids, shared by every channel).  RS step s:
@@ -2464,6 +2840,7 @@ bool Engine::StreamingRingChannels(uint8_t* base,
   // Per-channel cascade state.
   struct ChState {
     const ChannelSegs* segs = nullptr;
+    const RingPort* port = nullptr;
     std::vector<size_t> ready;
     int ss = 0;          // sender step
     size_t so = 0;       // bytes of step ss already sent
@@ -2476,17 +2853,23 @@ bool Engine::StreamingRingChannels(uint8_t* base,
     // collective.
     std::unique_ptr<uint8_t[]> tmp;
   };
+  // A spec's ports are homogeneous (a ring is wholly TCP or wholly shm),
+  // so the transport branch is taken once, not per chunk.
+  const bool is_shm = spec.ports[channels[0].ch].is_shm();
   std::vector<ChState> st(channels.size());
   std::vector<std::unique_ptr<NonblockGuard>> guards;
   for (size_t i = 0; i < channels.size(); ++i) {
     ChState& c = st[i];
     c.segs = &channels[i];
+    c.port = &spec.ports[c.segs->ch];
     c.ready.assign(nsteps + 1, 0);
     int64_t max_seg = 0;
     for (auto n : c.segs->seg_count) max_seg = std::max(max_seg, n);
     c.tmp.reset(new uint8_t[static_cast<size_t>(max_seg) * esize]);
-    guards.emplace_back(new NonblockGuard(ring_next_[c.segs->ch].fd()));
-    guards.emplace_back(new NonblockGuard(ring_prev_[c.segs->ch].fd()));
+    if (!is_shm) {
+      guards.emplace_back(new NonblockGuard(c.port->next->fd()));
+      guards.emplace_back(new NonblockGuard(c.port->prev->fd()));
+    }
   }
   auto seg_bytes = [&](const ChState& c, int seg) {
     return static_cast<size_t>(c.segs->seg_count[seg]) * esize;
@@ -2513,6 +2896,107 @@ bool Engine::StreamingRingChannels(uint8_t* base,
   auto t0 = std::chrono::steady_clock::now();
   int64_t local_reduce_ns = 0;
   bool ok = true;
+  // Receive-side bookkeeping shared by both transports: after `k` fresh
+  // bytes of step c.rs landed, reduce every COMPLETED chunk (RS steps) or
+  // credit the raw bytes downstream (allgather steps — final on arrival),
+  // then advance the cursor past any finished/empty steps.
+  auto credit_recv = [&](ChState& c, size_t k) {
+    if (c.rs <= last_rs) {
+      uint8_t* sb = base + c.segs->seg_off[recv_seg[c.rs]] * esize;
+      const size_t total = seg_bytes(c, recv_seg[c.rs]);
+      while (c.reduced < c.ro &&
+             (c.ro - c.reduced >= chunk || c.ro == total)) {
+        size_t len = std::min(chunk, c.ro - c.reduced);
+        auto r0 = std::chrono::steady_clock::now();
+        ReduceIntoTimed(sb + c.reduced, c.tmp.get() + c.reduced,
+                        static_cast<int64_t>(len / esize), dtype, op);
+        local_reduce_ns +=
+            std::chrono::duration_cast<std::chrono::nanoseconds>(
+                std::chrono::steady_clock::now() - r0)
+                .count();
+        c.reduced += len;
+        if (c.rs + 1 < nsteps) c.ready[c.rs + 1] += len;
+      }
+    } else if (c.rs + 1 < nsteps) {
+      c.ready[c.rs + 1] += k;
+    }
+    advance_receiver(c);
+  };
+  if (is_shm) {
+    // Shm cascade: the SPSC rings are progressed with nonblocking
+    // TryWrite/TryRead — no pollable fd, so idleness parks on a
+    // spin-then-yield-then-nap ladder (the WaitSeqSlice futex path serves
+    // single-ring waits; a multi-ring cascade would need one futex word
+    // per ring and gVisor's coverage is spotty anyway).  timeout_ms
+    // bounds time with NO forward progress across every channel, exactly
+    // like the TCP poll timeout.
+    auto last_progress = std::chrono::steady_clock::now();
+    int idle = 0;
+    while (ok) {
+      bool all_done = true, progressed = false;
+      for (auto& c : st) {
+        while (c.ss < nsteps && c.so < c.ready[c.ss]) {
+          const uint8_t* p =
+              base + c.segs->seg_off[send_seg[c.ss]] * esize + c.so;
+          size_t k = c.port->shm_tx->TryWrite(p, c.ready[c.ss] - c.so);
+          if (k > 0) {
+            c.so += k;
+            c.tx += k;
+            progressed = true;
+            advance_sender(c);
+          } else {
+            if (c.port->shm_tx->Closed()) {
+              *err = "send to peer: shm ring closed (peer exited?)";
+              ok = false;
+            }
+            break;
+          }
+        }
+        if (!ok) break;
+        while (c.rs < nsteps) {
+          const bool reducing = c.rs <= last_rs;
+          const size_t want = seg_bytes(c, recv_seg[c.rs]) - c.ro;
+          uint8_t* dst =
+              reducing ? c.tmp.get() + c.ro
+                       : base + c.segs->seg_off[recv_seg[c.rs]] * esize +
+                             c.ro;
+          size_t k = c.port->shm_rx->TryRead(dst, want);
+          if (k > 0) {
+            c.ro += k;
+            c.rx += k;
+            progressed = true;
+            credit_recv(c, k);
+          } else {
+            if (c.port->shm_rx->Closed()) {
+              *err = "recv from peer: shm ring closed (peer exited?)";
+              ok = false;
+            }
+            break;
+          }
+        }
+        if (!ok) break;
+        if (c.ss < nsteps || c.rs < nsteps) all_done = false;
+      }
+      if (!ok || all_done) break;
+      if (progressed) {
+        last_progress = std::chrono::steady_clock::now();
+        idle = 0;
+        continue;
+      }
+      if (++idle < 64) {
+        std::this_thread::yield();
+      } else {
+        std::this_thread::sleep_for(std::chrono::microseconds(50));
+      }
+      if (timeout_ms > 0 &&
+          std::chrono::steady_clock::now() - last_progress >
+              std::chrono::milliseconds(timeout_ms)) {
+        *err = "link: no progress for " + std::to_string(timeout_ms / 1000) +
+               "s (peer hung?)";
+        ok = false;
+      }
+    }
+  } else {
   std::vector<pollfd> fds;
   std::vector<std::pair<int, int>> owner;  // (channel idx, 0=send 1=recv)
   while (ok) {
@@ -2521,11 +3005,11 @@ bool Engine::StreamingRingChannels(uint8_t* base,
     for (size_t i = 0; i < st.size(); ++i) {
       ChState& c = st[i];
       if (c.ss < nsteps && c.so < c.ready[c.ss]) {
-        fds.push_back({ring_next_[c.segs->ch].fd(), POLLOUT, 0});
+        fds.push_back({c.port->next->fd(), POLLOUT, 0});
         owner.emplace_back(static_cast<int>(i), 0);
       }
       if (c.rs < nsteps) {
-        fds.push_back({ring_prev_[c.segs->ch].fd(), POLLIN, 0});
+        fds.push_back({c.port->prev->fd(), POLLIN, 0});
         owner.emplace_back(static_cast<int>(i), 1);
       }
     }
@@ -2555,7 +3039,7 @@ bool Engine::StreamingRingChannels(uint8_t* base,
         while (c.ss < nsteps && c.so < c.ready[c.ss]) {
           const uint8_t* p =
               base + c.segs->seg_off[send_seg[c.ss]] * esize + c.so;
-          ssize_t k = ::send(ring_next_[c.segs->ch].fd(), p,
+          ssize_t k = ::send(c.port->next->fd(), p,
                              c.ready[c.ss] - c.so, MSG_NOSIGNAL);
           if (k > 0) {
             c.so += static_cast<size_t>(k);
@@ -2579,34 +3063,11 @@ bool Engine::StreamingRingChannels(uint8_t* base,
               reducing ? c.tmp.get() + c.ro
                        : base + c.segs->seg_off[recv_seg[c.rs]] * esize +
                              c.ro;
-          ssize_t k = ::recv(ring_prev_[c.segs->ch].fd(), dst, want, 0);
+          ssize_t k = ::recv(c.port->prev->fd(), dst, want, 0);
           if (k > 0) {
             c.ro += static_cast<size_t>(k);
             c.rx += static_cast<size_t>(k);
-            if (reducing) {
-              // Reduce every COMPLETED chunk, then credit it downstream.
-              uint8_t* sb =
-                  base + c.segs->seg_off[recv_seg[c.rs]] * esize;
-              const size_t total = seg_bytes(c, recv_seg[c.rs]);
-              while (c.reduced < c.ro &&
-                     (c.ro - c.reduced >= chunk || c.ro == total)) {
-                size_t len = std::min(chunk, c.ro - c.reduced);
-                auto r0 = std::chrono::steady_clock::now();
-                ReduceIntoTimed(sb + c.reduced, c.tmp.get() + c.reduced,
-                                static_cast<int64_t>(len / esize), dtype,
-                                op);
-                local_reduce_ns +=
-                    std::chrono::duration_cast<std::chrono::nanoseconds>(
-                        std::chrono::steady_clock::now() - r0)
-                        .count();
-                c.reduced += len;
-                if (c.rs + 1 < nsteps) c.ready[c.rs + 1] += len;
-              }
-            } else if (c.rs + 1 < nsteps) {
-              // Allgather bytes are final on arrival: credit them raw.
-              c.ready[c.rs + 1] += static_cast<size_t>(k);
-            }
-            advance_receiver(c);
+            credit_recv(c, static_cast<size_t>(k));
           } else if (k == 0) {
             *err =
                 "recv from peer: connection closed (peer process exited?)";
@@ -2624,13 +3085,14 @@ bool Engine::StreamingRingChannels(uint8_t* base,
       }
     }
   }
+  }  // transport branch
   wire_ns_.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
                          std::chrono::steady_clock::now() - t0)
                          .count() -
                      local_reduce_ns);
   for (auto& c : st) {
-    data_bytes_tx_.fetch_add(static_cast<int64_t>(c.tx));
-    data_bytes_rx_.fetch_add(static_cast<int64_t>(c.rx));
+    CountPortBytes(*c.port, static_cast<int64_t>(c.tx),
+                   static_cast<int64_t>(c.rx));
   }
   return ok;
 }
@@ -2641,13 +3103,14 @@ bool Engine::StreamingRingChannels(uint8_t* base,
 static constexpr int64_t kMinBytesPerChannel = 256 * 1024;
 
 bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
-                                    DataType dtype, ReduceOp op, int vrank,
+                                    DataType dtype, ReduceOp op,
+                                    const RingSpec& spec,
                                     const ExecCtx& ctx,
                                     const std::string& tname,
                                     std::string* err) {
   const size_t esize = DataTypeSize(dtype);
   std::vector<int64_t> seg_count, seg_off;
-  EvenSegments(count, size_, &seg_count, &seg_off);
+  EvenSegments(count, spec.rsize, &seg_count, &seg_off);
   // Effective fan-out, deterministic across ranks (count, esize, and the
   // committed channel count all agree).  Any value is VALUE-safe: channel
   // shards slice WITHIN each ring segment, so an element's segment id —
@@ -2661,9 +3124,9 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
   // elements at a contiguous offset inside segment s.
   auto channel_segs = [&](int c, std::vector<int64_t>* cnt,
                           std::vector<int64_t>* off) {
-    cnt->resize(size_);
-    off->resize(size_);
-    for (int s = 0; s < size_; ++s) {
+    cnt->resize(spec.rsize);
+    off->resize(spec.rsize);
+    for (int s = 0; s < spec.rsize; ++s) {
       int64_t n = seg_count[s], q = n / nch, r = n % nch;
       (*cnt)[s] = q + (c < r ? 1 : 0);
       (*off)[s] = seg_off[s] + q * c + std::min<int64_t>(c, r);
@@ -2672,15 +3135,15 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
   if (nch == 1 && ctx.nchannels == 1 && num_channels_ == 1) {
     // HOROVOD_NUM_CHANNELS=1 restores the pre-channel discipline exactly:
     // the stepped reduce-scatter phase (with its within-step chunked
-    // recv/reduce overlap) followed by the stepped allgather, one socket
+    // recv/reduce overlap) followed by the stepped allgather, one port
     // pair, per-step barriers.  The streaming cascade below is the
     // multi-channel data plane.
     const int ch = ctx.channel;
-    timeline_.ActivityStartCh(tname, "RING_CH" + std::to_string(ch), ch + 1);
+    timeline_.ActivityStartCh(tname, spec.span + std::to_string(ch), ch + 1);
     bool ok = RingReduceScatterPhaseCh(base, seg_count, seg_off, dtype, op,
-                                       vrank, ch, err);
+                                       spec, ch, err);
     if (ok) {
-      ok = RingAllgatherPhaseCh(base, seg_count, seg_off, esize, vrank, ch,
+      ok = RingAllgatherPhaseCh(base, seg_count, seg_off, esize, spec, ch,
                                 err);
     }
     timeline_.ActivityEndCh(tname, ch + 1);
@@ -2701,10 +3164,10 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
   auto run_part = [&](const std::vector<ChannelSegs>& part,
                       std::string* derr) -> bool {
     for (const auto& cs : part) {
-      timeline_.ActivityStartCh(tname, "RING_CH" + std::to_string(cs.ch),
+      timeline_.ActivityStartCh(tname, spec.span + std::to_string(cs.ch),
                                 cs.ch + 1);
     }
-    bool ok = StreamingRingChannels(base, part, dtype, op, vrank, derr);
+    bool ok = StreamingRingChannels(base, part, dtype, op, spec, derr);
     for (const auto& cs : part) timeline_.ActivityEndCh(tname, cs.ch + 1);
     return ok;
   };
@@ -2737,123 +3200,214 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
   return true;
 }
 
-// Two-level allreduce (HOROVOD_HIERARCHICAL_ALLREDUCE): chain-reduce each
-// node's buffers onto its leader over loopback/shm-speed local links, ring
-// allreduce across the (few) leaders over the real network, then chain-
-// broadcast back down.  Reference decomposition: NCCL reduce-scatter →
-// cross-node MPI allreduce → NCCL allgather (operations.cc:1025-1187); on
-// the host plane the intra-node links are not the bottleneck, so the
-// simpler chain keeps the cross-node traffic identical (one buffer per
-// leader-ring hop) without per-local-rank cross rings.
-bool Engine::HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
-                                   ReduceOp op, const std::string& name,
-                                   std::string* status_msg) {
+bool Engine::UseSmallAlgo(int64_t nbytes, const ExecCtx& ctx) const {
+  if (!shm_ring_active_ || shm_star_.empty() || group_size_ <= 1) {
+    return false;
+  }
+  const int64_t thr = algo_threshold_.load();
+  if (thr <= 0 || nbytes > thr) return false;
+  // Serial execution context only: a concurrent wave slice owns ONE
+  // channel, not the star edges (two responses folding on the same star
+  // ring would interleave their streams).  The serial path always passes
+  // the full committed fan-out, so for a given response list this
+  // predicate evaluates identically on every member of the group — the
+  // wire patterns cannot split.
+  return ctx.nchannels >= num_channels_;
+}
+
+bool Engine::StarBroadcast(uint8_t* base, size_t nbytes, std::string* err) {
+  const int to_ms = socket_timeout_sec_ * 1000;
+  const int L = group_size_;
+  // Chunk round-robin ACROSS members (chunk sized to half the ring so a
+  // write never has to wait for a full drain): members consume
+  // concurrently, so the leader's wall time is ~one buffer, not
+  // (L-1) sequential full sends.
+  const size_t chunk =
+      std::min(kRelayChunk, static_cast<size_t>(shm_ring_bytes_ / 2));
+  if (local_index_ == 0) {
+    for (size_t off = 0; off < nbytes; off += chunk) {
+      const size_t n = std::min(chunk, nbytes - off);
+      for (int m = 1; m < L; ++m) {
+        std::string detail;
+        if (!shm_star_[m].tx.WriteAll(base + off, n, to_ms, &detail)) {
+          *err = "rank " + std::to_string(group_members_[m]) +
+                 " failed during star broadcast: send to member: " + detail;
+          return false;
+        }
+        CountShmBytes(static_cast<int64_t>(n), 0);
+      }
+    }
+  } else {
+    // The first chunk's legitimate wait covers the leader's whole
+    // cross-host ring (2(H-1) steps), hence the nnodes-scaled budget.
+    const int wait_ms =
+        to_ms > 0 ? to_ms * (2 * nnodes_ + group_size_ + 2) : 0;
+    for (size_t off = 0; off < nbytes; off += chunk) {
+      const size_t n = std::min(chunk, nbytes - off);
+      std::string detail;
+      if (!shm_star_[0].rx.ReadAll(base + off, n, wait_ms, &detail)) {
+        *err = "rank " + std::to_string(group_members_[0]) +
+               " failed during star broadcast: recv from leader: " + detail;
+        return false;
+      }
+      CountShmBytes(0, static_cast<int64_t>(n));
+    }
+  }
+  return true;
+}
+
+bool Engine::StarFoldAllreduce(uint8_t* base, int64_t count, DataType dtype,
+                               ReduceOp op, bool broadcast_result,
+                               std::string* err) {
   const size_t esize = DataTypeSize(dtype);
   const size_t nbytes = static_cast<size_t>(count) * esize;
-  const int L = local_size_, lr = local_rank_, base = node_id_ * L;
-  const size_t chunk_elems = kRelayChunk / esize;
-  std::string err;
-
-  // 1. Reduce up the local chain: data flows from local_rank L-1 down to
-  //    the leader at local_rank 0 (all sockets are duplex; "toward prev"
-  //    writes ride the connection the prev rank opened to us).  Streamed
-  //    in chunks so every link is busy at once and a rank's legitimate
-  //    zero-byte wait is bounded by chain_hops·chunk_time (see
-  //    kRelayChunk).
-  if (lr == L - 1) {
-    if (!local_prev_.SendAll(data, nbytes)) {
-      *status_msg = TransportError("hierarchical allreduce (local reduce)",
-                                   name, "send to peer: transport failure",
-                                   base + lr - 1, base + lr - 1);
+  const int L = group_size_;
+  const int to_ms = socket_timeout_sec_ * 1000;
+  const int gather_ms = to_ms > 0 ? to_ms * (L + 2) : 0;
+  if (local_index_ != 0) {
+    std::string detail;
+    if (!shm_star_[0].tx.WriteAll(base, nbytes, gather_ms, &detail)) {
+      *err = "rank " + std::to_string(group_members_[0]) +
+             " failed during star gather: send to leader: " + detail;
       return false;
     }
-    data_bytes_tx_.fetch_add(static_cast<int64_t>(nbytes));
-  } else {
-    std::vector<uint8_t> tmp(std::min(nbytes, kRelayChunk));
-    uint8_t* p = static_cast<uint8_t*>(data);
-    for (int64_t eoff = 0; eoff < count;
-         eoff += static_cast<int64_t>(chunk_elems)) {
-      int64_t n_elems =
-          std::min<int64_t>(static_cast<int64_t>(chunk_elems), count - eoff);
-      size_t n = static_cast<size_t>(n_elems) * esize;
-      if (!local_next_.RecvAllPatient(tmp.data(), n, L + 2)) {
-        *status_msg = TransportError("hierarchical allreduce (local reduce)",
-                                     name,
-                                     "recv from peer: transport failure",
-                                     base + lr + 1, base + lr + 1);
-        return false;
-      }
-      data_bytes_rx_.fetch_add(static_cast<int64_t>(n));
-      ReduceIntoTimed(p + eoff * esize, tmp.data(), n_elems, dtype, op);
-      if (lr > 0) {
-        if (!local_prev_.SendAll(p + eoff * esize, n)) {
-          *status_msg = TransportError(
-              "hierarchical allreduce (local reduce)", name,
-              "send to peer: transport failure", base + lr - 1,
-              base + lr - 1);
-          return false;
-        }
-        data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
-      }
-    }
+    CountShmBytes(static_cast<int64_t>(nbytes), 0);
+    if (broadcast_result) return StarBroadcast(base, nbytes, err);
+    return true;
   }
-
-  // 2. Leaders ring-allreduce the node sums across nodes.
-  if (lr == 0 && nnodes_ > 1) {
-    if (!RingAllreduce(data, count, dtype, op, node_id_, nnodes_,
-                       cross_next_, cross_prev_,
-                       socket_timeout_sec_ * 1000, &err)) {
-      int next_leader = ((node_id_ + 1) % nnodes_) * L;
-      int prev_leader = ((node_id_ - 1 + nnodes_) % nnodes_) * L;
-      *status_msg = TransportError("hierarchical allreduce (cross ring)",
-                                   name, err, next_leader, prev_leader);
+  // Leader: gather every member's RAW buffer, then reproduce the ring
+  // reduce-scatter's fold segment by segment.  Segment s accumulates
+  // contributions in group-position order s, s+1, ..., s+L-1 (mod L) —
+  // the order the ring's step schedule applies them in (see EvenSegments)
+  // — AND with the ring's exact operand roles (dst = the incoming
+  // position's raw data, src = the running accumulator), because
+  // ReduceInto's min/max tie-breaking and NaN propagation are operand-
+  // ORDER-sensitive even where the math is commutative.  Identical
+  // kernel, identical segment boundaries, identical operand sequence ⇒
+  // the algo switch can never change a bit.
+  std::vector<std::unique_ptr<uint8_t[]>> contrib(L);
+  contrib[0].reset(new uint8_t[nbytes]);
+  memcpy(contrib[0].get(), base, nbytes);
+  for (int m = 1; m < L; ++m) {
+    contrib[m].reset(new uint8_t[nbytes]);
+    std::string detail;
+    if (!shm_star_[m].rx.ReadAll(contrib[m].get(), nbytes, gather_ms,
+                                 &detail)) {
+      *err = "rank " + std::to_string(group_members_[m]) +
+             " failed during star gather: recv from member: " + detail;
       return false;
     }
-    // A leader's ring moves 2(nnodes-1)/nnodes of the payload each way
-    // (the static RingAllreduce is uninstrumented; segment remainders
-    // make this exact figure off by < one element per segment).
-    int64_t ring_bytes = static_cast<int64_t>(nbytes) * 2 *
-                         (nnodes_ - 1) / nnodes_;
-    data_bytes_tx_.fetch_add(ring_bytes);
-    data_bytes_rx_.fetch_add(ring_bytes);
+    CountShmBytes(0, static_cast<int64_t>(nbytes));
   }
+  std::vector<int64_t> seg_count, seg_off;
+  EvenSegments(count, L, &seg_count, &seg_off);
+  int64_t max_seg = 0;
+  for (auto c : seg_count) max_seg = std::max(max_seg, c);
+  std::unique_ptr<uint8_t[]> acc(new uint8_t[max_seg * esize]);
+  std::unique_ptr<uint8_t[]> nxt(new uint8_t[max_seg * esize]);
+  for (int s = 0; s < L; ++s) {
+    if (seg_count[s] == 0) continue;
+    const size_t sb = static_cast<size_t>(seg_count[s]) * esize;
+    const size_t boff = static_cast<size_t>(seg_off[s]) * esize;
+    memcpy(acc.get(), contrib[s].get() + boff, sb);
+    for (int k = 1; k < L; ++k) {
+      memcpy(nxt.get(), contrib[(s + k) % L].get() + boff, sb);
+      ReduceIntoTimed(nxt.get(), acc.get(), seg_count[s], dtype, op);
+      acc.swap(nxt);
+    }
+    memcpy(base + boff, acc.get(), sb);
+  }
+  if (broadcast_result) return StarBroadcast(base, nbytes, err);
+  return true;
+}
 
-  // 3. Broadcast the result back up the local chain, streamed in chunks.
-  //    The first chunk's legitimate idle time covers the leaders' whole
-  //    cross-node ring — 2(nnodes-1) SendRecvAll steps, each of which may
-  //    consume most of a timeout round on a slow link — hence the
-  //    2·nnodes-based budget.
-  uint8_t* p = static_cast<uint8_t*>(data);
-  for (size_t off = 0; off < nbytes; off += kRelayChunk) {
-    size_t n = std::min(kRelayChunk, nbytes - off);
-    if (lr == 0) {
-      if (!local_next_.SendAll(p + off, n)) {
-        *status_msg = TransportError("hierarchical allreduce (local bcast)",
-                                     name, "send to peer: transport failure",
-                                     base + 1, base + 1);
+// Two-level allreduce over the committed topology: intra-host ring
+// reduce-scatter over shm (or the star fold under the small-tensor algo) →
+// owned-segment gather to the group leader → leaders' channel-sharded TCP
+// ring across hosts → star broadcast back down.  The reference
+// decomposition (NCCL reduce → cross-node MPI → NCCL broadcast,
+// operations.cc:1025-1187), generalized from the eager
+// HOROVOD_HIERARCHICAL_ALLREDUCE into the native engine.  Deterministic
+// per topology; transport, channel count, and the algo threshold never
+// change bits within one topology.
+bool Engine::TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
+                               ReduceOp op, const std::string& name,
+                               const ExecCtx& ctx, std::string* err) {
+  const size_t esize = DataTypeSize(dtype);
+  const size_t nbytes = static_cast<size_t>(count) * esize;
+  const int L = group_size_;
+  const int p = local_index_;
+  const int to_ms = socket_timeout_sec_ * 1000;
+  const int gather_ms = to_ms > 0 ? to_ms * (L + 2) : 0;
+  std::string detail;
+  if (L > 1) {
+    if (UseSmallAlgo(static_cast<int64_t>(nbytes), ctx)) {
+      // Small path: 2 shm hops of latency instead of 2(L-1) ring steps;
+      // leaves the leader holding the host-reduced buffer.
+      if (!StarFoldAllreduce(base, count, dtype, op,
+                             /*broadcast_result=*/false, err)) {
         return false;
       }
-      data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
     } else {
-      if (!local_prev_.RecvAllPatient(p + off, n, 2 * nnodes_ + L + 2)) {
-        *status_msg = TransportError("hierarchical allreduce (local bcast)",
-                                     name,
-                                     "recv from peer: transport failure",
-                                     base + lr - 1, base + lr - 1);
+      std::vector<int64_t> seg_count, seg_off;
+      EvenSegments(count, L, &seg_count, &seg_off);
+      RingSpec shm = ShmRingSpec();
+      timeline_.ActivityStartCh(name, "SHM_CH0", 1);
+      bool ok = RingReduceScatterPhaseCh(base, seg_count, seg_off, dtype,
+                                         op, shm, 0, &detail);
+      timeline_.ActivityEndCh(name, 1);
+      if (!ok) {
+        *err = TransportError("two-level allreduce (intra ring)", name,
+                              detail, group_members_[(p + 1) % L],
+                              group_members_[(p - 1 + L) % L]);
         return false;
       }
-      data_bytes_rx_.fetch_add(static_cast<int64_t>(n));
-      if (lr < L - 1) {
-        if (!local_next_.SendAll(p + off, n)) {
-          *status_msg = TransportError(
-              "hierarchical allreduce (local bcast)", name,
-              "send to peer: transport failure", base + lr + 1,
-              base + lr + 1);
-          return false;
+      // Gather the host-reduced segments onto the leader: position q owns
+      // segment (q+1) mod L after the reduce-scatter (see EvenSegments),
+      // so the leader's buffer becomes the full host sum.
+      if (p == 0) {
+        for (int q = 1; q < L; ++q) {
+          const int s = (q + 1) % L;
+          if (seg_count[s] == 0) continue;
+          const size_t n = static_cast<size_t>(seg_count[s]) * esize;
+          if (!shm_star_[q].rx.ReadAll(base + seg_off[s] * esize, n,
+                                       gather_ms, &detail)) {
+            *err = "rank " + std::to_string(group_members_[q]) +
+                   " failed during two-level allreduce of '" + name +
+                   "' (segment gather): " + detail;
+            return false;
+          }
+          CountShmBytes(0, static_cast<int64_t>(n));
         }
-        data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
+      } else {
+        const int s = (p + 1) % L;
+        if (seg_count[s] > 0) {
+          const size_t n = static_cast<size_t>(seg_count[s]) * esize;
+          if (!shm_star_[0].tx.WriteAll(base + seg_off[s] * esize, n,
+                                        gather_ms, &detail)) {
+            *err = "rank " + std::to_string(group_members_[0]) +
+                   " failed during two-level allreduce of '" + name +
+                   "' (segment gather): " + detail;
+            return false;
+          }
+          CountShmBytes(static_cast<int64_t>(n), 0);
+        }
       }
     }
+  }
+  if (p == 0 && nnodes_ > 1) {
+    RingSpec cross = CrossRingSpec();
+    if (!ChanneledRingAllreduce(base, count, dtype, op, cross, ctx, name,
+                                &detail)) {
+      *err = TransportError(
+          "two-level allreduce (cross ring)", name, detail,
+          group_leaders_[(node_id_ + 1) % nnodes_],
+          group_leaders_[(node_id_ - 1 + nnodes_) % nnodes_]);
+      return false;
+    }
+  }
+  if (L > 1) {
+    if (!StarBroadcast(base, nbytes, err)) return false;
   }
   return true;
 }
@@ -2890,15 +3444,30 @@ void Engine::ExecAllreduce(const Response& response,
     bool ok;
     std::string msg;
     auto t0 = std::chrono::steady_clock::now();
-    if (hierarchical_) {
-      timeline_.ActivityStart(tname, "HIERARCHICAL_ALLREDUCE");
-      ok = HierarchicalAllreduce(buf, total, dtype, response.red_op, tname,
-                                 &msg);
+    const bool small =
+        UseSmallAlgo(total * static_cast<int64_t>(esize), ctx);
+    // One ALGO marker per response: which path this allreduce took (the
+    // two-level intra phase applies the same size-based selection).
+    timeline_.Algo(tname, small ? "ALGO_SMALL" : "ALGO_RING");
+    (small ? algo_small_count_ : algo_ring_count_).fetch_add(1);
+    if (two_level_) {
+      timeline_.ActivityStart(tname, "TWO_LEVEL_ALLREDUCE");
+      ok = TwoLevelAllreduce(static_cast<uint8_t*>(buf), total, dtype,
+                             response.red_op, tname, ctx, &msg);
+    } else if (small) {
+      // Whole-world host group: the star fold IS the collective —
+      // 2 shm hops instead of 2(N-1) ring steps, bit-equal by the fold-
+      // order emulation.
+      timeline_.ActivityStart(tname, "STAR_ALLREDUCE");
+      ok = StarFoldAllreduce(static_cast<uint8_t*>(buf), total, dtype,
+                             response.red_op, /*broadcast_result=*/true,
+                             &msg);
     } else {
       timeline_.ActivityStart(tname, "RING_ALLREDUCE");
       std::string err;
+      RingSpec spec = FlatRingSpec();
       ok = ChanneledRingAllreduce(static_cast<uint8_t*>(buf), total, dtype,
-                                  response.red_op, rank_, ctx, tname, &err);
+                                  response.red_op, spec, ctx, tname, &err);
       if (!ok) {
         msg = TransportError("allreduce", tname, err, (rank_ + 1) % size_,
                              (rank_ - 1 + size_) % size_);
@@ -2976,25 +3545,26 @@ void Engine::ExecAllgather(const Response& response,
 
   if (size_ > 1) {
     timeline_.ActivityStart(e.name, "RING_ALLGATHER");
-    // Circulate blocks around the ring; after size-1 steps everyone has all.
-    Socket& next = ring_next_[ctx.channel];
-    Socket& prev = ring_prev_[ctx.channel];
+    // Circulate blocks around the flat ring (shm on a whole-world host
+    // group, TCP otherwise); after size-1 steps everyone has all.
+    RingSpec spec = FlatRingSpec();
+    const RingPort& port = spec.ports[ctx.channel];
     std::string err;
     bool failed = false;
     for (int step = 0; step < size_ - 1 && !failed; ++step) {
       int send_block = (rank_ - step + size_) % size_;
       int recv_block = (rank_ - step - 1 + size_) % size_;
       int64_t wns = 0;
-      failed = !SendRecvChunked(
-          next, hs->result.data() + block_off[send_block],
-          static_cast<size_t>(block_bytes[send_block]), prev,
+      failed = !PortSendRecvChunked(
+          port, hs->result.data() + block_off[send_block],
+          static_cast<size_t>(block_bytes[send_block]),
           hs->result.data() + block_off[recv_block],
           static_cast<size_t>(block_bytes[recv_block]), /*chunk=*/0, nullptr,
           socket_timeout_sec_ * 1000, &err, &wns);
       wire_ns_.fetch_add(wns);
       if (!failed) {
-        data_bytes_tx_.fetch_add(block_bytes[send_block]);
-        data_bytes_rx_.fetch_add(block_bytes[recv_block]);
+        CountPortBytes(port, block_bytes[send_block],
+                       block_bytes[recv_block]);
       }
     }
     timeline_.ActivityEnd(e.name);
@@ -3016,8 +3586,8 @@ void Engine::ExecBroadcast(const Response& response,
   timeline_.Start(e.name);
   if (size_ > 1) {
     timeline_.ActivityStart(e.name, "RING_BROADCAST");
-    Socket& ring_next = ring_next_[ctx.channel];
-    Socket& ring_prev = ring_prev_[ctx.channel];
+    RingSpec spec = FlatRingSpec();
+    const RingPort& port = spec.ports[ctx.channel];
     size_t nbytes = static_cast<size_t>(e.shape.num_elements()) *
                     DataTypeSize(e.dtype);
     int root = response.root_rank;
@@ -3036,19 +3606,15 @@ void Engine::ExecBroadcast(const Response& response,
     for (size_t off = 0; ok && off < nbytes; off += kRelayChunk) {
       size_t n = std::min(kRelayChunk, nbytes - off);
       if (rank_ == root) {
-        ok = ring_next.SendAll(p + off, n);
-        if (ok) data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
-        if (!ok) detail = "send to peer: transport failure";
+        ok = PortSendAll(port, p + off, n, &detail);
+        if (ok) CountPortBytes(port, static_cast<int64_t>(n), 0);
       } else {
-        ok = ring_prev.RecvAllPatient(p + off, n, hops + 2);
-        if (!ok) {
-          detail = "recv from peer: transport failure";
-        } else {
-          data_bytes_rx_.fetch_add(static_cast<int64_t>(n));
+        ok = PortRecvAllPatient(port, p + off, n, hops + 2, &detail);
+        if (ok) {
+          CountPortBytes(port, 0, static_cast<int64_t>(n));
           if (forward) {
-            ok = ring_next.SendAll(p + off, n);
-            if (ok) data_bytes_tx_.fetch_add(static_cast<int64_t>(n));
-            if (!ok) detail = "send to peer: transport failure";
+            ok = PortSendAll(port, p + off, n, &detail);
+            if (ok) CountPortBytes(port, static_cast<int64_t>(n), 0);
           }
         }
       }
@@ -3107,13 +3673,15 @@ void Engine::ExecReducescatter(const Response& response,
   std::vector<uint8_t> scratch(
       input, input + static_cast<size_t>(off) * esize);
   // vrank = rank-1 so the phase leaves THIS rank owning segment `rank`
-  // (see RingReduceScatterPhaseCh); single-channel on the ctx's channel —
+  // (see EvenSegments); single-channel on the ctx's channel —
   // reducescatter payloads are small on this host plane, and the chunked
   // phase already overlaps its recv and reduce.
   std::string err;
+  RingSpec spec = FlatRingSpec();
+  spec.vrank = (spec.vrank - 1 + spec.rsize) % spec.rsize;
   bool ok = RingReduceScatterPhaseCh(
       scratch.data(), seg_count, seg_off, e.dtype, response.red_op,
-      (rank_ - 1 + size_) % size_, ctx.channel, &err);
+      spec, ctx.channel, &err);
   timeline_.ActivityEnd(e.name);
   if (!ok) {
     FinishEntry(e, Status::Aborted(TransportError(
@@ -3160,21 +3728,23 @@ void Engine::ExecAlltoall(const Response& response,
     timeline_.ActivityStart(e.name, "RING_ALLTOALL");
     std::vector<uint8_t> cur(input, input + static_cast<size_t>(total) * esize);
     std::vector<uint8_t> nxt(cur.size());
-    Socket& next = ring_next_[ctx.channel];
-    Socket& prev = ring_prev_[ctx.channel];
+    RingSpec spec = FlatRingSpec();
+    const RingPort& port = spec.ports[ctx.channel];
     for (int step = 1; step < size_; ++step) {
       std::string err;
-      if (!SendRecvAll(next, cur.data(), cur.size(), prev,
-                       nxt.data(), nxt.size(), socket_timeout_sec_ * 1000,
-                       &err)) {
+      int64_t wns = 0;
+      if (!PortSendRecvChunked(port, cur.data(), cur.size(), nxt.data(),
+                               nxt.size(), /*chunk=*/0, nullptr,
+                               socket_timeout_sec_ * 1000, &err, &wns)) {
         timeline_.ActivityEnd(e.name);
         FinishEntry(e, Status::Aborted(TransportError(
             "alltoall", e.name, err, (rank_ + 1) % size_,
             (rank_ - 1 + size_) % size_)));
         return;
       }
-      data_bytes_tx_.fetch_add(static_cast<int64_t>(cur.size()));
-      data_bytes_rx_.fetch_add(static_cast<int64_t>(nxt.size()));
+      wire_ns_.fetch_add(wns);
+      CountPortBytes(port, static_cast<int64_t>(cur.size()),
+                     static_cast<int64_t>(nxt.size()));
       int src = (rank_ - step + size_) % size_;
       memcpy(hs->result.data() + src * block_bytes,
              nxt.data() + rank_ * block_bytes, block_bytes);

@@ -42,6 +42,7 @@
 
 #include "common.h"
 #include "message.h"
+#include "shm.h"
 #include "socket.h"
 #include "timeline.h"
 
@@ -200,6 +201,24 @@ class Engine {
   int64_t allreduce_ns() const { return allreduce_ns_.load(); }
   int num_channels() const { return num_channels_; }
 
+  // Shared-memory / hierarchy observability.  `shm_bytes_tx/rx` sum
+  // payload bytes this process moved through shm rings (they also count
+  // into data_bytes_tx/rx — shm is a transport of the same data plane);
+  // `intra_host_bytes` sums payload exchanged with co-located ranks
+  // (tx + rx); `algo_small_count/algo_ring_count` count allreduce
+  // responses executed via the latency-optimized star path vs. the
+  // bandwidth-optimized ring; `topology_hosts` × per-host group sizes is
+  // the committed host grouping (this rank reports its own group's size).
+  int64_t shm_bytes_tx() const { return shm_bytes_tx_.load(); }
+  int64_t shm_bytes_rx() const { return shm_bytes_rx_.load(); }
+  int64_t intra_host_bytes() const { return intra_host_bytes_.load(); }
+  int64_t algo_small_count() const { return algo_small_count_.load(); }
+  int64_t algo_ring_count() const { return algo_ring_count_.load(); }
+  int topology_hosts() const { return nnodes_; }
+  int topology_local_ranks() const { return group_size_; }
+  bool shm_enabled() const { return shm_enabled_; }
+  int64_t algo_threshold() const { return algo_threshold_.load(); }
+
   // Effective (currently in-force) values of the live-tunable knobs plus
   // the wiring-time ones, for stats()["config"]: post-TUNE, not the env
   // default — an operator reading stats sees what the engine is actually
@@ -225,7 +244,8 @@ class Engine {
   // `commit` marks the search's final config (timeline/observability).
   // Returns 0 queued, -1 when not initialized or not the coordinator.
   int QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
-                int64_t cycle_time_ms, int64_t wave_width, bool commit);
+                int64_t cycle_time_ms, int64_t wave_width,
+                int64_t algo_threshold, bool commit);
 
   // Why the engine aborted ("" while healthy or after a clean shutdown).
   // Safe to call from any thread: the background thread publishes
@@ -303,6 +323,26 @@ class Engine {
   // wire streams live on disjoint socket pairs.  `channel` also indexes
   // the fusion scratch slot, keeping concurrent fused batches off each
   // other's buffers.
+  // One channel's duplex transport toward the ring neighbors: exactly one
+  // of (TCP sockets, shm edges) is set.  RingSpec bundles a whole ring's
+  // identity — who I am on it, how many ranks it has, and its per-channel
+  // ports — so the phase/cascade code runs unchanged over the flat TCP
+  // ring, the flat shm ring, the intra-host shm ring, and the leader
+  // cross-host ring.
+  struct RingPort {
+    Socket* next = nullptr;      // TCP: send toward ring-next
+    Socket* prev = nullptr;      // TCP: recv from ring-prev
+    ShmRing* shm_tx = nullptr;   // shm: send toward ring-next
+    ShmRing* shm_rx = nullptr;   // shm: recv from ring-prev
+    bool is_shm() const { return shm_tx != nullptr; }
+  };
+  struct RingSpec {
+    int vrank = 0;
+    int rsize = 1;
+    std::vector<RingPort> ports;       // indexed by global channel id
+    const char* span = "RING_CH";      // timeline activity prefix
+  };
+
   struct ExecCtx {
     int channel = 0;
     int nchannels = 1;
@@ -338,25 +378,29 @@ class Engine {
   void ExecAlltoall(const Response& response,
                     std::vector<TensorTableEntry>& entries,
                     const ExecCtx& ctx);
-  // Ring allreduce sharded across the ctx's channels.  Channel shards
-  // slice WITHIN each ring segment (never re-segment the raw element
-  // range), so an element's segment id — and therefore the rank order
-  // its reduction applies in — is independent of the channel count:
-  // results are bit-identical for any fan-out, 1..N.
+  // Ring allreduce sharded across the ctx's channels of the given ring
+  // (flat TCP, flat shm, intra-host shm, or the leader cross ring).
+  // Channel shards slice WITHIN each ring segment (never re-segment the
+  // raw element range), so an element's segment id — and therefore the
+  // rank order its reduction applies in — is independent of the channel
+  // count AND the transport: results are bit-identical for any fan-out,
+  // 1..N, shm or TCP.
   bool ChanneledRingAllreduce(uint8_t* base, int64_t count, DataType dtype,
-                              ReduceOp op, int vrank, const ExecCtx& ctx,
-                              const std::string& tname, std::string* err);
+                              ReduceOp op, const RingSpec& spec,
+                              const ExecCtx& ctx, const std::string& tname,
+                              std::string* err);
   // One channel's chunk-pipelined ring phases over explicit per-segment
   // counts/offsets (absolute element offsets into `base`).
   bool RingReduceScatterPhaseCh(uint8_t* base,
                                 const std::vector<int64_t>& seg_count,
                                 const std::vector<int64_t>& seg_off,
-                                DataType dtype, ReduceOp op, int vrank,
-                                int ch, std::string* err);
+                                DataType dtype, ReduceOp op,
+                                const RingSpec& spec, int ch,
+                                std::string* err);
   bool RingAllgatherPhaseCh(uint8_t* base,
                             const std::vector<int64_t>& seg_count,
                             const std::vector<int64_t>& seg_off,
-                            size_t esize, int vrank, int ch,
+                            size_t esize, const RingSpec& spec, int ch,
                             std::string* err);
   // A set of channels' ENTIRE allreduces (reduce-scatter + allgather),
   // each a chunk-granular streaming cascade, multiplexed in ONE poll
@@ -372,13 +416,13 @@ class Engine {
   // never what it computes.  Per-channel segment tables are indexed
   // [channel][segment] with absolute element offsets into `base`.
   struct ChannelSegs {
-    int ch = 0;  // global channel id (socket index)
+    int ch = 0;  // global channel id (port index in the spec)
     std::vector<int64_t> seg_count, seg_off;
   };
   bool StreamingRingChannels(uint8_t* base,
                              const std::vector<ChannelSegs>& channels,
-                             DataType dtype, ReduceOp op, int vrank,
-                             std::string* err);
+                             DataType dtype, ReduceOp op,
+                             const RingSpec& spec, std::string* err);
   // ReduceInto + reduce_ns accounting; splits reductions at or above
   // max(2 MB, 2x the pipeline chunk) across idle pool workers (disjoint
   // element ranges — bit-equal to serial; pipeline-chunk reduces stay
@@ -587,19 +631,109 @@ class Engine {
   std::vector<Socket> ring_next_, ring_prev_;
   Socket data_listener_;
 
-  // -- hierarchical (two-level) allreduce --
-  // HOROVOD_HIERARCHICAL_ALLREDUCE: reduce within each host first, ring
-  // across one leader per host, then broadcast back down — the reference's
-  // NCCL-reduce-scatter → cross-node MPI → NCCL-allgather decomposition
-  // (operations.cc:1025-1187, 1500-1532) mapped onto the host plane using
-  // local_rank/local_size for the intra/inter split.
-  bool hierarchical_ = false;
-  int node_id_ = 0, nnodes_ = 1;
-  Socket local_next_, local_prev_;         // intra-node ring (duplex chain)
-  Socket cross_next_, cross_prev_;         // leader ring across nodes
-  bool HierarchicalAllreduce(void* data, int64_t count, DataType dtype,
-                             ReduceOp op, const std::string& name,
-                             std::string* status_msg);
+  // -- host topology + shared-memory transport (the second channel kind) --
+  //
+  // The coordinator groups ranks by HOST KEY at rendezvous (HOROVOD_HOST_KEY
+  // override, else hostname#boot-id from the JOIN frame) and broadcasts the
+  // grouping in the ASSIGN frame.  Co-located ranks wire mmap ring-buffer
+  // edges (shm.h) instead of pushing bytes through the loopback TCP ring:
+  //   * single host (or any host group spanning the whole world): the flat
+  //     ring allreduce runs over shm edges — same algorithm, same segments,
+  //     same fold order as the TCP path, so results are BIT-IDENTICAL with
+  //     shm on or off;
+  //   * multiple hosts with co-located ranks: collectives go two-level —
+  //     intra-host ring reduce-scatter over shm, one leader per host in the
+  //     inter-host TCP ring (num_channels_-wide), intra-host broadcast back
+  //     (the reference's NCCL-reduce → cross-node MPI → NCCL-broadcast
+  //     decomposition, operations.cc:1025-1187, generalized from the eager
+  //     HOROVOD_HIERARCHICAL_ALLREDUCE into the native engine).  A
+  //     different topology is a different (deterministic) reduction order;
+  //     within one topology, transport and channel count never change bits.
+  // HOROVOD_SHM_DISABLE=1 (or an unavailable /dev/shm, probed on the
+  // coordinator) turns all of this off and restores the flat TCP path
+  // exactly; the COMMITTED flag is broadcast so every rank agrees.
+  bool shm_enabled_ = true;
+  bool two_level_ = false;                 // committed: H > 1 and max L > 1
+  int node_id_ = 0, nnodes_ = 1;           // my host group id, host count
+  std::vector<int32_t> rank_host_;         // committed group id per rank
+  std::vector<int> group_members_;         // my group's ranks, ascending
+  std::vector<int> group_leaders_;         // first (lowest) rank per group
+  int local_index_ = 0;                    // my index in group_members_
+  int group_size_ = 1;
+  bool shm_ring_active_ = false;           // intra-group shm edges wired
+  std::string shm_prefix_;                 // /dev/shm name prefix (job tag)
+  // Derive node_id_/group_members_/leaders from the committed rank_host_.
+  void AdoptTopology();
+  // Create/attach the group's shm edges (ring rings per channel + star
+  // edges to the leader), then unlink-after-map.  Bounded by the
+  // rendezvous timeout; a peer death mid-wiring surfaces as a clean
+  // init error.
+  bool WireShmEdges(std::string* err);
+  // Intra-group cyclic ring, one ring per direction per channel:
+  // shm_ring_tx_[c] carries my bytes toward ring-next, shm_ring_rx_[c]
+  // receives from ring-prev (matching the TCP plane, where collectives
+  // only ever send next / recv prev).  shm_star_ holds the duplex edges
+  // to the group leader (members: [0] = to-leader; the leader: one per
+  // member, indexed by group position, [0] unused) — they carry the
+  // small-tensor star algorithm, the two-level segment gather, and the
+  // result broadcast.
+  std::vector<ShmRing> shm_ring_tx_, shm_ring_rx_;
+  std::vector<ShmEdge> shm_star_;
+  // Leader-only inter-host ring, one socket pair per channel.
+  std::vector<Socket> cross_next_, cross_prev_;
+  void CloseShmEdges();
+  void CountShmBytes(int64_t tx, int64_t rx);
+
+  RingSpec TcpRingSpec();              // whole world over the TCP ring
+  RingSpec ShmRingSpec();              // my host group over shm rings
+  RingSpec CrossRingSpec();            // leaders over TCP
+  // The flat ring collectives actually run on: the shm ring when one host
+  // group spans the whole committed world (and shm is wired), the TCP
+  // ring otherwise.  Identical vrank/rsize either way, so transport can
+  // never change segment arithmetic — only the bytes' route.
+  RingSpec FlatRingSpec();
+  // Count payload bytes moved on a port (data_bytes_* always; the shm/
+  // intra-host counters when the port is an shm edge).
+  void CountPortBytes(const RingPort& port, int64_t tx, int64_t rx);
+  // Transport-generic primitives on one ring port (TCP socket pair or shm
+  // edge) — the phase/relay code calls these and never branches on the
+  // channel kind itself.  `patience_rounds` scales the shm no-progress
+  // bound exactly like RecvAllPatient's socket-timeout rounds.
+  static bool PortSendRecvChunked(
+      const RingPort& port, const void* send_buf, size_t sn, void* recv_buf,
+      size_t rn, size_t chunk,
+      const std::function<void(size_t, size_t)>& on_chunk, int timeout_ms,
+      std::string* err, int64_t* wire_ns);
+  bool PortSendAll(const RingPort& port, const void* p, size_t n,
+                   std::string* err);
+  bool PortRecvAllPatient(const RingPort& port, void* p, size_t n,
+                          int patience_rounds, std::string* err);
+
+  // Two-level allreduce over the committed topology (see above): intra
+  // ring reduce-scatter (or the star fold under the small-tensor algo) →
+  // segment gather to the leader → leader ring across hosts → star
+  // broadcast back down.  Deterministic per topology; value-independent
+  // of transport, channels, and the algo threshold (the star emulates the
+  // ring's exact per-segment fold order).
+  bool TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
+                         ReduceOp op, const std::string& name,
+                         const ExecCtx& ctx, std::string* err);
+  // Star (gather→fold→broadcast) allreduce within the host group: every
+  // member ships its buffer to the leader over shm, the leader reproduces
+  // the ring reduce-scatter's per-segment fold ORDER exactly (same
+  // ReduceInto kernel, same operand order, same EvenSegments boundaries —
+  // the algo switch can therefore never change a bit), and — when
+  // `broadcast_result` — ships the folded buffer back.  2 shm hops of
+  // latency instead of 2(L-1) ring steps: the small-tensor path.
+  bool StarFoldAllreduce(uint8_t* base, int64_t count, DataType dtype,
+                         ReduceOp op, bool broadcast_result,
+                         std::string* err);
+  // Leader → members full-buffer broadcast over the star edges (chunked).
+  bool StarBroadcast(uint8_t* base, size_t nbytes, std::string* err);
+  // Should this allreduce take the star path?  bytes under the live
+  // threshold, star edges wired, and the serial execution context (a
+  // concurrent wave slice owns one CHANNEL, not the star edges).
+  bool UseSmallAlgo(int64_t nbytes, const ExecCtx& ctx) const;
 
   // -- data plane: channels / pool / chunking knobs --
   // Committed per-edge channel count.  The env default is auto from core
@@ -614,6 +748,16 @@ class Engine {
   // overlaps the ReduceInto of chunk k); multiple of 8 so chunk edges
   // align to every dtype.  Live-tunable (see the knobs comment above).
   std::atomic<int64_t> chunk_bytes_{1 << 20};
+  // HOROVOD_ALGO_THRESHOLD: size-based algorithm selection (the NCCL
+  // tree-vs-ring pattern PAPER.md's L0 layer delegates downward).
+  // Allreduces at or under this many payload bytes take the
+  // latency-optimized star path when star edges are wired; 0 disables.
+  // Live-tunable (committed at rendezvous, retuned via TUNE frames —
+  // every rank must agree or the wire patterns split).  Value-neutral by
+  // construction: the star reproduces the ring's exact fold order.
+  std::atomic<int64_t> algo_threshold_{32 * 1024};
+  // HOROVOD_SHM_RING_BYTES: per-direction shm ring capacity.
+  int64_t shm_ring_bytes_ = 2 << 20;
   // Concurrent-response wave width: how many independent responses of
   // one cycle execute at once on disjoint channels (<= num_channels_).
   // The committed value is broadcast in the rendezvous ASSIGN next to
@@ -645,6 +789,7 @@ class Engine {
     int64_t fusion_threshold = 0;
     int32_t cycle_time_ms = 0;
     int32_t wave_width = 0;
+    int64_t algo_threshold = -1;  // < 0: leave unchanged (0 is a real value)
     bool commit = false;
   };
   std::mutex tune_mu_;
@@ -680,6 +825,11 @@ class Engine {
   std::atomic<int64_t> wire_ns_{0};
   std::atomic<int64_t> allreduce_bytes_{0};
   std::atomic<int64_t> allreduce_ns_{0};
+  std::atomic<int64_t> shm_bytes_tx_{0};
+  std::atomic<int64_t> shm_bytes_rx_{0};
+  std::atomic<int64_t> intra_host_bytes_{0};
+  std::atomic<int64_t> algo_small_count_{0};
+  std::atomic<int64_t> algo_ring_count_{0};
   std::atomic<int64_t> tune_trials_{0};
 
   // -- timeline --

@@ -144,6 +144,7 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
     w->i64(list.tune_fusion_threshold);
     w->i32(list.tune_cycle_time_ms);
     w->i32(list.tune_wave_width);
+    w->i64(list.tune_algo_threshold);
   }
 }
 
@@ -168,6 +169,7 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
     out->tune_fusion_threshold = r->i64();
     out->tune_cycle_time_ms = r->i32();
     out->tune_wave_width = r->i32();
+    out->tune_algo_threshold = r->i64();
   }
   return r->ok();
 }

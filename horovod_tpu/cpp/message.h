@@ -172,6 +172,10 @@ struct ResponseList {
   int64_t tune_fusion_threshold = 0;
   int32_t tune_cycle_time_ms = 0;
   int32_t tune_wave_width = 0;
+  // Size-based algorithm-selection crossover (HOROVOD_ALGO_THRESHOLD).
+  // Unlike the knobs above, 0 is a REAL value (small path disabled), so
+  // "leave unchanged" is < 0.
+  int64_t tune_algo_threshold = -1;
 };
 
 // Flat byte-buffer serialization (host byte order; in-cluster only).

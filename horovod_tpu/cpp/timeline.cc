@@ -129,6 +129,12 @@ void Timeline::ActivityEndCh(const std::string& name, int tid) {
   WriteEvent(TensorPid(name), 'E', "ACTIVITY", "", tid);
 }
 
+void Timeline::Algo(const std::string& name, const char* algo) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'X', "ACTIVITY", algo);
+}
+
 void Timeline::TuneTrial(const std::string& config, bool commit) {
   std::lock_guard<std::recursive_mutex> lk(mu_);
   if (file_ == nullptr) return;

@@ -39,6 +39,10 @@ class Timeline {
   void ActivityStartCh(const std::string& name, const std::string& activity,
                        int tid);
   void ActivityEndCh(const std::string& name, int tid);
+  // Size-based algorithm selection: one instantaneous ALGO_SMALL /
+  // ALGO_RING marker per allreduce response, so a trace shows which
+  // responses took the latency star vs. the bandwidth ring.
+  void Algo(const std::string& name, const char* algo);
   // Online-autotuner trials live on one dedicated trace "process"
   // (pid "autotune"): each applied trial writes an instantaneous
   // TUNE_TRIAL(config...) marker plus a span that covers its scoring

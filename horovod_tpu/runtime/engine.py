@@ -153,6 +153,15 @@ class NativeEngine:
                         "horovod_channel_drivers",
                         "horovod_cache_capacity",
                         "horovod_socket_buf_bytes",
+                        "horovod_shm_bytes_tx",
+                        "horovod_shm_bytes_rx",
+                        "horovod_intra_host_bytes",
+                        "horovod_algo_small_count",
+                        "horovod_algo_ring_count",
+                        "horovod_topology_hosts",
+                        "horovod_topology_local_ranks",
+                        "horovod_shm_enabled",
+                        "horovod_algo_threshold",
                         "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
@@ -169,7 +178,7 @@ class NativeEngine:
         try:
             lib.horovod_autotune_set.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-                ctypes.c_int64, ctypes.c_int,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
             ]
             lib.horovod_autotune_set.restype = ctypes.c_int
         except AttributeError:
@@ -340,6 +349,17 @@ class NativeEngine:
         NCCL busbw convention — comparable across world sizes);
         ``num_channels`` is the committed per-edge channel fan-out.
 
+        Shared memory / hierarchy (HOROVOD_SHM_DISABLE=0, the default):
+        ``shm_bytes_tx``/``_rx`` sum payload bytes this process moved
+        through shm rings (they also count into ``data_bytes_*`` — shm
+        is a transport of the same data plane); ``intra_host_bytes``
+        sums payload exchanged with co-located ranks (tx + rx);
+        ``algo_small_count``/``algo_ring_count`` count allreduce
+        responses executed via the latency star path vs. the bandwidth
+        ring (HOROVOD_ALGO_THRESHOLD); ``topology`` is the committed
+        host grouping as ``{"hosts": H, "local_ranks": L}`` (this
+        rank's group size).
+
         Autotune (HOROVOD_AUTOTUNE): ``tune_trials`` counts TUNE frames
         applied on this rank (0 with autotuning off — the observable
         proof the default path never sees one), and ``config`` reports
@@ -347,11 +367,11 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_tune_trials", None),
+        if getattr(getattr(self._lib, "horovod_algo_threshold", None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
                 "libhorovod_core.so predates the execution/control-plane/"
-                "data-plane/autotune counters — rebuild it with "
+                "data-plane/shm/autotune counters — rebuild it with "
                 "`make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
         ar_bytes = self._lib.horovod_allreduce_bytes()
@@ -382,6 +402,15 @@ class NativeEngine:
             "allreduce_ns": ar_ns,
             "allreduce_bus_bw_bytes_per_sec": bus_bw,
             "num_channels": self._lib.horovod_num_channels(),
+            "shm_bytes_tx": self._lib.horovod_shm_bytes_tx(),
+            "shm_bytes_rx": self._lib.horovod_shm_bytes_rx(),
+            "intra_host_bytes": self._lib.horovod_intra_host_bytes(),
+            "algo_small_count": self._lib.horovod_algo_small_count(),
+            "algo_ring_count": self._lib.horovod_algo_ring_count(),
+            "topology": {
+                "hosts": self._lib.horovod_topology_hosts(),
+                "local_ranks": self._lib.horovod_topology_local_ranks(),
+            },
             "tune_trials": self._lib.horovod_tune_trials(),
             "config": {
                 "num_channels": self._lib.horovod_num_channels(),
@@ -392,6 +421,8 @@ class NativeEngine:
                 "wave_width": self._lib.horovod_wave_width(),
                 "cache_capacity": self._lib.horovod_cache_capacity(),
                 "socket_buf_bytes": self._lib.horovod_socket_buf_bytes(),
+                "shm_enabled": bool(self._lib.horovod_shm_enabled()),
+                "algo_threshold": self._lib.horovod_algo_threshold(),
             },
         }
 
@@ -404,11 +435,11 @@ class NativeEngine:
         the bandwidth of exactly the window between the two snapshots,
         which is what the autotuner scores trials with and what bench/
         tests previously hand-rolled.  Non-cumulative keys (``config``,
-        ``num_channels``) carry the CURRENT value."""
+        ``num_channels``, ``topology``) carry the CURRENT value."""
         now = self.stats()
         delta: dict = {}
         for k, v in now.items():
-            if k in ("config", "num_channels",
+            if k in ("config", "num_channels", "topology",
                      "allreduce_bus_bw_bytes_per_sec"):
                 delta[k] = v
                 continue
@@ -423,17 +454,20 @@ class NativeEngine:
 
     def autotune_set(self, *, chunk_bytes: int = 0,
                      fusion_threshold: int = 0, cycle_time_ms: int = 0,
-                     wave_width: int = 0, commit: bool = False) -> bool:
+                     wave_width: int = 0, algo_threshold: int = -1,
+                     commit: bool = False) -> bool:
         """Queue a TUNE proposal (coordinator only): the engine
         broadcasts it in the next cycle's epoch-stamped frame and every
         rank applies it between cycles.  Values <= 0 leave that knob
-        unchanged.  Returns False when the engine refused (not
-        initialized, not the coordinator, or a stale prebuilt .so)."""
+        unchanged — except ``algo_threshold``, where 0 is a real value
+        (small-tensor star path off) and "leave unchanged" is < 0.
+        Returns False when the engine refused (not initialized, not the
+        coordinator, or a stale prebuilt .so)."""
         fn = getattr(self._lib, "horovod_autotune_set", None)
         if getattr(fn, "restype", None) is not ctypes.c_int:
             return False
         return fn(int(chunk_bytes), int(fusion_threshold),
-                  int(cycle_time_ms), int(wave_width),
+                  int(cycle_time_ms), int(wave_width), int(algo_threshold),
                   1 if commit else 0) == 0
 
     # -- handle API --

@@ -113,6 +113,13 @@ def main():
             / post_steps
         assert rts <= 1.5, f"control-plane gate after resize: {rts} rt/step"
         assert s2["cache_hits"] > s1["cache_hits"], (s1, s2)
+        # The resize must have rewired fresh shm rings for the new epoch
+        # (stale epoch-stamped segments swept, new ones epoch-matched):
+        # the post-resize loop really moves bytes through shm whenever
+        # the committed world is one co-located group with shm on.
+        if (s2["config"].get("shm_enabled")
+                and s2["topology"]["local_ranks"] == size and size > 1):
+            assert s2["shm_bytes_tx"] > s1["shm_bytes_tx"], (s1, s2)
 
     loss = float(np.mean((state.w - mean_target(size)) ** 2))
     print(

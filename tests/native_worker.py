@@ -643,6 +643,144 @@ def scenario_channels_stats(rank, size, eng):
         assert after["num_channels"] == want_ch, after
 
 
+def scenario_shm_parity(rank, size, eng):
+    # Transport neutrality: the shm flat ring (the default on a single
+    # host) must be BIT-IDENTICAL to the pure-TCP plane
+    # (HOROVOD_SHM_DISABLE=1) for every dtype/op — same vrank/rsize, same
+    # segments, same fold order; only the bytes' route changes.  This
+    # also covers the small-tensor star path: under the default
+    # HOROVOD_ALGO_THRESHOLD the sub-32 KB cases take the star fold on
+    # the shm run (the TCP run has no star edges), so identical bytes
+    # prove the star emulates the ring's exact operand sequence.
+    assert eng.stats()["config"]["shm_enabled"], "expected shm on"
+    cases = _parity_cases(rank, size)
+    before = eng.stats()
+    shm_out = _parity_run(eng, cases, "shm")
+    after = eng.stats()
+    assert after["shm_bytes_tx"] > before["shm_bytes_tx"], after
+    assert after["intra_host_bytes"] > before["intra_host_bytes"], after
+    assert after["algo_small_count"] > before["algo_small_count"], after
+    basics.shutdown()
+    os.environ["HOROVOD_SHM_DISABLE"] = "1"
+    basics.init()
+    assert not eng.stats()["config"]["shm_enabled"]
+    s0 = eng.stats()
+    tcp_out = _parity_run(eng, cases, "tcp")
+    s1 = eng.stats()
+    assert s1["shm_bytes_tx"] == s0["shm_bytes_tx"], "TCP run used shm?"
+    assert s1["algo_small_count"] == s0["algo_small_count"], s1
+    for i, (m, s) in enumerate(zip(shm_out, tcp_out)):
+        assert m.dtype == s.dtype and m.shape == s.shape, (i, m.shape)
+        assert m.tobytes() == s.tobytes(), (
+            f"case {i}: shm differs from TCP (dtype {m.dtype})")
+
+
+def scenario_algo_parity(rank, size, eng):
+    # Size-based algorithm selection is value-neutral: a run with the
+    # star path engaged for everything it can reach (the harness sets
+    # HOROVOD_ALGO_THRESHOLD=1 MB) is bit-identical to the same run with
+    # it disabled (threshold 0 → pure ring).  Counters are process-
+    # cumulative, so deltas prove which path actually ran.
+    cases = _parity_cases(rank, size)
+    b0 = eng.stats()
+    star_out = _parity_run(eng, cases, "star")
+    b1 = eng.stats()
+    assert b1["algo_small_count"] > b0["algo_small_count"], b1
+    basics.shutdown()
+    os.environ["HOROVOD_ALGO_THRESHOLD"] = "0"
+    basics.init()
+    assert eng.stats()["config"]["algo_threshold"] == 0
+    r0 = eng.stats()
+    ring_out = _parity_run(eng, cases, "ring")
+    r1 = eng.stats()
+    assert r1["algo_small_count"] == r0["algo_small_count"], r1
+    assert r1["algo_ring_count"] > r0["algo_ring_count"], r1
+    for i, (a, b) in enumerate(zip(star_out, ring_out)):
+        assert a.tobytes() == b.tobytes(), (
+            f"case {i}: star path differs from ring (dtype {a.dtype})")
+
+
+def scenario_shm_stats(rank, size, eng):
+    # The shm/hierarchy counters: a 4 MB allreduce rides the shm ring
+    # (ALGO_RING), a 256 B one takes the star (ALGO_SMALL, default 32 KB
+    # threshold); shm bytes count into data bytes, and the committed
+    # topology is one host spanning the world.
+    before = eng.stats()
+    n = (4 << 20) // 4
+    big = eng.allreduce(np.ones(n, np.float32), name="shm.stats.big")
+    assert np.allclose(big, float(size))
+    small = eng.allreduce(np.ones(64, np.float32), name="shm.stats.small")
+    assert np.allclose(small, float(size))
+    after = eng.stats()
+    assert after["topology"] == {"hosts": 1, "local_ranks": size}, after
+    assert after["config"]["shm_enabled"] is True, after
+    assert after["config"]["algo_threshold"] == 32 << 10, after
+    d_shm_tx = after["shm_bytes_tx"] - before["shm_bytes_tx"]
+    d_shm_rx = after["shm_bytes_rx"] - before["shm_bytes_rx"]
+    d_data_tx = after["data_bytes_tx"] - before["data_bytes_tx"]
+    assert d_shm_tx > 0 and d_shm_rx > 0, after
+    assert d_shm_tx <= d_data_tx, (d_shm_tx, d_data_tx)
+    d_intra = after["intra_host_bytes"] - before["intra_host_bytes"]
+    assert d_intra == d_shm_tx + d_shm_rx, (d_intra, d_shm_tx, d_shm_rx)
+    assert after["algo_ring_count"] - before["algo_ring_count"] >= 1, after
+    assert after["algo_small_count"] - before["algo_small_count"] >= 1, \
+        after
+
+
+def scenario_hier_exact(rank, size, eng):
+    # Two-level is a DIFFERENT (deterministic) reduction order than the
+    # flat ring, so fp sums need not match it bitwise — but the topology
+    # must be deterministic (identical bytes when the same collectives
+    # repeat) and order-free ops (integer sums, min/max, bool) must equal
+    # the numpy reference exactly.
+    st = eng.stats()
+    assert st["topology"]["hosts"] > 1, st
+    cases = _parity_cases(rank, size)
+    out1 = _parity_run(eng, cases, "h1")
+    out2 = _parity_run(eng, cases, "h2")
+    for i, (a, b) in enumerate(zip(out1, out2)):
+        assert a.tobytes() == b.tobytes(), (
+            f"case {i}: two-level not deterministic (dtype {a.dtype})")
+    peer_cases = [cases if r == rank else _parity_cases(r, size)
+                  for r in range(size)]
+    for i, (arr, op) in enumerate(cases):
+        floatish = (np.dtype(arr.dtype).kind == "f"
+                    or np.dtype(arr.dtype).name == "bfloat16")
+        if op not in ("min", "max") and floatish:
+            # Rounding-order-sensitive: allclose only.
+            stack = np.stack([np.asarray(peer_cases[r][i][0], np.float64)
+                              for r in range(size)])
+            ref = {"sum": stack.sum(0), "prod": stack.prod(0)}[op]
+            assert np.allclose(np.asarray(out1[i], np.float64), ref,
+                               rtol=5e-2, atol=1e-1), (i, op, arr.dtype)
+            continue
+        ref_in = [np.asarray(peer_cases[r][i][0]) for r in range(size)]
+        if np.dtype(arr.dtype).kind == "b":
+            stack = np.stack(ref_in)
+            ref = stack.any(0) if op in ("sum", "max") else stack.all(0)
+            assert np.array_equal(out1[i], ref), (i, op)
+            continue
+        stack = np.stack([np.asarray(a, np.float64) for a in ref_in])
+        ref = {"sum": stack.sum(0), "min": stack.min(0),
+               "max": stack.max(0), "prod": stack.prod(0)}[op]
+        got = np.asarray(out1[i], np.float64)
+        assert np.allclose(got, ref), (i, op, arr.dtype)
+    assert eng.stats()["intra_host_bytes"] > 0
+
+
+def scenario_spin(rank, size, eng):
+    # Keep allreducing until killed (the shm leak test SIGKILLs the job
+    # mid-collective and then inspects /dev/shm); bounded so an un-killed
+    # run still exits.
+    deadline = __import__("time").monotonic() + 60
+    i = 0
+    while __import__("time").monotonic() < deadline:
+        x = np.full((1 << 14,), float(rank + 1), dtype=np.float32)
+        out = eng.allreduce(x, name=f"spin.{i % 8}")
+        assert np.allclose(out, size * (size + 1) / 2.0)
+        i += 1
+
+
 def scenario_channels_big(rank, size, eng):
     # A few 8 MB allreduces: enough payload that every configured channel
     # carries a shard (timeline shows the per-channel RING_CH tracks).
@@ -681,6 +819,11 @@ SCENARIOS = {
     "channels_parity": scenario_channels_parity,
     "channels_stats": scenario_channels_stats,
     "channels_big": scenario_channels_big,
+    "shm_parity": scenario_shm_parity,
+    "algo_parity": scenario_algo_parity,
+    "shm_stats": scenario_shm_stats,
+    "hier_exact": scenario_hier_exact,
+    "spin": scenario_spin,
     "all": None,
 }
 

@@ -99,12 +99,20 @@ def test_state_file_round_trip(tmp_path):
 
     path = str(tmp_path / "autotune.json")
     committed = {"chunk_bytes": 1 << 20, "cycle_time_ms": 2,
-                 "fusion_threshold": 32 << 20, "wave_width": 2}
+                 "fusion_threshold": 32 << 20, "wave_width": 2,
+                 "algo_threshold": 64 << 10}
     save_state(path, committed, 123.0, seed=7,
                wiring={"num_channels": 2, "channel_drivers": 2})
     state = load_state(path)
     assert state["committed"] == committed
     assert state["wiring"] == {"num_channels": 2, "channel_drivers": 2}
+    # algo_threshold 0 is a REAL committed value (star path off) and must
+    # survive the round trip; 0 on any other knob means "unset" and drops.
+    committed_zero = dict(committed, algo_threshold=0, wave_width=0)
+    save_state(path, committed_zero, 123.0, seed=7, wiring={})
+    state = load_state(path)
+    assert state["committed"]["algo_threshold"] == 0
+    assert "wave_width" not in state["committed"]
     # Corruption degrades to a cold search, never a crash.
     with open(path, "w") as f:
         f.write("{not json")

@@ -80,10 +80,13 @@ def test_restart_rewires_all_channels():
 def test_multichannel_timeline_per_channel_tracks(tmp_path):
     """With 2 channels the timeline carries a RING_CH<k> activity span
     per channel on its own trace tid, alongside the op-level
-    RING_ALLREDUCE span."""
+    RING_ALLREDUCE span.  Pinned to the TCP plane (shm off) — the shm
+    flat ring writes SHM_CH<k> spans instead, asserted by the shm
+    timeline test."""
     path = tmp_path / "timeline.json"
     run_workers(2, "channels_big",
                 extra_env={"HOROVOD_NUM_CHANNELS": "2",
+                           "HOROVOD_SHM_DISABLE": "1",
                            "HOROVOD_TIMELINE": str(path)})
     text = path.read_text()
     assert "RING_ALLREDUCE" in text
@@ -92,3 +95,69 @@ def test_multichannel_timeline_per_channel_tracks(tmp_path):
     tids = {e.get("tid") for e in events if str(e.get("name", ""))
             .startswith("RING_CH")}
     assert len(tids) == 2, tids  # one trace track per channel
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory transport + hierarchy + size-based algorithm selection
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_shm_bitwise_parity_vs_tcp(n):
+    """The shm flat ring (default on one host) vs HOROVOD_SHM_DISABLE=1,
+    bitwise, across every dtype (incl. fp16/bf16 RNE edges and prime
+    counts), sum/min/max/prod, fused bursts, and multi-MB sharded
+    buffers.  The worker runs both transports in-process (shutdown +
+    re-init) and compares raw bytes; the shm run also proves the
+    small-tensor star path engaged (algo_small_count moved) — so the
+    comparison covers star-vs-ring equivalence too."""
+    run_workers(n, "shm_parity", timeout=300)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_algo_threshold_parity(n):
+    """HOROVOD_ALGO_THRESHOLD=1 MB (star for everything it can reach) vs
+    0 (pure ring): bit-identical for every dtype/op — the star reproduces
+    the ring's exact per-segment fold order."""
+    run_workers(n, "algo_parity",
+                extra_env={"HOROVOD_ALGO_THRESHOLD": str(1 << 20)},
+                timeout=300)
+
+
+def test_shm_parity_multichannel_tiny_chunks():
+    """Adversarial chunk size + channels>1 over the shm rings: the
+    streaming shm cascade must not change a single bit either."""
+    run_workers(2, "shm_parity",
+                extra_env={"HOROVOD_NUM_CHANNELS": "3",
+                           "HOROVOD_CHUNK_BYTES": "8192"}, timeout=300)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_shm_stats_counters(n):
+    """shm_bytes_tx/rx, intra_host_bytes, algo_small/ring_count, and the
+    committed topology (1 host x world) are all live and consistent."""
+    run_workers(n, "shm_stats")
+
+
+def test_hierarchical_exactness_and_determinism():
+    """4 ranks grouped 2x2 via per-rank HOROVOD_HOST_KEY: the two-level
+    path is deterministic (repeat runs bitwise-identical), exact for
+    order-free ops (integer/min/max/bool vs numpy), and allclose for
+    order-sensitive fp sums."""
+    run_workers(4, "hier_exact", timeout=300,
+                per_rank_env=lambda r: {"HOROVOD_HOST_KEY":
+                                        f"host{r // 2}"})
+
+
+def test_shm_timeline_spans_and_algo_markers(tmp_path):
+    """The shm flat ring writes SHM_CH<k> spans and every allreduce
+    response carries an instantaneous ALGO marker (ALGO_RING for the
+    4 MB payload, ALGO_SMALL for the 256 B one)."""
+    path = tmp_path / "timeline.json"
+    run_workers(2, "shm_stats",
+                extra_env={"HOROVOD_TIMELINE": str(path)})
+    text = path.read_text()
+    assert "SHM_CH0" in text
+    assert "ALGO_RING" in text
+    assert "ALGO_SMALL" in text
+    assert "RING_CH0" not in text  # nothing rode the TCP plane

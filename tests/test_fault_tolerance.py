@@ -335,3 +335,87 @@ def test_run_elastic_recovers_worker_loss_to_identical_loss(kind):
     faulted_losses, clean_losses = _losses(faulted), _losses(clean)
     assert len(faulted_losses) == 1, faulted_losses  # all ranks agree
     assert faulted_losses == clean_losses
+
+
+# ---------------------------------------------------------------------------
+# Shared-memory segment lifecycle (leak-proof by construction)
+# ---------------------------------------------------------------------------
+
+
+def _shm_entries(prefix):
+    try:
+        return [e for e in os.listdir("/dev/shm") if e.startswith(prefix)]
+    except OSError:
+        return []
+
+
+def test_no_leaked_shm_segments_after_killed_job():
+    """SIGKILL an entire 4-rank job mid-collective: /dev/shm must hold no
+    entries for the job afterwards.  Wired edges were unlinked the moment
+    the consumer confirmed its mapping (unlink-after-map), so only a kill
+    DURING wiring could leak a name — and that window is what the
+    epoch-stamped sweep covers (next test)."""
+    import socket as socket_mod
+    import time
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(4):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": "4",
+            "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
+            "HOROVOD_CYCLE_TIME": "2",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests",
+                                          "native_worker.py"), "spin"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    try:
+        # Let the job wire its shm rings and run collectives for a bit.
+        time.sleep(6)
+        assert all(p.poll() is None for p in procs), \
+            "job died before the kill (wiring failed?)"
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.communicate()
+    leaked = _shm_entries(f"hvd{port}_")
+    assert leaked == [], f"leaked /dev/shm entries: {leaked}"
+
+
+def test_stale_shm_segment_swept_on_init():
+    """A segment left by a crash DURING a previous incarnation's wiring
+    (epoch-stamped name, never attached) must be swept by the next job's
+    coordinator rendezvous on the same port."""
+    import socket as socket_mod
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    stale = f"/dev/shm/hvd{port}_e0_g0_r0_c0"
+    with open(stale, "wb") as f:
+        f.write(b"\0" * 4096)
+    try:
+        run_workers(2, "allreduce",
+                    extra_env={"HOROVOD_COORDINATOR": f"127.0.0.1:{port}"})
+        assert not os.path.exists(stale), "stale segment survived rendezvous"
+    finally:
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+
+
+def test_worker_death_mid_shm_collective_aborts_cleanly():
+    """A rank dying mid-allreduce over the shm flat ring: survivors must
+    fail promptly with a HorovodInternalError naming the culprit (the
+    closed-ring EOF analogue), never hang on a silent SPSC ring."""
+    run_workers(3, "worker_death", extra_env=FAULT_ENV, timeout=60,
+                expected_rc={2: 31})

@@ -31,7 +31,7 @@ def _free_port():
 
 
 def run_workers(n, scenario, extra_env=None, timeout=90, expected_rc=None,
-                worker=None):
+                worker=None, per_rank_env=None):
     _ensure_lib()
     port = _free_port()
     procs = []
@@ -45,6 +45,8 @@ def run_workers(n, scenario, extra_env=None, timeout=90, expected_rc=None,
             "HOROVOD_CYCLE_TIME": "2",
         })
         env.update(extra_env or {})
+        if per_rank_env is not None:
+            env.update(per_rank_env(rank))
         procs.append(subprocess.Popen(
             [sys.executable, worker or WORKER, scenario],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -122,48 +124,51 @@ def test_broadcast_root_mismatch_raises():
     run_workers(2, "root_mismatch")
 
 
-HIER_ENV = {
-    # Simulated 2-hosts x 2-ranks topology on one machine: basics derives
-    # local_rank = rank % local_size, the engine groups nodes as
-    # rank // local_size (same layout horovod_tpu.run assigns real
-    # multi-host launches).
-    "HOROVOD_LOCAL_SIZE": "2",
-    "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
-}
+def _hier_env(rank):
+    # Simulated 2-hosts x 2-ranks topology on one machine: per-rank HOST
+    # KEYS drive the rendezvous grouping (the coordinator groups JOIN
+    # frames by hostname#boot-id; HOROVOD_HOST_KEY overrides it) — ranks
+    # 0,1 group on "host0", 2,3 on "host1"; leaders {0,2} ring over TCP,
+    # co-located pairs exchange over shm.
+    return {"HOROVOD_HOST_KEY": f"host{rank // 2}",
+            "HOROVOD_LOCAL_SIZE": "2"}
 
 
 def test_hierarchical_allreduce_identity():
-    """Two-level (local chain + leader ring) allreduce returns the same
-    values as the flat ring (reference operations.cc:1025-1187 role)."""
-    run_workers(4, "allreduce", extra_env=HIER_ENV)
+    """Two-level (intra-host shm + leader ring) allreduce returns the
+    same values as the flat ring (reference operations.cc:1025-1187
+    role)."""
+    run_workers(4, "allreduce", per_rank_env=_hier_env)
 
 
 def test_hierarchical_fused_allreduce():
-    run_workers(4, "fused", extra_env=HIER_ENV)
+    run_workers(4, "fused", per_rank_env=_hier_env)
 
 
 def test_hierarchical_timeline_records_two_level_path(tmp_path):
-    """The toggle is actually honored: the timeline shows the hierarchical
-    activity, not the flat ring."""
+    """The committed topology is actually honored: the timeline shows the
+    two-level activity, not the flat ring."""
     path = tmp_path / "timeline.json"
-    run_workers(4, "allreduce",
-                extra_env={**HIER_ENV, "HOROVOD_TIMELINE": str(path)})
+    run_workers(4, "allreduce", per_rank_env=_hier_env,
+                extra_env={"HOROVOD_TIMELINE": str(path)})
     text = path.read_text()
-    assert "HIERARCHICAL_ALLREDUCE" in text
+    assert "TWO_LEVEL_ALLREDUCE" in text
     assert "RING_ALLREDUCE" not in text
 
 
 def test_hierarchical_mixed_stress():
-    """The mixed burst under the two-level topology: hierarchical
-    allreduces interleaved with ring gathers/broadcasts."""
-    run_workers(4, "mixed_stress", extra_env=HIER_ENV)
+    """The mixed burst under the two-level topology: two-level allreduces
+    interleaved with ring gathers/broadcasts."""
+    run_workers(4, "mixed_stress", per_rank_env=_hier_env)
 
 
-def test_hierarchical_falls_back_on_bad_topology():
-    """size=3 with local_size=2 cannot split into equal nodes: the
-    coordinator must agree a GLOBAL fallback to the flat ring (never a mix
-    of hierarchical and flat wiring) and results stay correct."""
-    run_workers(3, "allreduce", extra_env=HIER_ENV)
+def test_hierarchical_uneven_groups():
+    """size=3 split host0={0,1}, host1={2}: groups of unequal size (incl.
+    a singleton whose leader is its whole group) still produce correct
+    values — no equal-split requirement anywhere in the decomposition."""
+    run_workers(3, "allreduce",
+                per_rank_env=lambda r: {"HOROVOD_HOST_KEY":
+                                        f"host{min(r // 2, 1)}"})
 
 
 @pytest.mark.parametrize("n", [2, 4])
