@@ -453,6 +453,27 @@ def main() -> None:
         except Exception as e:
             result["engine_bench_error"] = f"{type(e).__name__}: {e}"
 
+    # Serving-plane throughput/latency: open-loop Poisson load against a
+    # 2-replica fleet (bench_serve.py; tokens/sec, p50/p99 request
+    # latency, TTFT, batch occupancy).  Degrade gracefully; skip via
+    # HOROVOD_SKIP_SERVE_BENCH=1.
+    if os.environ.get("HOROVOD_SKIP_SERVE_BENCH") != "1":
+        try:
+            import subprocess
+            import sys
+
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_serve.py")],
+                capture_output=True, timeout=900, text=True)
+            srv = json.loads(proc.stdout.strip().splitlines()[-1])
+            for k, v in srv.items():
+                if k not in ("metric", "router"):
+                    result[f"serve_{k}"] = v
+        except Exception as e:
+            result["serve_bench_error"] = f"{type(e).__name__}: {e}"
+
     print(json.dumps(result))
 
 

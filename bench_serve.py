@@ -1,0 +1,268 @@
+"""Serving benchmark: open-loop Poisson load against the replica fleet.
+
+Open-loop (the serving-literature convention): request arrival times
+are drawn from a Poisson process and honored REGARDLESS of completions,
+so the generator measures the system under load rather than pacing
+itself to it.  Each request records submit → first-token (TTFT) and
+submit → done latency from the client's side of the socket.
+
+Prints ONE JSON line (``bench.py`` merges it into the bench artifact
+under a ``serve_`` prefix, next to the ``engine_`` keys)::
+
+    {"metric": "serve", "tokens_per_sec": .., "req_latency_ms_p50": ..,
+     "req_latency_ms_p99": .., "ttft_ms_p50": .., "ttft_ms_p99": ..,
+     "batch_occupancy": .., "completed": .., "requests": ..,
+     "replicas": 2, "requeued": .., "preemptions": ..,
+     "kv_blocks_in_use_peak_seen": ..}
+
+``python bench_serve.py --gate`` is the CI serve gate: a short Poisson
+run (2 replicas) that FAILS loudly unless every request completes with
+its full nonzero token count, continuous batching actually overlapped
+(measured batch occupancy > 1), shutdown is clean (router exit 0), and
+nothing leaks — replica processes, the router's listen socket, and
+/dev/shm are checked against their pre-run state.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+BENCH_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "HOROVOD_SERVE_BLOCK_SIZE": "4",
+    "HOROVOD_SERVE_MAX_MODEL_LEN": "64",
+    "HOROVOD_SERVE_MAX_BATCH": "8",
+}
+
+
+def _percentile(values, q):
+    if not values:
+        return 0.0
+    vs = sorted(values)
+    idx = min(len(vs) - 1, max(0, int(round(q / 100.0 * (len(vs) - 1)))))
+    return vs[idx]
+
+
+def _replica_procs():
+    """Pids currently running the replica module (leak detection)."""
+    pids = set()
+    for path in glob.glob("/proc/[0-9]*/cmdline"):
+        try:
+            with open(path, "rb") as f:
+                cmd = f.read()
+        except OSError:
+            continue
+        if b"horovod_tpu.serve.replica" in cmd:
+            pids.add(int(path.split("/")[2]))
+    return pids
+
+
+def _start_fleet(replicas: int, env_extra=None):
+    env = dict(os.environ)
+    env.update(BENCH_ENV)
+    env.update(env_extra or {})
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run", "--serve",
+         "--replicas", str(replicas), "--serve-port", "0"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    port = None
+    log = []
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        log.append(line)
+        m = re.search(r"SERVE_ROUTER_READY port=(\d+)", line)
+        if m:
+            port = int(m.group(1))
+            break
+    if port is None:
+        proc.kill()
+        raise RuntimeError("router never became ready:\n" + "".join(log))
+    threading.Thread(target=lambda: [log.append(ln) for ln in
+                                     iter(proc.stdout.readline, "")],
+                     daemon=True).start()
+    return proc, port, log
+
+
+def run_load(port: int, *, requests: int, rate_hz: float, seed: int = 0,
+             max_tokens_lo: int = 8, max_tokens_hi: int = 24):
+    """Drive the Poisson open-loop load; returns per-request records and
+    the aggregate dict."""
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from horovod_tpu.serve.server import ServeClient
+
+    rng = np.random.default_rng(seed)
+    plan = []
+    t = 0.0
+    for i in range(requests):
+        t += float(rng.exponential(1.0 / rate_hz))
+        plan.append((t, rng.integers(0, 512,
+                                     int(rng.integers(3, 12))).tolist(),
+                     int(rng.integers(max_tokens_lo, max_tokens_hi + 1))))
+
+    cli = ServeClient("127.0.0.1", port, timeout=600)
+    records = {}
+    t0 = time.monotonic()
+    for i, (due, prompt, n) in enumerate(plan):
+        now = time.monotonic() - t0
+        if now < due:
+            time.sleep(due - now)
+        rid = f"load{i}"
+        records[rid] = {"submit": time.monotonic(), "n": n}
+        cli.start_generate(rid, prompt, max_tokens=n)
+    for i in range(requests):
+        rid = f"load{i}"
+        evs = cli.collect(rid, timeout=600)
+        rec = records[rid]
+        rec["events"] = evs
+        rec["ok"] = (evs[-1]["event"] == "done"
+                     and len(evs[-1]["tokens"]) == rec["n"]
+                     and rec["n"] > 0)
+        rec["requeued"] = any(e["event"] == "requeued" for e in evs)
+        rec["tokens"] = evs[-1].get("tokens", []) \
+            if evs[-1]["event"] == "done" else []
+    wall = time.monotonic() - t0
+
+    # TTFT needs receive timestamps; approximate from the collect order
+    # is wrong under concurrency, so ServeClient stamps each event.
+    lat, ttft = [], []
+    total_tokens = 0
+    completed = 0
+    requeued = 0
+    for rec in records.values():
+        if not rec["ok"]:
+            continue
+        completed += 1
+        total_tokens += len(rec["tokens"])
+        lat.append((rec["events"][-1]["_recv_ts"] - rec["submit"]) * 1e3)
+        first = next(e for e in rec["events"] if e["event"] == "token")
+        ttft.append((first["_recv_ts"] - rec["submit"]) * 1e3)
+        requeued += int(rec["requeued"])
+    stats = cli.stats()
+    agg = {
+        "metric": "serve",
+        "requests": requests,
+        "completed": completed,
+        "tokens_per_sec": round(total_tokens / wall, 2),
+        "req_latency_ms_p50": round(_percentile(lat, 50), 1),
+        "req_latency_ms_p99": round(_percentile(lat, 99), 1),
+        "ttft_ms_p50": round(_percentile(ttft, 50), 1),
+        "ttft_ms_p99": round(_percentile(ttft, 99), 1),
+        "requeued": requeued,
+        "router": stats["router"],
+        "batch_occupancy": max(
+            (r.get("scheduler", {}).get("batch_occupancy", 0.0)
+             for r in stats["replicas"]), default=0.0),
+        "preemptions": sum(
+            r.get("scheduler", {}).get("preemptions", 0)
+            for r in stats["replicas"]),
+        "kv_blocks_in_use_peak_seen": max(
+            (r.get("scheduler", {}).get("kv_blocks_in_use", 0)
+             for r in stats["replicas"]), default=0),
+    }
+    return cli, records, agg
+
+
+def _main(replicas: int, requests: int, rate_hz: float) -> dict:
+    proc, port, log = _start_fleet(replicas)
+    cli, _, agg = run_load(port, requests=requests, rate_hz=rate_hz)
+    agg["replicas"] = replicas
+    cli.shutdown()
+    rc = proc.wait(timeout=120)
+    cli.close()
+    agg["clean_shutdown"] = (rc == 0)
+    return agg
+
+
+def _gate() -> int:
+    """CI serve gate — see module docstring for the contract."""
+    shm_before = set(os.listdir("/dev/shm")) if os.path.isdir("/dev/shm") \
+        else set()
+    procs_before = _replica_procs()
+
+    replicas, requests, rate = 2, 24, 6.0
+    proc, port, log = _start_fleet(replicas)
+    try:
+        cli, records, agg = run_load(port, requests=requests, rate_hz=rate)
+    except Exception:
+        proc.kill()
+        sys.stdout.write("".join(log[-40:]))
+        raise
+    agg["replicas"] = replicas
+    cli.shutdown()
+    try:
+        rc = proc.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        rc = -9
+    cli.close()
+    agg["clean_shutdown"] = (rc == 0)
+    print(json.dumps(agg))
+
+    failures = []
+    if agg["completed"] != requests:
+        failures.append(f"only {agg['completed']}/{requests} requests "
+                        "completed with their full token count")
+    if agg["batch_occupancy"] <= 1.0:
+        failures.append("batch occupancy "
+                        f"{agg['batch_occupancy']:.2f} <= 1.0: continuous "
+                        "batching never overlapped")
+    if agg["tokens_per_sec"] <= 0:
+        failures.append("zero streamed tokens")
+    if rc != 0:
+        failures.append(f"router exited {rc} (unclean shutdown)")
+    # Leak checks: give stragglers a moment to be reaped.
+    deadline = time.time() + 20
+    while time.time() < deadline and _replica_procs() - procs_before:
+        time.sleep(0.5)
+    leaked_procs = _replica_procs() - procs_before
+    if leaked_procs:
+        failures.append(f"leaked replica processes: {sorted(leaked_procs)}")
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=2):
+            failures.append(f"router port {port} still accepting "
+                            "connections")
+    except OSError:
+        pass
+    shm_after = set(os.listdir("/dev/shm")) if os.path.isdir("/dev/shm") \
+        else set()
+    leaked_shm = shm_after - shm_before
+    if leaked_shm:
+        failures.append(f"leaked /dev/shm entries: {sorted(leaked_shm)}")
+
+    if failures:
+        for f in failures:
+            print(f"SERVE GATE FAIL: {f}", file=sys.stderr)
+        print("".join(log[-40:]), file=sys.stderr)
+        return 1
+    print(f"SERVE GATE OK: {requests} requests, "
+          f"{agg['tokens_per_sec']} tok/s, occupancy "
+          f"{agg['batch_occupancy']:.2f}, p99 "
+          f"{agg['req_latency_ms_p99']:.0f} ms, clean shutdown")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--gate" in sys.argv:
+        sys.exit(_gate())
+    out = _main(
+        replicas=int(os.environ.get("HOROVOD_SERVE_BENCH_REPLICAS", "2")),
+        requests=int(os.environ.get("HOROVOD_SERVE_BENCH_REQUESTS", "40")),
+        rate_hz=float(os.environ.get("HOROVOD_SERVE_BENCH_RATE", "6")))
+    print(json.dumps(out))

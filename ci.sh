@@ -111,6 +111,17 @@ echo "== autotune gate (online knob search vs static grid, hard timeout) =="
 PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
     python bench_engine.py --autotune-gate
 
+echo "== serve gate (2-replica Poisson load, hard timeout) =="
+# Production-serving regression gate: a short open-loop Poisson run
+# against a 2-replica fleet must complete EVERY request with its full
+# nonzero token stream, show real continuous-batching overlap (measured
+# batch occupancy > 1), and shut down clean — no leaked replica
+# processes, no still-listening router socket, no /dev/shm entries
+# (bench_serve.py --gate checks all of it).  The hard timeout is the
+# hang detector for a wedged scheduler/router.
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
+    python bench_serve.py --gate
+
 echo "== multichip sharding dry run =="
 PALLAS_AXON_POOL_IPS= python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8) OK')"
 

@@ -146,7 +146,10 @@ KNOBS: List[Knob] = [
 
 
 def resolved_config(environ=os.environ) -> List[dict]:
-    """Rows of {env, set, default, effective, doc} for every knob."""
+    """Rows of {env, set, default, effective, doc} for every knob —
+    the engine table followed by the serve-plane knobs
+    (horovod_tpu/serve/config.py), so ``--print-config`` is the one
+    consolidated view."""
     rows = []
     for knob in KNOBS:
         raw = environ.get(knob.env)
@@ -163,6 +166,9 @@ def resolved_config(environ=os.environ) -> List[dict]:
             "effective": effective,
             "doc": knob.doc,
         })
+    from horovod_tpu.serve.config import resolved_serve_config
+
+    rows.extend(resolved_serve_config(environ))
     return rows
 
 

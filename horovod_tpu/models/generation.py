@@ -30,7 +30,8 @@ import jax.numpy as jnp
 
 from horovod_tpu.models.llama import LlamaConfig, apply_rope, rope_freqs
 
-__all__ = ["prefill", "decode_step", "generate"]
+__all__ = ["prefill", "decode_step", "generate",
+           "paged_prefill", "paged_decode_step"]
 
 
 def _rms(x, scale, eps):
@@ -133,19 +134,33 @@ def decode_step(cfg: LlamaConfig, variables, token, cache, *, pos):
 
 def generate(cfg: LlamaConfig, variables, prompt_ids, *,
              max_new_tokens: int, temperature: float = 0.0,
-             rng: Optional[jax.Array] = None):
+             rng: Optional[jax.Array] = None,
+             cache_len: Optional[int] = None):
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, S0].
 
     ``temperature == 0`` is greedy argmax; otherwise softmax sampling at
     the given temperature (``rng`` required).  Returns [B, max_new_tokens].
     Wrap in ``jax.jit`` (static cfg/max_new_tokens) for production use —
     the loop is a single ``lax.scan``, so it compiles once.
+
+    ``cache_len`` pins the physical KV length (default: exactly
+    ``S0 + max_new_tokens``).  Logits are a deterministic function of
+    the prompt AND this physical length — XLA's reduction grouping over
+    the key axis varies with it, so near-tied logits can argmax
+    differently at different lengths.  The serving stack runs every
+    forward at ``cache_len = max_model_len``; pass the same value here
+    to get the bit-identical reference stream (tests/test_serve.py).
     """
     if temperature > 0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
     B, S0 = prompt_ids.shape
+    if cache_len is None:
+        cache_len = S0 + max_new_tokens
+    if cache_len < S0 + max_new_tokens:
+        raise ValueError(f"cache_len {cache_len} < prompt + new tokens "
+                         f"{S0 + max_new_tokens}")
     logits, cache = prefill(cfg, variables, prompt_ids,
-                            cache_len=S0 + max_new_tokens)
+                            cache_len=cache_len)
 
     def pick(logits, key):
         if temperature <= 0:
@@ -170,3 +185,173 @@ def generate(cfg: LlamaConfig, variables, prompt_ids, *,
         body, (tok0, cache),
         (keys[1:], S0 + jnp.arange(max_new_tokens - 1)))
     return jnp.concatenate([tok0[:, None], rest.T], axis=1)  # [B, N]
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-table) KV cache — the serving data path (horovod_tpu/serve/).
+#
+# The cache is a pool of fixed-size blocks [L, NB, BS, Hkv, D]; each
+# sequence owns a table of physical block ids covering its logical
+# positions.  The decode math gathers a sequence's blocks back into a
+# contiguous [T, Hkv, D] view and then runs the EXACT per-element
+# operations of the contiguous path above — a gather is a permutation
+# copy, so paged ≡ contiguous bit-for-bit at equal physical length
+# (tests/test_serve.py pins it).  Physical block id 0 is the TRASH block:
+# padded batch rows and unfunded table entries point at it, it is written
+# by every padded row and never read by a live one.
+# ---------------------------------------------------------------------------
+
+
+def _rope_at(head_dim: int, positions, theta: float):
+    """cos/sin [B, head_dim/2] at per-sequence ``positions`` [B] — the
+    batched counterpart of ``rope_freqs(head_dim, 1, theta, offset=p)``,
+    computed with the identical fp32 ops so the bits match."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = positions.astype(jnp.float32)
+    ang = t[:, None] * inv[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _apply_rope_b(x, cos, sin):
+    """apply_rope with per-batch-row tables: x [B, 1, H, D]; cos/sin
+    [B, D/2]."""
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    c = cos[:, None, None, :]
+    s = sin[:, None, None, :]
+    r1 = x1 * c - x2 * s
+    r2 = x1 * s + x2 * c
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _attend_b(q, k, v, *, q_pos, k_len):
+    """_attend with per-sequence positions: q [B,1,Hq,D]; k/v [B,T,Hkv,D];
+    ``q_pos``/``k_len`` [B].  Same einsum strings / fp32 logits / mask
+    value as :func:`_attend`, so valid entries carry identical bits."""
+    B, Sq, Hq, D = q.shape
+    T, Hkv = k.shape[1], k.shape[2]
+    qg = q.reshape(B, Sq, Hkv, Hq // Hkv, D)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32)
+    logits = logits / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    k_pos = jnp.arange(T)
+    mask = (k_pos[None, :] <= q_pos[:, None]) & \
+        (k_pos[None, :] < k_len[:, None])                      # [B, T]
+    logits = jnp.where(mask[:, None, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, Sq, Hq, D)
+
+
+def _paged_layer(cfg: LlamaConfig, lp, x, pk, pv, tables, *, pos):
+    """One decoder layer over one decode token per sequence.
+
+    x: [B, 1, H]; pk/pv: this layer's pool [NB, BS, Hkv, D];
+    tables: [B, MAXB] physical block ids; pos: [B] global positions.
+    Writes K/V at each sequence's ``pos`` slot, then attends the gathered
+    contiguous view.  Returns (x, pk, pv).
+    """
+    D = cfg.head_dim
+    B, S, _ = x.shape
+    bs = pk.shape[1]
+    y = _rms(x, lp["norm_attn"]["scale"], cfg.rms_eps)
+    a = lp["attn"]
+    q = (y @ a["wq"]["kernel"].astype(cfg.dtype)).reshape(
+        B, S, cfg.num_heads, D)
+    k = (y @ a["wk"]["kernel"].astype(cfg.dtype)).reshape(
+        B, S, cfg.num_kv_heads, D)
+    v = (y @ a["wv"]["kernel"].astype(cfg.dtype)).reshape(
+        B, S, cfg.num_kv_heads, D)
+    cos, sin = _rope_at(D, pos, cfg.rope_theta)
+    q, k = _apply_rope_b(q, cos, sin), _apply_rope_b(k, cos, sin)
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+    off = pos % bs
+    pk = pk.at[blk, off].set(k[:, 0])
+    pv = pv.at[blk, off].set(v[:, 0])
+    maxb = tables.shape[1]
+    ck = pk[tables].reshape(B, maxb * bs, cfg.num_kv_heads, D)
+    cv = pv[tables].reshape(B, maxb * bs, cfg.num_kv_heads, D)
+    out = _attend_b(q, ck, cv, q_pos=pos, k_len=pos + 1)
+    x = x + out.reshape(B, S, cfg.num_heads * D) @ \
+        a["wo"]["kernel"].astype(cfg.dtype)
+    y = _rms(x, lp["norm_mlp"]["scale"], cfg.rms_eps)
+    m = lp["mlp"]
+    gate, up = jnp.split(y @ m["w_gate_up"]["kernel"].astype(cfg.dtype), 2,
+                         axis=-1)
+    return x + (jax.nn.silu(gate) * up) @ \
+        m["w_down"]["kernel"].astype(cfg.dtype), pk, pv
+
+
+def paged_decode_step(cfg: LlamaConfig, variables, tokens, pool_k, pool_v,
+                      tables, pos):
+    """One decode step for a batch of independent sequences over the
+    paged pool.
+
+    tokens: [B] current token per sequence; pool_k/pool_v:
+    [L, NB, BS, Hkv, D]; tables: [B, MAXB] int32 block tables (unused
+    tail entries and padded rows point at trash block 0); pos: [B]
+    global position of each token.  Returns (next-position logits
+    [B, V], pool_k, pool_v).  Rows are computed independently — a padded
+    row (pos 0, all-trash table) produces garbage logits the caller
+    discards, and never perturbs a live row.
+    """
+    p = _params(variables)
+    x = jnp.take(p["tok_emb"]["embedding"], tokens[:, None],
+                 axis=0).astype(cfg.dtype)
+    new_k, new_v = [], []
+    for i in range(cfg.num_layers):
+        x, pk, pv = _paged_layer(cfg, p[f"layer_{i}"], x, pool_k[i],
+                                 pool_v[i], tables, pos=pos)
+        new_k.append(pk)
+        new_v.append(pv)
+    x = _rms(x, p["norm_f"]["scale"], cfg.rms_eps)
+    logits = (x.astype(cfg.logits_dtype)
+              @ p["lm_head"]["kernel"].astype(cfg.logits_dtype))
+    return logits[:, -1], jnp.stack(new_k), jnp.stack(new_v)
+
+
+def paged_prefill(cfg: LlamaConfig, variables, prompt_ids, pool_k, pool_v,
+                  table, *, prompt_len, cache_len=None):
+    """Prefill one sequence's (padded) prompt into its pool blocks.
+
+    prompt_ids: [1, S_pad] with S_pad a multiple of the block size
+    (positions >= ``prompt_len`` may hold any id — their K/V rows land in
+    cache slots that every later read either masks or overwrites);
+    table: [cache_len/BS] physical block ids (unfunded tail = trash 0);
+    ``prompt_len`` may be traced.  Returns (logits at the last prompt
+    position [1, V], pool_k, pool_v).
+
+    ``cache_len`` (default S_pad) is the physical length of the
+    temporary contiguous cache the prompt attends over.  Logits depend
+    bitwise on this length (reduction-order effect — see
+    :func:`generate`), so the serving engine pins it to
+    ``max_model_len``: prefill then attends the exact geometry the
+    block-table decode steps do, and the whole serve stream is
+    bit-reproducible against offline ``generate()`` at that
+    ``cache_len``.
+    """
+    if cfg.num_experts > 1:
+        raise NotImplementedError("KV-cache decode supports dense (non-MoE)"
+                                  " configs")
+    p = _params(variables)
+    B, S_pad = prompt_ids.shape
+    bs = pool_k.shape[2]
+    if cache_len is None:
+        cache_len = S_pad
+    shape = (cfg.num_layers, B, cache_len, cfg.num_kv_heads, cfg.head_dim)
+    ck = jnp.zeros(shape, cfg.dtype)
+    cv = jnp.zeros(shape, cfg.dtype)
+    logits, ck, cv = _forward(cfg, p, prompt_ids, ck, cv, pos0=0,
+                              k_len=prompt_len)
+    last = jax.lax.dynamic_index_in_dim(logits, prompt_len - 1, axis=1,
+                                        keepdims=False)
+    nb = cache_len // bs
+    pool_k = pool_k.at[:, table].set(
+        ck[:, 0].reshape(cfg.num_layers, nb, bs, cfg.num_kv_heads,
+                         cfg.head_dim))
+    pool_v = pool_v.at[:, table].set(
+        cv[:, 0].reshape(cfg.num_layers, nb, bs, cfg.num_kv_heads,
+                         cfg.head_dim))
+    return last, pool_k, pool_v

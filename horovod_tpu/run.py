@@ -19,6 +19,12 @@ back to their last commit and re-rendezvous with the replacement.  A
 relaunched worker's env is scrubbed of ``HOROVOD_FAULT_INJECT`` so an
 injected fault fires once, not on every incarnation.
 
+Inference serving: ``--serve`` starts the multi-replica serving stack
+instead of launching a training command — a router on ``--serve-port``
+dispatching to ``--replicas`` replica worlds with continuous batching
+and a paged KV cache (docs/serving.md); ``--restart-on-failure`` doubles
+as the replica relaunch budget.
+
 Elastic membership: ``--elastic`` additionally sets ``HOROVOD_ELASTIC=1``
 so the engine may re-form the world IN PLACE around the survivors — the
 env rank becomes a persistent worker id (a join candidacy, not the final
@@ -92,6 +98,22 @@ def main(argv=None) -> int:
                         help="dump the full resolved engine knob table "
                              "(env -> default -> effective) and exit; "
                              "mirrors the table in docs/performance.md")
+    parser.add_argument("--serve", action="store_true",
+                        help="inference serving mode: start the "
+                             "multi-replica router + replica fleet "
+                             "(docs/serving.md) instead of launching a "
+                             "training command")
+    parser.add_argument("--serve-port", type=int, default=8070,
+                        help="router listen port under --serve "
+                             "(0 = ephemeral, printed in the READY line)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="serving replicas under --serve (each one "
+                             "engine world; --restart-on-failure is the "
+                             "per-fleet relaunch budget on replica death)")
+    parser.add_argument("--serve-model", default=None, metavar="NAME",
+                        help="served model config under --serve "
+                             "(LlamaConfig.<NAME>; default: "
+                             "HOROVOD_SERVE_MODEL or tiny)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run (prefix with --)")
     args = parser.parse_args(argv)
@@ -101,6 +123,10 @@ def main(argv=None) -> int:
 
         print(format_table())
         return 0
+    if args.serve:
+        from horovod_tpu.serve.router import serve_main
+
+        return serve_main(args)
     if args.num_proc is None:
         parser.error("the following arguments are required: -np/--num-proc")
 

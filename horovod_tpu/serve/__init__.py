@@ -1,0 +1,23 @@
+"""Production inference serving on the training stack.
+
+The repo's train→serve story (docs/serving.md): an async request
+front-end (:mod:`.server`) feeds a continuous-batching scheduler
+(:mod:`.scheduler`) that admits new sequences into the running decode
+loop at step granularity, funds them from a paged KV-cache block pool
+(:mod:`.kv_cache` + the block-table decode path in
+``models/generation.py``), and streams tokens back as they are
+produced.  A multi-replica router (:mod:`.router`) treats each engine
+world as one replica — least-loaded dispatch, and on replica death the
+unfinished requests are re-queued onto the survivors while the
+supervisor relaunches the dead world (the serve-plane analogue of the
+elastic shrink/rejoin cycle).
+
+Entry points: ``python -m horovod_tpu.run --serve`` (router + replicas),
+``python -m horovod_tpu.serve.replica`` (one replica), ``bench_serve.py``
+(Poisson open-loop load generator).
+"""
+
+from horovod_tpu.serve.config import ServeConfig, resolved_serve_config
+from horovod_tpu.serve.kv_cache import PagedKVCache
+
+__all__ = ["ServeConfig", "resolved_serve_config", "PagedKVCache"]

@@ -1,0 +1,153 @@
+"""Serve-plane knob resolution (env -> default -> effective).
+
+Same contract as :mod:`horovod_tpu.autotune.config` for the engine
+knobs: one place that resolves what the serving stack will actually
+use — clamps and derived defaults included — without importing jax or
+starting anything.  ``python -m horovod_tpu.run --print-config`` renders
+these rows after the engine table (autotune/config.py pulls
+:data:`SERVE_KNOBS` in), and a live replica's ``stats()["config"]``
+reports the values in force (post serve-autotune).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+__all__ = ["ServeConfig", "resolved_serve_config", "SERVE_KNOBS"]
+
+
+def _int_env(environ, name: str, dflt: int) -> int:
+    raw = environ.get(name)
+    if raw is None or raw == "":
+        return dflt
+    try:
+        return int(raw)
+    except ValueError:
+        return dflt
+
+
+def _pow2_at_least(v: int, lo: int) -> int:
+    out = lo
+    while out < v:
+        out *= 2
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """The resolved serving knobs, all clamped/derived.
+
+    ``block_size`` is forced to a power of two so prompt padding buckets
+    stay block-aligned; ``kv_blocks`` counts allocatable blocks PLUS the
+    reserved trash block is added internally by the pool; ``max_batch``
+    and ``prefill_waves`` are live-tunable (the serve autotuner may
+    rewrite them between steps).
+    """
+
+    model: str = "tiny"
+    dtype: str = ""                 # "" = the model config's own dtype
+    param_seed: int = 0
+    block_size: int = 16
+    kv_blocks: int = 64
+    max_model_len: int = 256
+    max_batch: int = 8
+    prefill_waves: int = 1
+    autotune: int = 0
+    autotune_seed: int = 0
+    autotune_window_steps: int = 32
+    autotune_max_trials: int = 12
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.max_model_len // self.block_size)
+
+    @staticmethod
+    def from_env(environ=os.environ) -> "ServeConfig":
+        block = _pow2_at_least(
+            max(1, _int_env(environ, "HOROVOD_SERVE_BLOCK_SIZE", 16)), 1)
+        # Rounded UP to a block multiple so the engine's pinned physical
+        # cache length IS max_model_len exactly — the documented
+        # bit-reproducibility reference (docs/serving.md).
+        max_len = max(block,
+                      _int_env(environ, "HOROVOD_SERVE_MAX_MODEL_LEN", 256))
+        max_len = block * (-(-max_len // block))
+        # Default pool: enough for max_batch full-length sequences would
+        # defeat admission-control testing; default to half that so the
+        # pool is a real resource, overridable per deployment.
+        max_batch = max(1, _int_env(environ, "HOROVOD_SERVE_MAX_BATCH", 8))
+        blocks_dflt = max(
+            2, (max_batch * (-(-max_len // block)) + 1) // 2)
+        return ServeConfig(
+            model=environ.get("HOROVOD_SERVE_MODEL", "tiny"),
+            dtype=environ.get("HOROVOD_SERVE_DTYPE", ""),
+            param_seed=_int_env(environ, "HOROVOD_SERVE_PARAM_SEED", 0),
+            block_size=block,
+            kv_blocks=max(1, _int_env(environ, "HOROVOD_SERVE_KV_BLOCKS",
+                                      blocks_dflt)),
+            max_model_len=max_len,
+            max_batch=max_batch,
+            prefill_waves=max(1, _int_env(environ,
+                                          "HOROVOD_SERVE_PREFILL_WAVES", 1)),
+            autotune=_int_env(environ, "HOROVOD_SERVE_AUTOTUNE", 0),
+            autotune_seed=_int_env(environ, "HOROVOD_SERVE_AUTOTUNE_SEED",
+                                   0),
+            autotune_window_steps=max(
+                4, _int_env(environ,
+                            "HOROVOD_SERVE_AUTOTUNE_WINDOW_STEPS", 32)),
+            autotune_max_trials=max(
+                1, _int_env(environ,
+                            "HOROVOD_SERVE_AUTOTUNE_MAX_TRIALS", 12)),
+        )
+
+
+#: (env, default-doc, doc) rows for the --print-config table; the
+#: effective value is computed by resolving the whole ServeConfig so
+#: derived defaults (kv_blocks from max_batch/max_model_len) are real.
+SERVE_KNOBS = [
+    ("HOROVOD_SERVE_MODEL", "tiny", "model",
+     "served model config (LlamaConfig.<name>)"),
+    ("HOROVOD_SERVE_DTYPE", "(model default)", "dtype",
+     "activation/cache dtype override (float32|bfloat16)"),
+    ("HOROVOD_SERVE_PARAM_SEED", "0", "param_seed",
+     "deterministic parameter seed — every replica builds identical "
+     "weights from it"),
+    ("HOROVOD_SERVE_BLOCK_SIZE", "16", "block_size",
+     "paged KV-cache block size in tokens (forced to a power of two)"),
+    ("HOROVOD_SERVE_KV_BLOCKS", "auto: max_batch*max_len/2", "kv_blocks",
+     "allocatable KV blocks in the pool (admission control funds "
+     "sequences from it)"),
+    ("HOROVOD_SERVE_MAX_MODEL_LEN", "256", "max_model_len",
+     "hard cap on prompt+generation length per sequence (rounded up to "
+     "a block multiple; also the pinned physical cache length)"),
+    ("HOROVOD_SERVE_MAX_BATCH", "8", "max_batch",
+     "max concurrently decoding sequences (live-tunable)"),
+    ("HOROVOD_SERVE_PREFILL_WAVES", "1", "prefill_waves",
+     "admissions prefilled per scheduler step (live-tunable)"),
+    ("HOROVOD_SERVE_AUTOTUNE", "0", "autotune",
+     "serve-plane knob search scored on tokens/sec windows"),
+    ("HOROVOD_SERVE_AUTOTUNE_SEED", "0", "autotune_seed",
+     "deterministic serve trial-schedule seed"),
+    ("HOROVOD_SERVE_AUTOTUNE_WINDOW_STEPS", "32", "autotune_window_steps",
+     "scheduler steps per serve scoring window"),
+    ("HOROVOD_SERVE_AUTOTUNE_MAX_TRIALS", "12", "autotune_max_trials",
+     "hard cap on serve trials (commits best-so-far at the cap)"),
+]
+
+
+def resolved_serve_config(environ=os.environ) -> List[dict]:
+    """Rows of {env, set, default, effective, doc} for every serve knob —
+    the same row shape autotune/config.py renders."""
+    cfg = ServeConfig.from_env(environ)
+    rows = []
+    for env, dflt, field, doc in SERVE_KNOBS:
+        raw: Optional[str] = environ.get(env)
+        rows.append({
+            "env": env,
+            "set": raw if raw is not None else "",
+            "default": dflt,
+            "effective": str(getattr(cfg, field)),
+            "doc": doc,
+        })
+    return rows

@@ -1,0 +1,171 @@
+"""The replica's model execution engine: jitted paged prefill/decode.
+
+Owns the parameter pytree and the physical KV block pool, and exposes
+two host-level calls the scheduler drives:
+
+* ``prefill(prompt, table)`` — one sequence's prompt through the model
+  in a single batched pass, K/V scattered into its funded blocks;
+  returns the last-position logits.
+* ``decode(tokens, tables, pos)`` — one token for every running
+  sequence in a single batched step over the paged pool.
+
+Static shapes via power-of-two padding buckets (prompt length for
+prefill, batch width for decode), so each bucket compiles once; padded
+batch rows point at the trash block and their outputs are discarded on
+the host.  Every forward attends a physical cache of exactly
+``max_blocks_per_seq * block_size`` slots — logits depend bitwise on
+that length AND on eager-vs-jit program structure, so pinning it makes
+serve streams bit-identical to offline ``jax.jit(generate)`` at
+``cache_len=max_model_len`` regardless of batch composition
+(``tests/test_serve.py`` pins paged ≡ contiguous and serve ≡ offline).
+
+Parameters are built deterministically from ``HOROVOD_SERVE_PARAM_SEED``
+so every replica serves identical weights without shipping a checkpoint
+(a checkpointed deployment would load the same pytree via
+``horovod_tpu.flax.checkpoint`` instead — docs/serving.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Sequence
+
+import numpy as np
+
+from horovod_tpu.serve.config import ServeConfig, _pow2_at_least
+from horovod_tpu.serve.kv_cache import TRASH_BLOCK
+
+__all__ = ["ModelRunner", "build_model_config"]
+
+
+def build_model_config(serve_cfg: ServeConfig):
+    """Resolve HOROVOD_SERVE_MODEL/_DTYPE into a LlamaConfig."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.llama import LlamaConfig
+
+    builder = getattr(LlamaConfig, serve_cfg.model, None)
+    if builder is None:
+        raise ValueError(f"unknown serve model {serve_cfg.model!r} "
+                         "(no LlamaConfig builder of that name)")
+    cfg = builder()
+    if serve_cfg.dtype:
+        dt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}.get(
+            serve_cfg.dtype)
+        if dt is None:
+            raise ValueError(f"unsupported HOROVOD_SERVE_DTYPE "
+                             f"{serve_cfg.dtype!r}")
+        cfg = dataclasses.replace(cfg, dtype=dt, logits_dtype=dt)
+    return cfg
+
+
+class ModelRunner:
+    """Jitted paged-KV model execution for one replica."""
+
+    def __init__(self, serve_cfg: ServeConfig):
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.llama import LlamaModel
+
+        self._jax, self._jnp = jax, jnp
+        self.serve_cfg = serve_cfg
+        self.model_cfg = build_model_config(serve_cfg)
+        mcfg = self.model_cfg
+        model = LlamaModel(mcfg)
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        self.variables = model.init(jax.random.key(serve_cfg.param_seed),
+                                    dummy)
+        self.block_size = serve_cfg.block_size
+        self.max_blocks_per_seq = serve_cfg.max_blocks_per_seq
+        #: pool blocks INCLUDING the reserved trash block 0
+        self.num_blocks = serve_cfg.kv_blocks + 1
+        shape = (mcfg.num_layers, self.num_blocks, self.block_size,
+                 mcfg.num_kv_heads, mcfg.head_dim)
+        self.pool_k = jnp.zeros(shape, mcfg.dtype)
+        self.pool_v = jnp.zeros(shape, mcfg.dtype)
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fns: Dict[int, object] = {}
+        self.compilations = 0
+
+    # -- jit caches --
+
+    def _prefill_fn(self, s_pad: int):
+        fn = self._prefill_fns.get(s_pad)
+        if fn is None:
+            from horovod_tpu.models.generation import paged_prefill
+
+            # Physical cache length is pinned to the decode geometry
+            # (max_blocks_per_seq * block_size) so prefill and every
+            # decode step attend the same reduction shape — the
+            # bit-reproducibility contract (see paged_prefill).
+            cache_len = self.max_blocks_per_seq * self.block_size
+
+            def impl(variables, pool_k, pool_v, prompt, table, prompt_len):
+                return paged_prefill(self.model_cfg, variables, prompt,
+                                     pool_k, pool_v, table,
+                                     prompt_len=prompt_len,
+                                     cache_len=cache_len)
+
+            fn = self._jax.jit(impl, donate_argnums=(1, 2))
+            self._prefill_fns[s_pad] = fn
+            self.compilations += 1
+        return fn
+
+    def _decode_fn(self, b_pad: int):
+        fn = self._decode_fns.get(b_pad)
+        if fn is None:
+            from horovod_tpu.models.generation import paged_decode_step
+
+            def impl(variables, pool_k, pool_v, tokens, tables, pos):
+                return paged_decode_step(self.model_cfg, variables, tokens,
+                                         pool_k, pool_v, tables, pos)
+
+            fn = self._jax.jit(impl, donate_argnums=(1, 2))
+            self._decode_fns[b_pad] = fn
+            self.compilations += 1
+        return fn
+
+    # -- host API --
+
+    def prefill(self, prompt: Sequence[int],
+                table: Sequence[int]) -> np.ndarray:
+        """Prompt (len S0 >= 1) through the model; ``table`` must fund
+        ceil(S0/block_size) blocks.  Returns fp32 last-position logits
+        [V]."""
+        jnp = self._jnp
+        s0 = len(prompt)
+        # Pow2 bucket for few compiles, clipped to the pinned physical
+        # cache length (always a block multiple >= any legal prompt).
+        s_pad = min(_pow2_at_least(s0, self.block_size),
+                    self.max_blocks_per_seq * self.block_size)
+        prompt_pad = np.zeros((1, s_pad), np.int32)
+        prompt_pad[0, :s0] = np.asarray(prompt, np.int32)
+        tbl = np.full((self.max_blocks_per_seq,), TRASH_BLOCK, np.int32)
+        tbl[:len(table)] = np.asarray(table, np.int32)
+        fn = self._prefill_fn(s_pad)
+        logits, self.pool_k, self.pool_v = fn(
+            self.variables, self.pool_k, self.pool_v,
+            jnp.asarray(prompt_pad), jnp.asarray(tbl), s0)
+        return np.asarray(logits[0]).astype(np.float32)
+
+    def decode(self, tokens: Sequence[int], tables: Sequence[np.ndarray],
+               pos: Sequence[int]) -> np.ndarray:
+        """One token per running sequence; ``tables[i]`` is a
+        [max_blocks_per_seq] int32 array.  Returns fp32 logits [B, V]."""
+        jnp = self._jnp
+        b = len(tokens)
+        b_pad = _pow2_at_least(b, 1)
+        toks = np.zeros((b_pad,), np.int32)
+        toks[:b] = np.asarray(tokens, np.int32)
+        tbls = np.full((b_pad, self.max_blocks_per_seq), TRASH_BLOCK,
+                       np.int32)
+        for i, t in enumerate(tables):
+            tbls[i] = t
+        ps = np.zeros((b_pad,), np.int32)
+        ps[:b] = np.asarray(pos, np.int32)
+        fn = self._decode_fn(b_pad)
+        logits, self.pool_k, self.pool_v = fn(
+            self.variables, self.pool_k, self.pool_v, jnp.asarray(toks),
+            jnp.asarray(tbls), jnp.asarray(ps))
+        return np.asarray(logits[:b]).astype(np.float32)

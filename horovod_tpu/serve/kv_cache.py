@@ -1,0 +1,149 @@
+"""Paged KV-cache block accounting (the vLLM PagedAttention insight).
+
+The physical cache is a pool of ``num_blocks`` fixed-size blocks; a
+sequence owns a *block table* — the ordered list of physical block ids
+covering its logical positions.  This module is the pure-Python
+bookkeeping side: funding decisions (admission control), per-token
+growth, recycling on completion/eviction.  The tensors themselves live
+in :mod:`horovod_tpu.serve.engine`, and the block-table decode math in
+``models/generation.py`` (``paged_decode_step`` / ``paged_prefill``).
+
+Physical block id 0 is reserved as the TRASH block: padded batch rows
+and unfunded table entries point at it, so the jitted scatter/gather
+always has a valid target without the allocator ever handing it out.
+Every refusal leaves the allocator untouched — a sequence that cannot
+be funded *now* simply waits (or is preempted back to the queue), it is
+never half-funded.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List
+
+import numpy as np
+
+__all__ = ["PagedKVCache", "TRASH_BLOCK"]
+
+#: Reserved physical block id — never allocated, written only by padded
+#: rows, never read by a live sequence.
+TRASH_BLOCK = 0
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` cache slots."""
+    return -(-int(n_tokens) // int(block_size))
+
+
+class PagedKVCache:
+    """Block allocator + per-sequence block tables.
+
+    ``num_blocks`` counts the whole pool INCLUDING the trash block, so
+    ``capacity_blocks = num_blocks - 1`` are allocatable.  All methods
+    are O(blocks touched); none raise on refusal — they return False and
+    leave state unchanged, which is what admission control keys off.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 max_blocks_per_seq: int):
+        if num_blocks < 2:
+            raise ValueError("need at least one allocatable block "
+                             "besides the trash block")
+        if block_size < 1:
+            raise ValueError("block_size must be positive")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self._free: deque[int] = deque(range(1, self.num_blocks))
+        self._tables: Dict[int, List[int]] = {}
+        # Cumulative recycling counters (serve stats).
+        self.allocated_blocks_total = 0
+        self.freed_blocks_total = 0
+
+    # -- capacity --
+
+    @property
+    def capacity_blocks(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.capacity_blocks - len(self._free)
+
+    def fits_model(self, n_tokens: int) -> bool:
+        """Whether a sequence of ``n_tokens`` total positions can EVER be
+        funded (table width + pool size) — False means reject the
+        request outright, not queue it."""
+        need = blocks_for(n_tokens, self.block_size)
+        return need <= min(self.max_blocks_per_seq, self.capacity_blocks)
+
+    def can_fund(self, n_tokens: int) -> bool:
+        """Whether ``n_tokens`` cache slots are fundable right now."""
+        return blocks_for(n_tokens, self.block_size) <= len(self._free)
+
+    # -- lifecycle --
+
+    def allocate(self, seq_id: int, n_tokens: int) -> bool:
+        """Fund a new sequence with blocks for ``n_tokens`` slots.
+        All-or-nothing: False (state unchanged) when the pool can't
+        cover it."""
+        if seq_id in self._tables:
+            raise KeyError(f"sequence {seq_id} already funded")
+        need = blocks_for(n_tokens, self.block_size)
+        if need > self.max_blocks_per_seq or need > len(self._free):
+            return False
+        self._tables[seq_id] = [self._free.popleft() for _ in range(need)]
+        self.allocated_blocks_total += need
+        return True
+
+    def append_slot(self, seq_id: int, n_tokens: int) -> bool:
+        """Ensure the table covers ``n_tokens`` slots (one decode step =
+        one more slot).  Allocates at most one block; False when the pool
+        is exhausted or the table is at ``max_blocks_per_seq``."""
+        table = self._tables[seq_id]
+        need = blocks_for(n_tokens, self.block_size)
+        if need <= len(table):
+            return True
+        if need > self.max_blocks_per_seq or not self._free:
+            return False
+        table.append(self._free.popleft())
+        self.allocated_blocks_total += 1
+        return True
+
+    def free(self, seq_id: int) -> int:
+        """Recycle a sequence's blocks (completion or eviction); returns
+        how many went back to the pool."""
+        table = self._tables.pop(seq_id)
+        self._free.extend(table)
+        self.freed_blocks_total += len(table)
+        return len(table)
+
+    # -- views --
+
+    def table(self, seq_id: int) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def table_array(self, seq_id: int, width: int) -> np.ndarray:
+        """The block table padded to ``width`` with the trash block —
+        the shape the jitted decode consumes."""
+        table = self._tables[seq_id]
+        if len(table) > width:
+            raise ValueError(f"table wider than {width}")
+        out = np.full((width,), TRASH_BLOCK, dtype=np.int32)
+        out[:len(table)] = table
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "kv_blocks_total": self.capacity_blocks,
+            "kv_blocks_in_use": self.blocks_in_use,
+            "kv_blocks_free": self.free_blocks,
+            "kv_block_size": self.block_size,
+            "kv_blocks_allocated_total": self.allocated_blocks_total,
+            "kv_blocks_freed_total": self.freed_blocks_total,
+            "kv_sequences": len(self._tables),
+        }

@@ -1,0 +1,483 @@
+"""Multi-replica routing: least-loaded dispatch + requeue-on-death.
+
+The router owns a fleet of replica worlds (one
+``horovod_tpu.serve.replica`` process each — the launcher env decides
+how many engine ranks back each one), speaks the same JSON-lines
+protocol to clients on its front port, and forwards each ``generate``
+to the live replica with the fewest outstanding requests.
+
+Failure semantics are the serve-plane analogue of the elastic
+shrink/rejoin cycle (docs/elastic.md):
+
+* *shrink* — a replica death (connection loss or process exit) removes
+  it from the routing set; every request it still owed is immediately
+  re-queued onto the survivors.  The client sees a ``requeued`` frame
+  and the token stream restarts at index 0 — the ``done`` frame's
+  ``tokens`` is always the complete output, so **no request is ever
+  dropped**, only re-run (generation is deterministic per request:
+  greedy, or seeded position-stable sampling, so the rerun streams the
+  identical tokens).
+* *rejoin* — the supervisor relaunches the dead replica (scrubbing
+  ``HOROVOD_FAULT_INJECT`` exactly like ``run.py --restart-on-failure``)
+  up to the restart budget; once it prints READY and reconnects it
+  rejoins the routing set and starts taking new load.
+
+With every replica down and no budget left, queued requests fail with a
+clean error — the router never hangs a client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import sys
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["Router", "serve_main"]
+
+_READY_RE = re.compile(rb"SERVE_REPLICA_READY port=(\d+)")
+
+
+class _Replica:
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.port: Optional[int] = None
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
+        self.pending: Dict[str, "_ClientConn"] = {}
+        self.alive = False
+        #: set by the supervisor once this replica can NEVER come back
+        #: (clean exit, budget exhausted, or relaunch failed) — the
+        #: router's queue-parking hope is "any replica not terminal".
+        self.terminal = False
+        self.stats_waiter: Optional[asyncio.Future] = None
+        # Serializes stats exchanges: concurrent clients must not
+        # clobber each other's waiter future.
+        self.stats_lock = asyncio.Lock()
+
+
+class _ClientConn:
+    _next_id = 0
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        _ClientConn._next_id += 1
+        self.cid = _ClientConn._next_id
+        self.writer = writer
+        self.outbox: asyncio.Queue = asyncio.Queue()
+        self.live: Dict[str, str] = {}   # internal rid -> client rid
+
+    def emit(self, ev: dict) -> None:
+        self.outbox.put_nowait(ev)
+
+
+class Router:
+    def __init__(self, *, num_replicas: int, restart_budget: int = 0,
+                 relaunch_delay: float = 0.0, host: str = "127.0.0.1",
+                 port: int = 0, replica_env: Optional[dict] = None):
+        self.num_replicas = num_replicas
+        self.restart_budget = restart_budget
+        self.relaunch_delay = relaunch_delay
+        self.host, self.port = host, port
+        self.replica_env = dict(replica_env or {})
+        self.replicas: List[_Replica] = [_Replica(i)
+                                         for i in range(num_replicas)]
+        self._reqs: Dict[str, dict] = {}    # internal rid -> request frame
+        self._owners: Dict[str, _ClientConn] = {}
+        self._queue: deque[str] = deque()   # awaiting a live replica
+        self._restarts_left = restart_budget
+        self._next_rid = 0
+        self._shutdown = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self.counters = {
+            "dispatched": 0, "completed": 0, "requeued": 0,
+            "replica_deaths": 0, "rejoins": 0, "failed": 0,
+            "cancelled": 0,
+        }
+
+    # -- replica lifecycle --
+
+    async def _spawn(self, rep: _Replica, scrub_fault: bool) -> None:
+        env = dict(os.environ)
+        env.update(self.replica_env)
+        env["HOROVOD_REPLICA_ID"] = str(rep.idx)
+        # The replica must import this exact package even when the
+        # launcher was started outside the repo / without installation.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH",
+                                                            "")
+        if scrub_fault:
+            # A relaunched incarnation must not re-fire the injected
+            # fault (same contract as run.py --restart-on-failure).
+            env.pop("HOROVOD_FAULT_INJECT", None)
+        rep.proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "horovod_tpu.serve.replica", "--port", "0",
+            env=env, stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT)
+        # Pump output; the READY line carries the ephemeral port.
+        ready = asyncio.get_running_loop().create_future()
+
+        async def pump(proc=rep.proc) -> None:
+            async for line in proc.stdout:
+                m = _READY_RE.search(line)
+                if m and not ready.done():
+                    ready.set_result(int(m.group(1)))
+                sys.stdout.write(f"[replica {rep.idx}] "
+                                 f"{line.decode(errors='replace')}")
+                sys.stdout.flush()
+            if not ready.done():
+                ready.set_exception(
+                    RuntimeError(f"replica {rep.idx} exited before READY"))
+
+        self._tasks.append(asyncio.ensure_future(pump()))
+        rep.port = await asyncio.wait_for(ready, timeout=300)
+        for attempt in range(50):
+            try:
+                rep.reader, rep.writer = await asyncio.open_connection(
+                    "127.0.0.1", rep.port)
+                break
+            except OSError:
+                await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError(f"cannot connect to replica {rep.idx}")
+        rep.alive = True
+        self._tasks.append(asyncio.ensure_future(self._replica_reader(rep)))
+        self._tasks.append(asyncio.ensure_future(self._supervise(rep)))
+
+    async def _supervise(self, rep: _Replica) -> None:
+        proc = rep.proc
+        rc = await proc.wait()
+        if self._shutdown.is_set():
+            return
+        self._on_replica_down(rep)
+        if rc == 0 or self._restarts_left <= 0:
+            rep.terminal = True
+            self._fail_queue_if_hopeless()
+            return
+        self._restarts_left -= 1
+        sys.stderr.write(
+            f"replica {rep.idx} exited with code {rc}; relaunching "
+            f"({self._restarts_left} restarts left)\n")
+        sys.stderr.flush()
+        if self.relaunch_delay > 0:
+            await asyncio.sleep(self.relaunch_delay)
+        try:
+            await self._spawn(rep, scrub_fault=True)
+        except (RuntimeError, OSError, asyncio.TimeoutError) as e:
+            if not self._shutdown.is_set():   # not noise mid-teardown
+                sys.stderr.write(f"replica {rep.idx} relaunch "
+                                 f"failed: {e}\n")
+                rep.terminal = True
+                self._fail_queue_if_hopeless()
+            return
+        self.counters["rejoins"] += 1
+        self._drain_queue()
+
+    def _fail_queue_if_hopeless(self) -> None:
+        """Error out parked requests once no replica can ever serve them
+        — the no-hang guarantee.  Hope is "some replica is not
+        terminal": its supervisor has not yet concluded (it may still
+        relaunch with remaining budget), or it is alive.  Leftover
+        budget with every supervisor concluded is NOT hope — nothing
+        will ever spend it (a clean rc-0 exit, budget exhaustion, or a
+        failed relaunch ends a supervisor for good)."""
+        if any(not r.terminal for r in self.replicas):
+            return
+        for rid in list(self._queue):
+            self._queue.remove(rid)
+            client = self._owners.get(rid)
+            if client is not None:
+                self.counters["failed"] += 1
+                client.emit({"event": "error", "id": client.live.get(rid),
+                             "error": "no live replica and no restart "
+                                      "budget left"})
+            self._forget(rid)
+
+    def _on_replica_down(self, rep: _Replica) -> None:
+        if not rep.alive:
+            return
+        rep.alive = False
+        self.counters["replica_deaths"] += 1
+        if rep.writer is not None:
+            try:
+                rep.writer.close()
+            except OSError:
+                pass
+        if rep.stats_waiter is not None and not rep.stats_waiter.done():
+            rep.stats_waiter.set_result(None)
+        orphans = list(rep.pending)
+        rep.pending.clear()
+        for rid in orphans:
+            client = self._owners.get(rid)
+            if client is None:
+                continue
+            self.counters["requeued"] += 1
+            client.emit({"event": "requeued", "id": client.live.get(rid)})
+            self._dispatch(rid)
+
+    async def _replica_reader(self, rep: _Replica) -> None:
+        try:
+            while True:
+                line = await rep.reader.readline()
+                if not line:
+                    break
+                ev = json.loads(line)
+                if ev.get("event") == "stats":
+                    if rep.stats_waiter is not None \
+                            and not rep.stats_waiter.done():
+                        rep.stats_waiter.set_result(ev["stats"])
+                    continue
+                rid = ev.get("id")
+                client = self._owners.get(rid)
+                if client is None:
+                    continue   # cancelled/disconnected client
+                ev["id"] = client.live.get(rid)
+                if ev["event"] in ("done", "error", "cancelled"):
+                    rep.pending.pop(rid, None)
+                    self._forget(rid)
+                    self.counters[{"done": "completed",
+                                   "error": "failed",
+                                   "cancelled": "cancelled"}
+                                  [ev["event"]]] += 1
+                client.emit(ev)
+        except (ConnectionResetError, json.JSONDecodeError, OSError):
+            pass
+        self._on_replica_down(rep)
+
+    # -- dispatch --
+
+    def _forget(self, rid: str) -> None:
+        self._reqs.pop(rid, None)
+        client = self._owners.pop(rid, None)
+        if client is not None:
+            client.live.pop(rid, None)
+
+    def _pick(self) -> Optional[_Replica]:
+        live = [r for r in self.replicas if r.alive]
+        if not live:
+            return None
+        return min(live, key=lambda r: (len(r.pending), r.idx))
+
+    def _dispatch(self, rid: str) -> None:
+        rep = self._pick()
+        if rep is None:
+            # Park only while some replica is not terminal (its
+            # supervisor may still relaunch it) — see
+            # _fail_queue_if_hopeless.
+            if any(not r.terminal for r in self.replicas):
+                self._queue.append(rid)   # a rejoin may still come
+            else:
+                client = self._owners.get(rid)
+                if client is not None:
+                    self.counters["failed"] += 1
+                    client.emit({"event": "error",
+                                 "id": client.live.get(rid),
+                                 "error": "no live replica and no restart "
+                                          "budget left"})
+                self._forget(rid)
+            return
+        frame = dict(self._reqs[rid])
+        frame["id"] = rid
+        rep.pending[rid] = self._owners[rid]
+        try:
+            rep.writer.write((json.dumps(frame) + "\n").encode())
+        except (ConnectionResetError, OSError):
+            self._on_replica_down(rep)
+
+    def _drain_queue(self) -> None:
+        pending = list(self._queue)
+        self._queue.clear()
+        for rid in pending:
+            self._dispatch(rid)
+
+    # -- client side --
+
+    async def _handle_client(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        client = _ClientConn(writer)
+
+        async def write_loop() -> None:
+            while True:
+                ev = await client.outbox.get()
+                if ev is None:
+                    break
+                writer.write((json.dumps(ev) + "\n").encode())
+                await writer.drain()
+
+        wtask = asyncio.ensure_future(write_loop())
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    client.emit({"event": "error", "id": None,
+                                 "error": "malformed frame"})
+                    continue
+                op = msg.get("op")
+                if op == "generate":
+                    self._next_rid += 1
+                    rid = f"q{client.cid}.{self._next_rid}"
+                    self._reqs[rid] = {k: msg[k] for k in
+                                       ("prompt", "max_tokens")
+                                       if k in msg}
+                    self._reqs[rid]["op"] = "generate"
+                    for k in ("temperature", "seed"):
+                        if k in msg:
+                            self._reqs[rid][k] = msg[k]
+                    self._owners[rid] = client
+                    client.live[rid] = str(msg.get("id", rid))
+                    self.counters["dispatched"] += 1
+                    self._dispatch(rid)
+                elif op == "cancel":
+                    want = str(msg.get("id", ""))
+                    for rid, crid in list(client.live.items()):
+                        if crid != want:
+                            continue
+                        for rep in self.replicas:
+                            if rid in rep.pending and rep.alive:
+                                rep.writer.write((json.dumps(
+                                    {"op": "cancel", "id": rid})
+                                    + "\n").encode())
+                        if rid in self._queue:
+                            self._queue.remove(rid)
+                            client.emit({"event": "cancelled", "id": want})
+                            self._forget(rid)
+                elif op == "stats":
+                    client.emit({"event": "stats",
+                                 "stats": await self._gather_stats()})
+                elif op == "ping":
+                    client.emit({"event": "pong"})
+                elif op == "shutdown":
+                    client.emit({"event": "bye"})
+                    self._shutdown.set()
+                    break
+                else:
+                    client.emit({"event": "error", "id": None,
+                                 "error": f"unknown op {op!r}"})
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            for rid in list(client.live):
+                for rep in self.replicas:
+                    if rid in rep.pending and rep.alive:
+                        try:
+                            rep.writer.write((json.dumps(
+                                {"op": "cancel", "id": rid}) + "\n")
+                                .encode())
+                        except OSError:
+                            pass
+                        rep.pending.pop(rid, None)
+                if rid in self._queue:
+                    self._queue.remove(rid)
+                self._forget(rid)
+            client.outbox.put_nowait(None)
+            try:
+                await asyncio.wait_for(wtask, timeout=5)
+            except (asyncio.TimeoutError, ConnectionResetError,
+                    BrokenPipeError):
+                wtask.cancel()
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+    async def _gather_stats(self) -> dict:
+        out = {"router": dict(self.counters)}
+        out["router"]["queue_depth"] = len(self._queue)
+        out["router"]["restarts_left"] = self._restarts_left
+        reps = []
+        for rep in self.replicas:
+            entry = {"replica": rep.idx, "alive": rep.alive,
+                     "pending": len(rep.pending)}
+            if rep.alive:
+                async with rep.stats_lock:
+                    rep.stats_waiter = asyncio.get_running_loop() \
+                        .create_future()
+                    try:
+                        rep.writer.write(b'{"op": "stats"}\n')
+                        stats = await asyncio.wait_for(rep.stats_waiter,
+                                                       timeout=10)
+                        if stats is not None:
+                            entry["scheduler"] = stats
+                    except (asyncio.TimeoutError, OSError):
+                        pass
+                    finally:
+                        rep.stats_waiter = None
+            reps.append(entry)
+        out["replicas"] = reps
+        return out
+
+    # -- entry --
+
+    async def run(self) -> int:
+        t0 = time.monotonic()
+        try:
+            await asyncio.gather(*[self._spawn(rep, scrub_fault=False)
+                                   for rep in self.replicas])
+        except BaseException:
+            # Partial fleet startup must not leak the replicas that DID
+            # launch (the gate checks for exactly this).
+            for rep in self.replicas:
+                if rep.proc is not None and rep.proc.returncode is None:
+                    rep.proc.kill()
+                    await rep.proc.wait()
+            raise
+        server = await asyncio.start_server(self._handle_client, self.host,
+                                            self.port)
+        port = server.sockets[0].getsockname()[1]
+        print(f"SERVE_ROUTER_READY port={port} replicas="
+              f"{self.num_replicas} startup_sec="
+              f"{time.monotonic() - t0:.1f}", flush=True)
+        await self._shutdown.wait()
+        server.close()
+        await server.wait_closed()
+        # Clean teardown: polite shutdown frame, then terminate/kill.
+        for rep in self.replicas:
+            if rep.alive and rep.writer is not None:
+                try:
+                    rep.writer.write(b'{"op": "shutdown"}\n')
+                except OSError:
+                    pass
+        for rep in self.replicas:
+            if rep.proc is None or rep.proc.returncode is not None:
+                continue
+            try:
+                await asyncio.wait_for(rep.proc.wait(), timeout=10)
+            except asyncio.TimeoutError:
+                rep.proc.terminate()
+                try:
+                    await asyncio.wait_for(rep.proc.wait(), timeout=5)
+                except asyncio.TimeoutError:
+                    rep.proc.kill()
+                    await rep.proc.wait()
+        for task in self._tasks:
+            if not task.done():
+                task.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        return 0
+
+
+def serve_main(args) -> int:
+    """The ``run.py --serve`` entry: router + replica fleet."""
+    replica_env = {}
+    if getattr(args, "serve_model", None):
+        replica_env["HOROVOD_SERVE_MODEL"] = args.serve_model
+    router = Router(
+        num_replicas=max(1, args.replicas),
+        restart_budget=max(0, args.restart_on_failure),
+        relaunch_delay=max(0.0, args.relaunch_delay_sec),
+        port=args.serve_port,
+        replica_env=replica_env)
+    try:
+        return asyncio.run(router.run())
+    except KeyboardInterrupt:
+        return 130

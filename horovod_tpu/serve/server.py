@@ -1,0 +1,298 @@
+"""Async request front-end: newline-delimited JSON over TCP.
+
+One protocol serves both tiers — a client talking to the router and the
+router talking to a replica speak the same frames, so a single replica
+can also be driven directly (no router) for tests and benchmarks.
+
+Requests (one JSON object per line)::
+
+    {"op": "generate", "id": "r1", "prompt": [1,2,3], "max_tokens": 8,
+     "temperature": 0.0, "seed": 0}
+    {"op": "cancel", "id": "r1"}
+    {"op": "stats"}
+    {"op": "ping"}
+    {"op": "shutdown"}
+
+Streamed responses (interleaved across in-flight requests)::
+
+    {"event": "token", "id": "r1", "token": 42, "index": 0}
+    {"event": "done", "id": "r1", "tokens": [...], "preemptions": 0}
+    {"event": "error", "id": "r1", "error": "..."}
+    {"event": "cancelled", "id": "r1"}
+    {"event": "requeued", "id": "r1"}   # router only: stream restarts
+    {"event": "stats", "stats": {...}}
+    {"event": "pong"}
+
+Tokens stream as they are produced by the continuous-batching scheduler;
+after a replica death the router re-queues the request and the token
+stream RESTARTS at index 0 on a survivor — the ``done`` frame's
+``tokens`` list is always the complete, authoritative output.
+
+A small blocking :class:`ServeClient` (reader-thread + per-request
+queues) is included for tests and simple callers; the open-loop
+benchmark drives the asyncio side directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional
+
+from horovod_tpu.serve.scheduler import Request, Scheduler
+
+__all__ = ["ReplicaServer", "ServeClient"]
+
+
+class ReplicaServer:
+    """Serves one Scheduler over asyncio TCP (JSON lines)."""
+
+    def __init__(self, scheduler: Scheduler):
+        self.scheduler = scheduler
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port: Optional[int] = None
+        self._shutdown = asyncio.Event()
+        self._conns: set = set()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def serve_until_shutdown(self) -> None:
+        """Run until a ``shutdown`` frame (or :meth:`shutdown`)."""
+        await self._shutdown.wait()
+        self._server.close()
+        await self._server.wait_closed()
+        # Nudge lingering connections so their handler tasks can finish
+        # before the loop goes away (quiet teardown in test harnesses).
+        for writer in list(self._conns):
+            try:
+                writer.close()
+            except OSError:
+                pass
+        await asyncio.sleep(0)
+        self.scheduler.stop()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        loop = asyncio.get_running_loop()
+        self._conns.add(writer)
+        outbox: asyncio.Queue = asyncio.Queue()
+        live: set = set()
+
+        def emit_threadsafe(rid: str) -> Callable[[dict], None]:
+            def emit(ev: dict) -> None:
+                if ev["event"] in ("done", "error", "cancelled"):
+                    live.discard(rid)
+                try:
+                    loop.call_soon_threadsafe(outbox.put_nowait, ev)
+                except RuntimeError:
+                    # Loop already torn down (shutdown drain racing the
+                    # scheduler thread) — the client saw EOF anyway.
+                    pass
+            return emit
+
+        async def write_loop() -> None:
+            while True:
+                ev = await outbox.get()
+                if ev is None:
+                    break
+                writer.write((json.dumps(ev) + "\n").encode())
+                await writer.drain()
+
+        wtask = asyncio.ensure_future(write_loop())
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    outbox.put_nowait({"event": "error", "id": None,
+                                       "error": "malformed frame"})
+                    continue
+                op = msg.get("op")
+                if op == "generate":
+                    rid = str(msg.get("id", ""))
+                    try:
+                        req = Request(
+                            id=rid,
+                            prompt=[int(t) for t in msg["prompt"]],
+                            max_tokens=int(msg["max_tokens"]),
+                            temperature=float(msg.get("temperature", 0.0)),
+                            seed=int(msg.get("seed", 0)))
+                    except (KeyError, TypeError, ValueError) as e:
+                        outbox.put_nowait({"event": "error", "id": rid,
+                                           "error": f"bad request: {e}"})
+                        continue
+                    live.add(rid)
+                    self.scheduler.submit(req, emit_threadsafe(rid))
+                elif op == "cancel":
+                    self.scheduler.cancel(str(msg.get("id", "")))
+                elif op == "stats":
+                    outbox.put_nowait({"event": "stats",
+                                       "stats": self.scheduler.stats()})
+                elif op == "ping":
+                    outbox.put_nowait({"event": "pong"})
+                elif op == "shutdown":
+                    outbox.put_nowait({"event": "bye"})
+                    self.shutdown()
+                    break
+                else:
+                    outbox.put_nowait({"event": "error", "id": None,
+                                       "error": f"unknown op {op!r}"})
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            # A vanished client must not keep burning pool blocks.
+            for rid in list(live):
+                self.scheduler.cancel(rid)
+            outbox.put_nowait(None)
+            try:
+                await asyncio.wait_for(wtask, timeout=5)
+            except (asyncio.TimeoutError, ConnectionResetError,
+                    BrokenPipeError):
+                wtask.cancel()
+            self._conns.discard(writer)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+
+class ServeClient:
+    """Blocking JSON-lines client (tests / simple callers).
+
+    A reader thread fans events out to per-request queues;
+    :meth:`generate` blocks until the ``done`` frame and returns the
+    full event list.  Concurrent generates from different threads are
+    fine — the socket write side is lock-guarded.
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        self.timeout = timeout
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._file = self._sock.makefile("rb")
+        self._wlock = threading.Lock()
+        self._qlock = threading.Lock()
+        self._queues: Dict[str, deque] = {}
+        self._events: Dict[str, threading.Event] = {}
+        self._plain: deque = deque()         # events with no request id
+        self._plain_ev = threading.Event()
+        self._dead = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            for line in iter(self._file.readline, b""):
+                ev = json.loads(line)
+                # Client-side receive timestamp: what latency benchmarks
+                # (bench_serve.py TTFT/p99) measure from.
+                ev["_recv_ts"] = time.monotonic()
+                rid = ev.get("id")
+                if rid is not None and rid in self._queues:
+                    with self._qlock:
+                        self._queues[rid].append(ev)
+                        self._events[rid].set()
+                else:
+                    self._plain.append(ev)
+                    self._plain_ev.set()
+        except (OSError, ValueError):
+            pass
+        self._dead = True
+        with self._qlock:
+            for ev in self._events.values():
+                ev.set()
+        self._plain_ev.set()
+
+    def _send(self, msg: dict) -> None:
+        with self._wlock:
+            self._sock.sendall((json.dumps(msg) + "\n").encode())
+
+    def start_generate(self, rid: str, prompt, max_tokens: int,
+                       temperature: float = 0.0, seed: int = 0) -> None:
+        with self._qlock:
+            self._queues[rid] = deque()
+            self._events[rid] = threading.Event()
+        self._send({"op": "generate", "id": rid, "prompt": list(prompt),
+                    "max_tokens": max_tokens, "temperature": temperature,
+                    "seed": seed})
+
+    def collect(self, rid: str, timeout: Optional[float] = None) -> list:
+        """Block until the request finishes; returns every event for it
+        (token stream incl. any requeue restarts, then done/error)."""
+        deadline = time.monotonic() + (timeout or self.timeout)
+        out = []
+        while True:
+            with self._qlock:
+                q = self._queues[rid]
+                ev = q.popleft() if q else None
+                if not q:
+                    self._events[rid].clear()
+            if ev is not None:
+                out.append(ev)
+                if ev["event"] in ("done", "error", "cancelled"):
+                    with self._qlock:
+                        del self._queues[rid], self._events[rid]
+                    return out
+                continue
+            if self._dead:
+                raise ConnectionError("server connection lost")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"request {rid} did not finish")
+            self._events[rid].wait(timeout=min(remaining, 1.0))
+
+    def generate(self, rid: str, prompt, max_tokens: int,
+                 temperature: float = 0.0, seed: int = 0,
+                 timeout: Optional[float] = None) -> list:
+        self.start_generate(rid, prompt, max_tokens, temperature, seed)
+        return self.collect(rid, timeout=timeout)
+
+    def _plain_request(self, op: str, want_event: str,
+                       timeout: float = 30.0) -> dict:
+        self._send({"op": op})
+        deadline = time.monotonic() + timeout
+        while True:
+            while self._plain:
+                ev = self._plain.popleft()
+                if ev.get("event") == want_event:
+                    return ev
+            if self._dead:
+                raise ConnectionError("server connection lost")
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"no {want_event} reply")
+            self._plain_ev.wait(timeout=0.5)
+            self._plain_ev.clear()
+
+    def stats(self) -> dict:
+        return self._plain_request("stats", "stats")["stats"]
+
+    def ping(self) -> None:
+        self._plain_request("ping", "pong")
+
+    def shutdown(self) -> None:
+        try:
+            self._send({"op": "shutdown"})
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        # makefile() dup'd the fd: both must close or the server never
+        # sees EOF (and never cancels this client's in-flight work).
+        for closer in (self._file.close, self._sock.close):
+            try:
+                closer()
+            except OSError:
+                pass

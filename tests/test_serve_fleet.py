@@ -1,0 +1,206 @@
+"""Multi-replica serving fleet: routing, replica death, requeue.
+
+Spawns the real ``run.py --serve`` stack (router process + replica
+subprocesses) and drives it over TCP.  The fault test kills one replica
+mid-stream via the engine's ``HOROVOD_FAULT_INJECT`` schedule format
+(replica index standing in for the rank) and asserts the router's
+shrink/rejoin semantics: every in-flight request is re-queued onto the
+survivor and completes with the full, correct token stream — zero
+requests dropped — while the supervisor relaunches the dead replica.
+"""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from horovod_tpu.models.generation import generate
+from horovod_tpu.serve.config import ServeConfig
+from horovod_tpu.serve.engine import ModelRunner
+from horovod_tpu.serve.server import ServeClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FLEET_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "HOROVOD_SERVE_BLOCK_SIZE": "4",
+    "HOROVOD_SERVE_MAX_MODEL_LEN": "64",
+    "HOROVOD_SERVE_MAX_BATCH": "4",
+}
+
+
+@pytest.fixture(scope="module")
+def offline():
+    """Jitted offline generate over the same weights every replica
+    derives (param seed 0), at the serving cache geometry — the
+    bit-identity reference (see tests/test_serve.py)."""
+    import functools
+
+    import jax
+
+    runner = ModelRunner(ServeConfig.from_env(FLEET_ENV))
+    cache = runner.max_blocks_per_seq * runner.block_size
+    fns = {}
+
+    def gen(prompt, n):
+        if n not in fns:
+            fns[n] = jax.jit(functools.partial(
+                generate, runner.model_cfg, max_new_tokens=n,
+                cache_len=cache))
+        return np.asarray(fns[n](
+            runner.variables,
+            jnp.asarray(np.asarray(prompt, np.int32)[None])))[0]
+
+    return gen
+
+
+class _Fleet:
+    def __init__(self, replicas, restart=0, extra_env=None, delay=0.0):
+        env = dict(os.environ)
+        env.update(FLEET_ENV)
+        env.update(extra_env or {})
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.run", "--serve",
+             "--replicas", str(replicas), "--serve-port", "0",
+             "--restart-on-failure", str(restart),
+             "--relaunch-delay-sec", str(delay)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        self.port = None
+        self.log = []
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            line = self.proc.stdout.readline()
+            if not line:
+                break
+            self.log.append(line)
+            m = re.search(r"SERVE_ROUTER_READY port=(\d+)", line)
+            if m:
+                self.port = int(m.group(1))
+                break
+        assert self.port, "router never became ready:\n" + "".join(self.log)
+        self._drain = threading.Thread(target=self._pump, daemon=True)
+        self._drain.start()
+
+    def _pump(self):
+        for line in iter(self.proc.stdout.readline, ""):
+            self.log.append(line)
+
+    def stop(self, client=None):
+        if client is not None:
+            client.shutdown()
+            try:
+                rc = self.proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                rc = None
+        else:
+            rc = None
+        if rc is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                rc = self.proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                rc = self.proc.wait()
+        self._drain.join(timeout=5)
+        return rc
+
+
+def _run_jobs(cli, prompts, max_tokens):
+    for i, prompt in enumerate(prompts):
+        cli.start_generate(f"job{i}", prompt, max_tokens=max_tokens)
+    results = {}
+    for i in range(len(prompts)):
+        results[f"job{i}"] = cli.collect(f"job{i}", timeout=240)
+    return results
+
+
+@pytest.mark.slow
+def test_two_replica_fleet_serves_and_balances(offline):
+    """2 replicas, 8 concurrent requests: all complete with offline-
+    exact greedy tokens, both replicas take load, clean shutdown.
+
+    ``slow``: the full ci.sh suite runs it; the bounded tier-1 gate gets
+    the same coverage cheaper from the in-process scheduler/protocol
+    tests plus the fault-marked death test below (and ci.sh's serve
+    gate drives the whole fleet again under Poisson load)."""
+    fleet = _Fleet(replicas=2)
+    try:
+        cli = ServeClient("127.0.0.1", fleet.port, timeout=240)
+        rng = np.random.default_rng(11)
+        prompts = [rng.integers(0, 512, int(rng.integers(3, 12))).tolist()
+                   for _ in range(8)]
+        results = _run_jobs(cli, prompts, max_tokens=12)
+        for i, prompt in enumerate(prompts):
+            evs = results[f"job{i}"]
+            assert evs[-1]["event"] == "done", evs[-1]
+            np.testing.assert_array_equal(
+                np.asarray(evs[-1]["tokens"]), offline(prompt, 12))
+        stats = cli.stats()
+        assert stats["router"]["completed"] == 8
+        assert stats["router"]["requeued"] == 0
+        per_replica = [r.get("scheduler", {}).get("requests_completed", 0)
+                       for r in stats["replicas"]]
+        assert all(n > 0 for n in per_replica), \
+            f"load not balanced: {per_replica}"
+        # Continuous batching overlapped on at least one replica
+        assert any(r.get("scheduler", {}).get("batch_occupancy", 0) > 1.0
+                   for r in stats["replicas"])
+        rc = fleet.stop(cli)
+        assert rc == 0, "".join(fleet.log[-20:])
+        cli.close()
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.fault
+def test_replica_death_requeues_all_requests(offline):
+    """Kill replica 1 after 4 decode steps (HOROVOD_FAULT_INJECT
+    schedule): its in-flight requests are re-queued onto replica 0 and
+    EVERY request completes with the exact offline tokens — zero
+    dropped; the supervisor relaunches the dead replica (rejoin)."""
+    fleet = _Fleet(replicas=2, restart=2,
+                   extra_env={"HOROVOD_FAULT_INJECT": "1:4:exit"})
+    try:
+        cli = ServeClient("127.0.0.1", fleet.port, timeout=240)
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(0, 512, int(rng.integers(3, 12))).tolist()
+                   for _ in range(8)]
+        results = _run_jobs(cli, prompts, max_tokens=20)
+        requeued_streams = 0
+        for i, prompt in enumerate(prompts):
+            evs = results[f"job{i}"]
+            assert evs[-1]["event"] == "done", \
+                f"job{i} dropped: {evs[-1]}"
+            assert len(evs[-1]["tokens"]) == 20
+            np.testing.assert_array_equal(
+                np.asarray(evs[-1]["tokens"]), offline(prompt, 20))
+            if any(e["event"] == "requeued" for e in evs):
+                requeued_streams += 1
+                # The restarted stream re-emits from index 0 and its
+                # token events still spell the authoritative output.
+                tail = [e["token"] for e in evs
+                        if e["event"] == "token"][-20:]
+                assert tail == evs[-1]["tokens"]
+        assert requeued_streams > 0, "fault fired but nothing requeued"
+        stats = cli.stats()
+        assert stats["router"]["completed"] == 8
+        assert stats["router"]["requeued"] >= requeued_streams
+        assert stats["router"]["replica_deaths"] == 1
+        # The relaunched replica rejoined (or is mid-relaunch with
+        # budget spent on it) — the supervisor consumed restart budget.
+        assert stats["router"]["restarts_left"] < 2
+        rc = fleet.stop(cli)
+        assert rc == 0, "".join(fleet.log[-20:])
+        cli.close()
+    finally:
+        fleet.stop()
