@@ -97,6 +97,19 @@ PALLAS_AXON_POOL_IPS= timeout -k 15 420 \
     "tests/test_data_plane.py::test_algo_threshold_parity[4]" -q
 PALLAS_AXON_POOL_IPS= timeout -k 15 900 python bench_engine.py --shm-gate
 
+echo "== compression gate (wire dtypes + sparse error feedback, hard timeout) =="
+# Wire-level gradient compression: (1) the fp32-wire DEFAULT must be
+# byte-identical to the pre-compression engine across the full dtype/op
+# parity corpus at 4 ranks; (2) the int8 wire must cut the deterministic
+# data_bytes_tx counter to <= 0.30x (>= 3.3x fewer bytes) on a 16 MB
+# fp32 allreduce — byte counters, never wall time, because the loopback
+# is CPU-ceilinged and noisy; (3) the convergence worker must land int8
+# and top-k(1%)+error-feedback inside their pinned loss bounds and show
+# top-k WITHOUT feedback measurably worse.  The hard timeout is the
+# wedge detector for the quantized ring.
+PALLAS_AXON_POOL_IPS= timeout -k 15 700 \
+    python bench_engine.py --compression-gate
+
 echo "== autotune gate (online knob search vs static grid, hard timeout) =="
 # Online autotuner (HOROVOD_AUTOTUNE=1): the search must converge within
 # HOROVOD_AUTOTUNE_MAX_TRIALS at 2 and 4 ranks, and the committed config's

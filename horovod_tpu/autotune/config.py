@@ -67,6 +67,16 @@ def _wave_width(raw, environ=os.environ):
     return str(_clamp(v, 1, 16))
 
 
+def _sparse_topk(raw):
+    # Mirrors runtime/sparse.default_topk_ratio exactly: float parse
+    # with 0.01 fallback, clamped to [1e-6, 1.0].
+    try:
+        v = float(raw) if raw else 0.01
+    except ValueError:
+        v = 0.01
+    return str(min(1.0, max(1e-6, v)))
+
+
 #: Every performance/robustness knob the engine reads, in the order the
 #: docs table presents them.  Live-tunable knobs (autotune may rewrite
 #: them at runtime) are marked in the doc string.
@@ -106,6 +116,20 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_STALL_WARNING_SEC", "60",
          lambda raw: str(_int_env(raw, 60)),
          "stalled-tensor warning cadence"),
+    Knob("HOROVOD_WIRE_DTYPE", "fp32",
+         lambda raw: raw if raw in ("fp16", "bf16", "int8", "fp8")
+         else "fp32",
+         "wire format for fp32 allreduce payloads: fp32 is byte-exact; "
+         "fp16/bf16 halve wire bytes (RNE), int8/fp8 quarter them with "
+         "per-chunk scales (live-tunable; per-tensor override via "
+         "wire_dtype=; see docs/performance.md 'Wire compression')"),
+    Knob("HOROVOD_SPARSE_TOPK", "0.01", _sparse_topk,
+         "default top-k ratio for Compression.topk sparse allreduce "
+         "(indices+values ride the allgather path; error-feedback "
+         "residuals per gradient leaf, cleared per membership epoch)"),
+    Knob("HOROVOD_TOPK_SEED", "0",
+         lambda raw: str(_int_env(raw, 0)),
+         "seeded tie-break for deterministic top-k selection"),
     Knob("HOROVOD_ALGO_THRESHOLD", "32768",
          lambda raw: str(max(0, _int_env(raw, 32 << 10))),
          "size-based algorithm crossover: allreduces at or under this "

@@ -19,11 +19,12 @@ __all__ = ["load_state", "save_state", "apply_wiring_warm_start"]
 _VERSION = 1
 
 #: Live-tunable knob names a committed config may carry.  For
-#: ``algo_threshold`` 0 is a REAL value (small-tensor star path off), so
-#: the sanitizer below accepts >= 0 for it while the others need > 0.
+#: ``algo_threshold`` 0 is a REAL value (small-tensor star path off) and
+#: for ``wire_dtype`` 0 is fp32 (the uncompressed default), so the
+#: sanitizer below accepts >= 0 for them while the others need > 0.
 LIVE_KNOBS = ("chunk_bytes", "fusion_threshold", "cycle_time_ms",
-              "wave_width", "algo_threshold")
-_ZERO_OK_KNOBS = ("algo_threshold",)
+              "wave_width", "algo_threshold", "wire_dtype")
+_ZERO_OK_KNOBS = ("algo_threshold", "wire_dtype")
 #: Wiring-time knobs the startup micro-probe may pin.
 WIRING_KNOBS = {"num_channels": "HOROVOD_NUM_CHANNELS",
                 "channel_drivers": "HOROVOD_CHANNEL_DRIVERS"}

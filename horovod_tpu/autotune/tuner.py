@@ -65,7 +65,17 @@ def default_space(num_channels: int) -> Dict[str, List[int]]:
     """Log-scaled ladders for the live-tunable knobs.  The wave ladder is
     bounded by the committed channel fan-out (waves cannot exceed it).
     ``HOROVOD_AUTOTUNE_KNOBS`` (comma list) restricts which knobs are
-    swept — tests and the CI gate use it to keep schedules short."""
+    swept — tests and the CI gate use it to keep schedules short.
+
+    The WIRE DTYPE knob (fp32=0, fp16=1, int8=3 — WireDtype codes) only
+    joins the sweep under ``HOROVOD_AUTOTUNE_WIRE=1``: unlike every
+    other knob it changes NUMERICS (compressed wires are value-lossy by
+    design), so the tuner flipping it silently under a training job
+    would violate the bit-exactness default.  When enabled, trials are
+    scored on the same busbw counters as everything else — and since
+    ``allreduce_bytes`` counts LOGICAL (pre-compression) payload, the
+    score is automatically the EFFECTIVE bus bandwidth: logical bytes
+    over wall time, exactly what compression is supposed to improve."""
     space: Dict[str, List[int]] = {
         "chunk_bytes": ladder(64 << 10, 4 << 20),
         "fusion_threshold": ladder(8 << 20, 128 << 20),
@@ -77,8 +87,11 @@ def default_space(num_channels: int) -> Dict[str, List[int]]:
         "algo_threshold": [0] + ladder(8 << 10, 256 << 10),
     }
     only = os.environ.get("HOROVOD_AUTOTUNE_KNOBS", "")
-    if only:
-        keep = {k.strip() for k in only.split(",") if k.strip()}
+    keep = {k.strip() for k in only.split(",") if k.strip()}
+    if os.environ.get("HOROVOD_AUTOTUNE_WIRE", "") not in ("", "0") or \
+            "wire_dtype" in keep:
+        space["wire_dtype"] = [0, 1, 3]
+    if keep:
         space = {k: v for k, v in space.items() if k in keep}
     return space
 
@@ -172,6 +185,7 @@ class Autotuner(threading.Thread):
             cycle_time_ms=cfg.get("cycle_time_ms", 0),
             wave_width=cfg.get("wave_width", 0),
             algo_threshold=cfg.get("algo_threshold", -1),
+            wire_dtype=cfg.get("wire_dtype", -1),
             commit=commit)
         if not ok:
             return False
@@ -226,24 +240,42 @@ class Autotuner(threading.Thread):
         if os.environ.get("HOROVOD_AUTOTUNE_FORCE_SEARCH", "") not in \
                 ("", "0"):
             return None
+        warm = None
         state = load_state(self.state_file)
         if state is not None:
             global _LAST_SCORE
             _LAST_SCORE = state.get("score")
-            return state["committed"]
-        if _LAST_COMMITTED is not None:
-            return dict(_LAST_COMMITTED)
-        return None
+            warm = dict(state["committed"])
+        elif _LAST_COMMITTED is not None:
+            warm = dict(_LAST_COMMITTED)
+        if warm is not None and \
+                os.environ.get("HOROVOD_AUTOTUNE_WIRE", "") in ("", "0"):
+            # A persisted wire dtype is NUMERICS-changing and only ever
+            # entered the search under the HOROVOD_AUTOTUNE_WIRE opt-in;
+            # a warm restart without that opt-in must not silently put
+            # the new job on a lossy wire.
+            warm.pop("wire_dtype", None)
+        return warm or None
 
     def _search_once(self) -> bool:
         """One full search under the current epoch.  Returns True when it
         committed; False when the epoch moved underneath it (the caller
         restarts the search under the new epoch)."""
         self.epoch = self._eng.epoch()
-        base = {k: int(v) for k, v in self._eng.stats()["config"].items()
+        cfg_now = self._eng.stats()["config"]
+        base = {k: int(v) for k, v in cfg_now.items()
                 if k in ("chunk_bytes", "fusion_threshold",
                          "cycle_time_ms", "wave_width", "algo_threshold")}
-        space = default_space(self._eng.stats()["config"]["num_channels"])
+        space = default_space(cfg_now["num_channels"])
+        if "wire_dtype" in space:
+            # Only when the wire knob is actually swept does it join the
+            # base/committed config (config reports it as a NAME; the
+            # TUNE frame and the ladder use the WireDtype code).  Keeping
+            # it out otherwise preserves the invariant that a committed
+            # config compares equal to stats()["config"] key-for-key.
+            from horovod_tpu.runtime.engine import WIRE_DTYPES
+            base["wire_dtype"] = WIRE_DTYPES.get(
+                cfg_now.get("wire_dtype", "fp32"), 0)
         search = CoordinateSearch(space, seed=self.seed, base=base,
                                   max_trials=self.max_trials)
         self.planned = search.planned_schedule()

@@ -59,6 +59,20 @@ int64_t horovod_enqueue(int op, const char* name, int dtype, int ndim,
                                root_rank, static_cast<hvd::ReduceOp>(red_op));
 }
 
+// Like horovod_enqueue with an explicit per-tensor WIRE dtype for the
+// allreduce payload: 0 = fp32, 1 = fp16, 2 = bf16, 3 = int8, 4 = fp8
+// (WireDtype values); < 0 defers to the live HOROVOD_WIRE_DTYPE knob —
+// exactly what horovod_enqueue does.  Only fp32 allreduces compress.
+int64_t horovod_enqueue_wire(int op, const char* name, int dtype, int ndim,
+                             const int64_t* shape, void* data,
+                             int root_rank, int red_op, int wire_dtype) {
+  std::vector<int64_t> dims(shape, shape + ndim);
+  return Engine::Get().Enqueue(static_cast<RequestType>(op), name,
+                               static_cast<DataType>(dtype), dims, data,
+                               root_rank, static_cast<hvd::ReduceOp>(red_op),
+                               /*probe=*/false, wire_dtype);
+}
+
 // Layout-probe allreduce (sum) for a tensor whose gradient never
 // materialized locally: completes as a normal dense allreduce unless peers
 // are gathering the tensor sparsely, in which case the handle fails with
@@ -153,6 +167,33 @@ int64_t horovod_shm_enabled() {
 }
 int64_t horovod_algo_threshold() { return Engine::Get().algo_threshold(); }
 
+// Wire-compression observability (see Engine accessors): buffer-level
+// bytes saved by the wire representation, compressed ring payload sent,
+// cumulative (de)quantization kernel time, and per-mode response counts.
+int64_t horovod_wire_bytes_saved() {
+  return Engine::Get().wire_bytes_saved();
+}
+int64_t horovod_compressed_bytes_tx() {
+  return Engine::Get().compressed_bytes_tx();
+}
+int64_t horovod_quantize_ns() { return Engine::Get().quantize_ns(); }
+int64_t horovod_wire_fp16_count() {
+  return Engine::Get().wire_fp16_count();
+}
+int64_t horovod_wire_bf16_count() {
+  return Engine::Get().wire_bf16_count();
+}
+int64_t horovod_wire_int8_count() {
+  return Engine::Get().wire_int8_count();
+}
+int64_t horovod_wire_fp8_count() {
+  return Engine::Get().wire_fp8_count();
+}
+// Effective default wire dtype (WireDtype value; live-tunable knob #6).
+int64_t horovod_wire_dtype() {
+  return static_cast<int64_t>(Engine::Get().wire_dtype());
+}
+
 // Effective (currently in-force) knob values for stats()["config"]:
 // post-autotune, not the env defaults — chunk/fusion/cycle/wave are
 // live-tunable, the rest report the committed wiring-time resolution.
@@ -186,10 +227,11 @@ int64_t horovod_tune_trials() { return Engine::Get().tune_trials(); }
 // Returns 0 queued, -1 when not initialized or not the coordinator.
 int horovod_autotune_set(int64_t chunk_bytes, int64_t fusion_threshold,
                          int64_t cycle_time_ms, int64_t wave_width,
-                         int64_t algo_threshold, int commit) {
+                         int64_t algo_threshold, int64_t wire_dtype,
+                         int commit) {
   return Engine::Get().QueueTune(chunk_bytes, fusion_threshold,
                                  cycle_time_ms, wave_width, algo_threshold,
-                                 commit != 0);
+                                 wire_dtype, commit != 0);
 }
 
 // Why the engine aborted, copied into buf (truncated to buflen-1); empty

@@ -107,6 +107,33 @@ inline const char* DataTypeName(DataType dt) {
   return "?";
 }
 
+// Negotiated per-response WIRE format for allreduce payloads
+// (HOROVOD_WIRE_DTYPE, overridable per tensor from the frontend).  The
+// tensor keeps its own dtype end to end; the wire dtype only governs the
+// bytes between ranks: fp16/bf16 wires carry RNE-converted halves, and
+// int8/fp8 wires carry per-chunk-scaled quantized blocks
+// (``[fp32 scale][block]``, block sized to HOROVOD_CHUNK_BYTES).  FP32
+// (the default) is byte-identical to the uncompressed engine.  Applies to
+// FLOAT32 allreduce only; every other dtype/op wires at its own format.
+enum class WireDtype : uint8_t {
+  FP32 = 0,
+  FP16 = 1,
+  BF16 = 2,
+  INT8 = 3,
+  FP8 = 4,   // e4m3 with per-chunk scales (saturating, no inf)
+};
+
+inline const char* WireDtypeName(WireDtype w) {
+  switch (w) {
+    case WireDtype::FP32: return "fp32";
+    case WireDtype::FP16: return "fp16";
+    case WireDtype::BF16: return "bf16";
+    case WireDtype::INT8: return "int8";
+    case WireDtype::FP8: return "fp8";
+  }
+  return "?";
+}
+
 class TensorShape {
  public:
   void AddDim(int64_t d) { dims_.push_back(d); }

@@ -7,11 +7,14 @@
 
 #include <algorithm>
 #include <cassert>
+#include <cctype>
 #include <cerrno>
 #include <chrono>
+#include <cmath>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <map>
 #include <sstream>
 
@@ -275,6 +278,159 @@ void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
 }
 
 // ---------------------------------------------------------------------------
+// Wire-compression kernels (quantize / dequantize / block reduce)
+// ---------------------------------------------------------------------------
+
+// fp8 e4m3 (1/4/3, bias 7, saturating "fn" variant: no infinity, 0x7f =
+// NaN, max finite 448).  Encode is RNE like every other wire conversion;
+// decode goes through a 256-entry table built once (the dequant hot loop
+// is a single gather).
+static inline uint8_t FloatToFp8E4M3(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t sign = (f >> 24) & 0x80u;
+  uint32_t absf = f & 0x7fffffffu;
+  if (absf >= 0x7f800000u) return static_cast<uint8_t>(sign | 0x7fu);  // NaN/inf
+  // Saturate finite overflow to the max finite (448), e4m3fn-style.
+  // 0x43e00000 = 448.0f; values that ROUND past 448 saturate too — the
+  // RNE step below cannot exceed 0x7e after this clamp.
+  float av;
+  memcpy(&av, &absf, 4);
+  if (av > 448.0f) return static_cast<uint8_t>(sign | 0x7eu);
+  int32_t exp = static_cast<int32_t>(absf >> 23) - 127 + 7;
+  uint32_t man = absf & 0x7fffffu;
+  if (exp <= 0) {
+    // Subnormal target: smallest normal is 2^-6, subnormal lsb 2^-9.
+    if (exp < -3) return static_cast<uint8_t>(sign);  // underflows to 0
+    man |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(21 - exp);  // man>>shift -> 3 bits
+    uint32_t q = man >> shift;
+    uint32_t halfbit = 1u << (shift - 1);
+    uint32_t rem = man & ((1u << shift) - 1u);
+    if (rem > halfbit || (rem == halfbit && (q & 1u))) q += 1;
+    return static_cast<uint8_t>(sign | q);
+  }
+  uint32_t q = (static_cast<uint32_t>(exp) << 3) | (man >> 20);
+  uint32_t rem = man & 0xfffffu;
+  if (rem > 0x80000u || (rem == 0x80000u && (q & 1u))) q += 1;
+  if (q >= 0x7fu) q = 0x7eu;  // rounded past the top: saturate, not NaN
+  return static_cast<uint8_t>(sign | q);
+}
+
+static inline float Fp8E4M3ToFloatScalar(uint8_t b) {
+  uint32_t sign = (b & 0x80u) ? 0x80000000u : 0;
+  uint32_t exp = (b >> 3) & 0xfu;
+  uint32_t man = b & 0x7u;
+  uint32_t f;
+  if (exp == 0) {
+    if (man == 0) {
+      f = sign;
+    } else {
+      int e = 127 - 7 + 1;
+      while ((man & 0x8u) == 0) {
+        man <<= 1;
+        e--;
+      }
+      f = sign | (static_cast<uint32_t>(e) << 23) | ((man & 0x7u) << 20);
+    }
+  } else if (exp == 0xfu && man == 0x7u) {
+    f = sign | 0x7fc00000u;  // NaN
+  } else {
+    f = sign | ((exp - 7 + 127) << 23) | (man << 20);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+static const float* Fp8DecodeTable() {
+  static const float* table = [] {
+    float* t = new float[256];
+    for (int i = 0; i < 256; ++i) {
+      t[i] = Fp8E4M3ToFloatScalar(static_cast<uint8_t>(i));
+    }
+    return t;
+  }();
+  return table;
+}
+
+// Round-to-nearest-even float -> int8 in [-127, 127] (the symmetric
+// range; -128 unused so negation is exact).  rintf honors the current FP
+// rounding mode — FE_TONEAREST (RNE) per C default, matching every other
+// wire conversion in this file.  Saturating comparisons first, NaN
+// check last: casting a NaN or out-of-range float to int8 is UB, and a
+// non-finite block already routed through the NaN-scale path below.
+static inline int8_t QuantizeI8(float x) {
+  float r = rintf(x);
+  if (r >= 127.f) return 127;
+  if (r <= -127.f) return -127;
+  if (!(r == r)) return 0;  // NaN element: the block scale carries it
+  return static_cast<int8_t>(r);
+}
+
+// One quantized block: [fp32 scale][block_elems codes], scale chosen so
+// the block's max |value| maps to the top code (127 / 448).  An all-zero
+// block carries scale 0 and zero codes.  A block containing ANY
+// non-finite element (a mixed-precision overflow step) carries a NaN
+// scale and zero codes: dequantization turns the whole block into NaNs,
+// so the overflow PROPAGATES to every rank — block-granular, like fp16
+// overflow — instead of silently zeroing the gradient out from under a
+// GradScaler-style detector (and instead of the UB a NaN→int8 cast
+// would be).
+static void QuantizeBlock(const float* src, int64_t n, hvd::WireDtype wire,
+                          uint8_t* dst, int64_t block_elems) {
+  float maxabs = 0.f;
+  bool finite = true;
+  for (int64_t i = 0; i < n; ++i) {
+    float a = fabsf(src[i]);
+    finite = finite && std::isfinite(a);
+    if (a > maxabs) maxabs = a;  // NaN compares false: `finite` covers it
+  }
+  const float top = wire == hvd::WireDtype::FP8 ? 448.f : 127.f;
+  float scale = maxabs > 0.f ? maxabs / top : 0.f;
+  if (!finite) scale = std::numeric_limits<float>::quiet_NaN();
+  float inv = scale > 0.f ? 1.f / scale : 0.f;
+  if (!std::isfinite(inv)) {
+    // A subnormal-magnitude block (max|value| ~< 1e-36): 1/scale
+    // overflows to inf, which would NaN-poison finite input through
+    // 0*inf.  Values this small are below every wire format's
+    // resolution anyway — flush the block to exact zero (scale 0).
+    scale = 0.f;
+    inv = 0.f;
+  }
+  memcpy(dst, &scale, 4);
+  uint8_t* q = dst + 4;
+  if (!finite || inv == 0.f) {
+    for (int64_t i = 0; i < block_elems; ++i) q[i] = 0;
+    return;
+  }
+  if (wire == hvd::WireDtype::FP8) {
+    for (int64_t i = 0; i < n; ++i) q[i] = FloatToFp8E4M3(src[i] * inv);
+  } else {
+    for (int64_t i = 0; i < n; ++i) {
+      q[i] = static_cast<uint8_t>(QuantizeI8(src[i] * inv));
+    }
+  }
+  // Zero-pad the tail of a partial last block: padding dequantizes to
+  // exactly 0 and can never move the block scale of any peer.
+  for (int64_t i = n; i < block_elems; ++i) q[i] = 0;
+}
+
+static void DequantizeBlock(const uint8_t* src, int64_t n,
+                            hvd::WireDtype wire, float* dst) {
+  float scale;
+  memcpy(&scale, src, 4);
+  const uint8_t* q = src + 4;
+  if (wire == hvd::WireDtype::FP8) {
+    const float* table = Fp8DecodeTable();
+    for (int64_t i = 0; i < n; ++i) dst[i] = table[q[i]] * scale;
+  } else {
+    const int8_t* s = reinterpret_cast<const int8_t*>(q);
+    for (int64_t i = 0; i < n; ++i) dst[i] = static_cast<float>(s[i]) * scale;
+  }
+}
+
+// ---------------------------------------------------------------------------
 // Data-plane thread pool
 // ---------------------------------------------------------------------------
 
@@ -466,6 +622,34 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   {
     int64_t at = EnvInt64("HOROVOD_ALGO_THRESHOLD", 32 << 10);
     algo_threshold_.store(at < 0 ? 0 : at);
+  }
+  // Default wire format for fp32 allreduce payloads
+  // (HOROVOD_WIRE_DTYPE=fp32|fp16|bf16|int8|fp8; fp32 is byte-identical
+  // to the pre-compression engine and stays the default contract).
+  {
+    const char* w = std::getenv("HOROVOD_WIRE_DTYPE");
+    int wv = 0;
+    if (w != nullptr && w[0] != '\0') {
+      if (std::strcmp(w, "fp32") == 0 || std::strcmp(w, "float32") == 0) {
+        wv = 0;
+      } else if (std::strcmp(w, "fp16") == 0 ||
+                 std::strcmp(w, "float16") == 0) {
+        wv = 1;
+      } else if (std::strcmp(w, "bf16") == 0 ||
+                 std::strcmp(w, "bfloat16") == 0) {
+        wv = 2;
+      } else if (std::strcmp(w, "int8") == 0) {
+        wv = 3;
+      } else if (std::strcmp(w, "fp8") == 0 ||
+                 std::strcmp(w, "fp8e4m3") == 0) {
+        wv = 4;
+      } else {
+        std::fprintf(stderr,
+                     "horovod_tpu: unknown HOROVOD_WIRE_DTYPE '%s' (want "
+                     "fp32|fp16|bf16|int8|fp8); using fp32\n", w);
+      }
+    }
+    wire_dtype_.store(wv);
   }
   shm_ring_bytes_ = EnvInt64("HOROVOD_SHM_RING_BYTES", 2 << 20);
   if (shm_ring_bytes_ < (1 << 16)) shm_ring_bytes_ = 1 << 16;
@@ -1385,7 +1569,9 @@ void Engine::CountShmBytes(int64_t tx, int64_t rx) {
   if (tx + rx > 0) intra_host_bytes_.fetch_add(tx + rx);
 }
 
-void Engine::CountPortBytes(const RingPort& port, int64_t tx, int64_t rx) {
+void Engine::CountPortBytes(const RingPort& port, int64_t tx, int64_t rx,
+                            bool compressed) {
+  if (compressed && tx > 0) compressed_bytes_tx_.fetch_add(tx);
   if (port.is_shm()) {
     CountShmBytes(tx, rx);
     return;
@@ -1877,7 +2063,8 @@ bool Engine::RunLoopOnce() {
 
 int Engine::QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
                       int64_t cycle_time_ms, int64_t wave_width,
-                      int64_t algo_threshold, bool commit) {
+                      int64_t algo_threshold, int64_t wire_dtype,
+                      bool commit) {
   if (!initialized_.load() || shut_down_.load()) return -1;
   // Only the coordinator may propose: TUNE rides its response broadcast.
   if (size_ > 1 && rank_ != 0) return -1;
@@ -1888,6 +2075,7 @@ int Engine::QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
   pending_tune_.cycle_time_ms = static_cast<int32_t>(cycle_time_ms);
   pending_tune_.wave_width = static_cast<int32_t>(wave_width);
   pending_tune_.algo_threshold = algo_threshold;
+  pending_tune_.wire_dtype = static_cast<int32_t>(wire_dtype);
   pending_tune_.commit = commit;
   tune_pending_.store(true);
   cycle_cv_.notify_one();  // an idle world still ships the frame promptly
@@ -1905,6 +2093,7 @@ bool Engine::DrainPendingTune(ResponseList* out) {
   out->tune_cycle_time_ms = pending_tune_.cycle_time_ms;
   out->tune_wave_width = pending_tune_.wave_width;
   out->tune_algo_threshold = pending_tune_.algo_threshold;
+  out->tune_wire_dtype = pending_tune_.wire_dtype;
   tune_pending_.store(false);
   return true;
 }
@@ -1935,14 +2124,22 @@ void Engine::ApplyTune(const ResponseList& list) {
   if (list.tune_algo_threshold >= 0) {
     algo_threshold_.store(list.tune_algo_threshold);
   }
+  // Same convention for the wire knob: 0 (fp32) is real, < 0 unchanged.
+  // The new default governs enqueues AFTER this boundary; anything
+  // already negotiated keeps its committed wire format, and the
+  // signature change evicts the affected cache slots on first re-use.
+  if (list.tune_wire_dtype >= 0 && list.tune_wire_dtype <= 4) {
+    wire_dtype_.store(static_cast<int>(list.tune_wire_dtype));
+  }
   tune_trials_.fetch_add(1);
-  char desc[192];
+  char desc[224];
   std::snprintf(desc, sizeof(desc),
-                "chunk=%lld,fusion=%lld,cycle=%d,wave=%d,algo=%lld",
+                "chunk=%lld,fusion=%lld,cycle=%d,wave=%d,algo=%lld,wire=%s",
                 static_cast<long long>(chunk_bytes_.load()),
                 static_cast<long long>(fusion_threshold_.load()),
                 cycle_time_ms_.load(), wave_width_.load(),
-                static_cast<long long>(algo_threshold_.load()));
+                static_cast<long long>(algo_threshold_.load()),
+                WireDtypeName(static_cast<WireDtype>(wire_dtype_.load())));
   timeline_.TuneTrial(desc, list.tune_commit);
 }
 
@@ -2012,6 +2209,7 @@ static Request RequestFromEntry(const TensorTableEntry& e, int rank) {
   q.tensor_name = e.name;
   q.root_rank = e.root_rank;
   q.red_op = e.red_op;
+  q.wire_dtype = e.wire_dtype;
   for (int d = 0; d < e.shape.ndim(); ++d) q.shape.push_back(e.shape.dim(d));
   return q;
 }
@@ -2059,6 +2257,7 @@ void Engine::ApplyCacheUpdates(const ResponseList& list) {
         entry.sig.dtype = e.dtype;
         entry.sig.root_rank = e.root_rank;
         entry.sig.red_op = e.red_op;
+        entry.sig.wire_dtype = e.wire_dtype;
         for (int d = 0; d < e.shape.ndim(); ++d) {
           entry.sig.shape.push_back(e.shape.dim(d));
         }
@@ -2069,6 +2268,7 @@ void Engine::ApplyCacheUpdates(const ResponseList& list) {
       single.tensor_sizes = resp.tensor_sizes;
       single.root_rank = resp.root_rank;
       single.red_op = resp.red_op;
+      single.wire_dtype = resp.wire_dtype;
       single.cache_slots.assign(1, -1);
       entry.response = std::move(single);
       cache_by_name_[name] = slot;
@@ -2282,6 +2482,21 @@ Response Engine::BuildResponse(const std::string& name) {
   Response resp;
   resp.tensor_names.push_back(name);
   std::ostringstream err;
+  // Wire-dtype reference for validation: the first NON-probe request.
+  // A layout probe (no local gradient) resolves its wire from the
+  // global knob, not the per-tensor override its peers may be using —
+  // holding it to the peers' format would fail the very step the probe
+  // machinery exists to survive.  Execution is safe either way: every
+  // rank executes the RESPONSE's committed wire, never its own
+  // request's.
+  const Request* wire_ref = nullptr;
+  for (int r = 0; r < size_; ++r) {
+    if (!info.requests[r].probe) {
+      wire_ref = &info.requests[r];
+      break;
+    }
+  }
+  if (wire_ref == nullptr) wire_ref = &first;  // all probes: global knob
 
   for (int r = 1; r < size_; ++r) {
     const Request& q = info.requests[r];
@@ -2308,6 +2523,23 @@ Response Engine::BuildResponse(const std::string& name) {
       err << "Mismatched data types: rank 0 has " << DataTypeName(first.dtype)
           << " but rank " << r << " has " << DataTypeName(q.dtype)
           << " for tensor " << name << ".";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+    // The L1 dtype validation extended to the WIRE format: the data
+    // plane quantizes on one committed format per response, so ranks
+    // disagreeing (per-tensor override drift, or a raced env change)
+    // must fail cleanly here — never garble bytes on the ring.  Probes
+    // are exempt (see wire_ref above) — they adopt the committed wire.
+    if (first.type == RequestType::ALLREDUCE && !q.probe &&
+        q.wire_dtype != wire_ref->wire_dtype) {
+      err << "Mismatched wire dtypes: rank " << wire_ref->request_rank
+          << " requested " << WireDtypeName(wire_ref->wire_dtype)
+          << " but rank " << r << " requested "
+          << WireDtypeName(q.wire_dtype) << " for tensor " << name
+          << " (set HOROVOD_WIRE_DTYPE identically on every rank, or use "
+             "the same per-tensor override).";
       resp.type = ResponseType::ERROR;
       resp.error_message = err.str();
       return resp;
@@ -2416,6 +2648,9 @@ Response Engine::BuildResponse(const std::string& name) {
   }
   resp.type = ResponseType::ALLREDUCE;
   resp.red_op = first.red_op;
+  // Committed wire: the non-probe ranks' (validated identical) format —
+  // probing ranks adopt it from this response.
+  resp.wire_dtype = wire_ref->wire_dtype;
   return resp;
 }
 
@@ -2448,6 +2683,7 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
     if (resp.type == ResponseType::ALLREDUCE && !fused.empty() &&
         fused.back().type == ResponseType::ALLREDUCE &&
         fused.back().red_op == resp.red_op &&
+        fused.back().wire_dtype == resp.wire_dtype &&
         entry_dtype(fused.back().tensor_names[0]) ==
             entry_dtype(resp.tensor_names[0])) {
       int64_t total = 0;
@@ -2590,6 +2826,101 @@ void Engine::ReduceIntoTimed(void* dst, const void* src, int64_t count,
   reduce_ns_.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
                            std::chrono::steady_clock::now() - t0)
                            .count());
+}
+
+// The codec combine kernel: dequantize both operands' blocks to fp32
+// staging, combine (same operand order as ReduceInto: dst op src),
+// rescale and requantize into dst.  Per-hop requantization accumulates
+// bounded quantization error — the wire contract for int8/fp8 is
+// loss-parity convergence, not bitwise equality.  Counted as reduction
+// time (reduce_ns); the buffer-edge quantize/dequantize passes are what
+// quantize_ns measures.
+void Engine::WireReduceBlocksTimed(uint8_t* dst, const uint8_t* src,
+                                   int64_t nblocks, const WireCodec& codec,
+                                   ReduceOp op) {
+  auto t0 = std::chrono::steady_clock::now();
+  // Thread-local staging: this runs on channel drivers and pool workers
+  // concurrently, and a per-chunk heap allocation would dominate small
+  // blocks.
+  thread_local std::vector<float> a, b;
+  const size_t n = static_cast<size_t>(codec.block_elems);
+  if (a.size() < n) {
+    a.resize(n);
+    b.resize(n);
+  }
+  for (int64_t blk = 0; blk < nblocks; ++blk) {
+    uint8_t* d = dst + blk * codec.block_bytes;
+    const uint8_t* s = src + blk * codec.block_bytes;
+    DequantizeBlock(d, codec.block_elems, codec.wire, a.data());
+    DequantizeBlock(s, codec.block_elems, codec.wire, b.data());
+    ReduceInto(a.data(), b.data(), codec.block_elems, DataType::FLOAT32, op);
+    QuantizeBlock(a.data(), codec.block_elems, codec.wire, d,
+                  codec.block_elems);
+  }
+  reduce_ns_.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                           std::chrono::steady_clock::now() - t0)
+                           .count());
+}
+
+// Quantized (int8/fp8) allreduce over `spec`: quantize the fp32 payload
+// into per-chunk-scaled blocks, run the SAME channel-sharded ring (the
+// stepped legacy path or the streaming cascade, TCP or shm — the codec
+// rides the spec) over the wire buffer, dequantize back into `base`.
+// Blocks are sized to HOROVOD_CHUNK_BYTES worth of fp32 elements, so
+// "per-chunk scales" and the pipeline chunk coincide; the last block is
+// zero-padded to keep ring elements uniform.
+bool Engine::CompressedRingAllreduce(uint8_t* base, int64_t count,
+                                     WireDtype wire, ReduceOp op,
+                                     RingSpec spec, const ExecCtx& ctx,
+                                     const std::string& tname,
+                                     std::string* err) {
+  WireCodec codec;
+  codec.wire = wire;
+  codec.block_elems =
+      std::min<int64_t>(std::max<int64_t>(64, chunk_bytes_.load() / 4),
+                        count);
+  codec.block_bytes = 4 + static_cast<size_t>(codec.block_elems);
+  const int64_t nblocks =
+      (count + codec.block_elems - 1) / codec.block_elems;
+  std::vector<uint8_t> wirebuf(static_cast<size_t>(nblocks) *
+                               codec.block_bytes);
+  const float* src = reinterpret_cast<const float*>(base);
+  auto q0 = std::chrono::steady_clock::now();
+  for (int64_t blk = 0; blk < nblocks; ++blk) {
+    const int64_t off = blk * codec.block_elems;
+    const int64_t n = std::min(codec.block_elems, count - off);
+    QuantizeBlock(src + off, n, wire,
+                  wirebuf.data() + blk * codec.block_bytes,
+                  codec.block_elems);
+  }
+  quantize_ns_.fetch_add(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now() - q0)
+          .count());
+  // Clamped at zero: a tiny tensor's wire form (scale header + padding)
+  // can exceed its logical bytes, and a cumulative "saved" counter must
+  // never run backwards over many small collectives.
+  wire_bytes_saved_.fetch_add(std::max<int64_t>(
+      0, count * 4 - static_cast<int64_t>(wirebuf.size())));
+  spec.codec = &codec;
+  spec.compressed = true;
+  bool ok = ChanneledRingAllreduce(wirebuf.data(), nblocks,
+                                   DataType::FLOAT32, op, spec, ctx, tname,
+                                   err);
+  if (!ok) return false;
+  float* dst = reinterpret_cast<float*>(base);
+  q0 = std::chrono::steady_clock::now();
+  for (int64_t blk = 0; blk < nblocks; ++blk) {
+    const int64_t off = blk * codec.block_elems;
+    const int64_t n = std::min(codec.block_elems, count - off);
+    DequantizeBlock(wirebuf.data() + blk * codec.block_bytes, n, wire,
+                    dst + off);
+  }
+  quantize_ns_.fetch_add(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now() - q0)
+          .count());
+  return true;
 }
 
 void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
@@ -2737,7 +3068,11 @@ bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
                                       DataType dtype, ReduceOp op,
                                       const RingSpec& spec, int ch,
                                       std::string* err) {
-  const size_t esize = DataTypeSize(dtype);
+  // Under a wire codec the ring element is one quantized BLOCK
+  // (seg_count/seg_off are block-granular) and the combine kernel is the
+  // dequant-add-requant block reduce; everything else is unchanged.
+  const size_t esize =
+      spec.codec ? spec.codec->block_bytes : DataTypeSize(dtype);
   const int rsize = spec.rsize;
   const int vrank = spec.vrank;
   int64_t max_seg = 0;
@@ -2746,8 +3081,9 @@ bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
   // bytes per collective for data every chunk immediately overwrites.
   std::unique_ptr<uint8_t[]> tmp(
       new uint8_t[static_cast<size_t>(max_seg) * esize]);
-  const size_t chunk =
+  size_t chunk =
       static_cast<size_t>(chunk_bytes_.load()) / esize * esize;  // aligned
+  if (chunk == 0) chunk = esize;  // a wire block can exceed the chunk knob
   const int timeout_ms = socket_timeout_sec_ * 1000;
   for (int step = 0; step < rsize - 1; ++step) {
     int send_seg = (vrank - step + 2 * rsize) % rsize;
@@ -2760,14 +3096,20 @@ bool Engine::RingReduceScatterPhaseCh(uint8_t* base,
         spec.ports[ch], base + seg_off[send_seg] * esize, sn, tmp.get(), rn,
         chunk,
         [&](size_t off, size_t len) {
-          ReduceIntoTimed(rbase + off, tmp.get() + off,
-                          static_cast<int64_t>(len / esize), dtype, op);
+          if (spec.codec != nullptr) {
+            WireReduceBlocksTimed(rbase + off, tmp.get() + off,
+                                  static_cast<int64_t>(len / esize),
+                                  *spec.codec, op);
+          } else {
+            ReduceIntoTimed(rbase + off, tmp.get() + off,
+                            static_cast<int64_t>(len / esize), dtype, op);
+          }
         },
         timeout_ms, err, &wns);
     wire_ns_.fetch_add(wns);
     if (!ok) return false;
     CountPortBytes(spec.ports[ch], static_cast<int64_t>(sn),
-                   static_cast<int64_t>(rn));
+                   static_cast<int64_t>(rn), spec.compressed);
   }
   return true;
 }
@@ -2795,7 +3137,7 @@ bool Engine::RingAllgatherPhaseCh(uint8_t* base,
     wire_ns_.fetch_add(wns);
     if (!ok) return false;
     CountPortBytes(spec.ports[ch], static_cast<int64_t>(sn),
-                   static_cast<int64_t>(rn));
+                   static_cast<int64_t>(rn), spec.compressed);
   }
   return true;
 }
@@ -2814,7 +3156,8 @@ bool Engine::StreamingRingChannels(uint8_t* base,
                                    const std::vector<ChannelSegs>& channels,
                                    DataType dtype, ReduceOp op,
                                    const RingSpec& spec, std::string* err) {
-  const size_t esize = DataTypeSize(dtype);
+  const size_t esize =
+      spec.codec ? spec.codec->block_bytes : DataTypeSize(dtype);
   const int N = spec.rsize;
   const int vrank = spec.vrank;
   const int nsteps = 2 * (N - 1);
@@ -2834,8 +3177,9 @@ bool Engine::StreamingRingChannels(uint8_t* base,
       recv_seg[s] = (vrank - sp + 2 * N) % N;
     }
   }
-  const size_t chunk =
+  size_t chunk =
       static_cast<size_t>(chunk_bytes_.load()) / esize * esize;  // aligned
+  if (chunk == 0) chunk = esize;  // a wire block can exceed the chunk knob
 
   // Per-channel cascade state.
   struct ChState {
@@ -2908,8 +3252,14 @@ bool Engine::StreamingRingChannels(uint8_t* base,
              (c.ro - c.reduced >= chunk || c.ro == total)) {
         size_t len = std::min(chunk, c.ro - c.reduced);
         auto r0 = std::chrono::steady_clock::now();
-        ReduceIntoTimed(sb + c.reduced, c.tmp.get() + c.reduced,
-                        static_cast<int64_t>(len / esize), dtype, op);
+        if (spec.codec != nullptr) {
+          WireReduceBlocksTimed(sb + c.reduced, c.tmp.get() + c.reduced,
+                                static_cast<int64_t>(len / esize),
+                                *spec.codec, op);
+        } else {
+          ReduceIntoTimed(sb + c.reduced, c.tmp.get() + c.reduced,
+                          static_cast<int64_t>(len / esize), dtype, op);
+        }
         local_reduce_ns +=
             std::chrono::duration_cast<std::chrono::nanoseconds>(
                 std::chrono::steady_clock::now() - r0)
@@ -3092,7 +3442,7 @@ bool Engine::StreamingRingChannels(uint8_t* base,
                      local_reduce_ns);
   for (auto& c : st) {
     CountPortBytes(*c.port, static_cast<int64_t>(c.tx),
-                   static_cast<int64_t>(c.rx));
+                   static_cast<int64_t>(c.rx), spec.compressed);
   }
   return ok;
 }
@@ -3108,7 +3458,11 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
                                     const ExecCtx& ctx,
                                     const std::string& tname,
                                     std::string* err) {
-  const size_t esize = DataTypeSize(dtype);
+  // Under a wire codec, `count` is the number of quantized BLOCKS and
+  // the element size is the block size — segment and channel-shard
+  // arithmetic runs unchanged over uniform block elements.
+  const size_t esize =
+      spec.codec ? spec.codec->block_bytes : DataTypeSize(dtype);
   std::vector<int64_t> seg_count, seg_off;
   EvenSegments(count, spec.rsize, &seg_count, &seg_off);
   // Effective fan-out, deterministic across ranks (count, esize, and the
@@ -3332,7 +3686,8 @@ bool Engine::StarFoldAllreduce(uint8_t* base, int64_t count, DataType dtype,
 // change bits within one topology.
 bool Engine::TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
                                ReduceOp op, const std::string& name,
-                               const ExecCtx& ctx, std::string* err) {
+                               const ExecCtx& ctx, WireDtype wire,
+                               bool compressed_payload, std::string* err) {
   const size_t esize = DataTypeSize(dtype);
   const size_t nbytes = static_cast<size_t>(count) * esize;
   const int L = group_size_;
@@ -3352,6 +3707,7 @@ bool Engine::TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
       std::vector<int64_t> seg_count, seg_off;
       EvenSegments(count, L, &seg_count, &seg_off);
       RingSpec shm = ShmRingSpec();
+      shm.compressed = compressed_payload;
       timeline_.ActivityStartCh(name, "SHM_CH0", 1);
       bool ok = RingReduceScatterPhaseCh(base, seg_count, seg_off, dtype,
                                          op, shm, 0, &detail);
@@ -3397,8 +3753,21 @@ bool Engine::TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
   }
   if (p == 0 && nnodes_ > 1) {
     RingSpec cross = CrossRingSpec();
-    if (!ChanneledRingAllreduce(base, count, dtype, op, cross, ctx, name,
-                                &detail)) {
+    // Quantized wire compresses exactly the hop that crosses a real
+    // network: the leaders' cross-host ring.  The intra-host shm phases
+    // above stay at the buffer's dtype (intra-host bandwidth is cheap;
+    // skipping their requantization also halves the accumulated error).
+    bool ok;
+    if ((wire == WireDtype::INT8 || wire == WireDtype::FP8) &&
+        dtype == DataType::FLOAT32) {
+      ok = CompressedRingAllreduce(base, count, wire, op, cross, ctx, name,
+                                   &detail);
+    } else {
+      cross.compressed = compressed_payload;
+      ok = ChanneledRingAllreduce(base, count, dtype, op, cross, ctx, name,
+                                  &detail);
+    }
+    if (!ok) {
       *err = TransportError(
           "two-level allreduce (cross ring)", name, detail,
           group_leaders_[(node_id_ + 1) % nnodes_],
@@ -3444,34 +3813,111 @@ void Engine::ExecAllreduce(const Response& response,
     bool ok;
     std::string msg;
     auto t0 = std::chrono::steady_clock::now();
-    const bool small =
-        UseSmallAlgo(total * static_cast<int64_t>(esize), ctx);
+    // Committed wire format for this response (negotiated + validated;
+    // FP32 unless every rank requested otherwise for an fp32 allreduce).
+    WireDtype wire = dtype == DataType::FLOAT32 ? response.wire_dtype
+                                                : WireDtype::FP32;
+    const bool quantized =
+        wire == WireDtype::INT8 || wire == WireDtype::FP8;
+    const bool half_wire =
+        wire == WireDtype::FP16 || wire == WireDtype::BF16;
+    // fp16/bf16 wire: RNE-convert the whole payload to a half staging
+    // buffer ONCE, run the ordinary collective at the half dtype (flat
+    // ring, star fold, or the full two-level hierarchy — every transport
+    // and path works unchanged), convert back at the end.  Wire traffic,
+    // fusion staging and reduction all halve.
+    std::vector<uint16_t> halfbuf;
+    uint8_t* exec_buf = static_cast<uint8_t*>(buf);
+    DataType exec_dtype = dtype;
+    if (half_wire) {
+      halfbuf.resize(static_cast<size_t>(total));
+      const float* fp = static_cast<const float*>(buf);
+      auto q0 = std::chrono::steady_clock::now();
+      if (wire == WireDtype::FP16) {
+        for (int64_t i = 0; i < total; ++i) halfbuf[i] = FloatToHalf(fp[i]);
+      } else {
+        for (int64_t i = 0; i < total; ++i) halfbuf[i] = FloatToBF16(fp[i]);
+      }
+      quantize_ns_.fetch_add(
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now() - q0)
+              .count());
+      wire_bytes_saved_.fetch_add(total * 2);  // 4 -> 2 bytes per element
+      exec_buf = reinterpret_cast<uint8_t*>(halfbuf.data());
+      exec_dtype = wire == WireDtype::FP16 ? DataType::FLOAT16
+                                           : DataType::BFLOAT16;
+    }
+    switch (wire) {
+      case WireDtype::FP16: wire_fp16_count_.fetch_add(1); break;
+      case WireDtype::BF16: wire_bf16_count_.fetch_add(1); break;
+      case WireDtype::INT8: wire_int8_count_.fetch_add(1); break;
+      case WireDtype::FP8: wire_fp8_count_.fetch_add(1); break;
+      case WireDtype::FP32: break;
+    }
+    if (wire != WireDtype::FP32) {
+      // Per-response WIRE<dtype> marker: compressed responses are
+      // visible in traces next to their ALGO marker.
+      char wm[16];
+      std::snprintf(wm, sizeof(wm), "WIRE_%s", WireDtypeName(wire));
+      for (char* c = wm; *c; ++c) *c = static_cast<char>(toupper(*c));
+      timeline_.Algo(tname, wm);
+    }
+    const int64_t exec_bytes =
+        total * static_cast<int64_t>(DataTypeSize(exec_dtype));
+    // Quantized responses skip the star fold: its gather/fold path has
+    // no block semantics, and sub-threshold payloads gain nothing from
+    // compression anyway.  Deterministic across ranks — the wire format
+    // is committed per response.
+    const bool small = UseSmallAlgo(exec_bytes, ctx) && !quantized;
     // One ALGO marker per response: which path this allreduce took (the
     // two-level intra phase applies the same size-based selection).
     timeline_.Algo(tname, small ? "ALGO_SMALL" : "ALGO_RING");
     (small ? algo_small_count_ : algo_ring_count_).fetch_add(1);
     if (two_level_) {
       timeline_.ActivityStart(tname, "TWO_LEVEL_ALLREDUCE");
-      ok = TwoLevelAllreduce(static_cast<uint8_t*>(buf), total, dtype,
-                             response.red_op, tname, ctx, &msg);
+      ok = TwoLevelAllreduce(exec_buf, total, exec_dtype,
+                             response.red_op, tname, ctx,
+                             quantized ? wire : WireDtype::FP32,
+                             half_wire, &msg);
     } else if (small) {
       // Whole-world host group: the star fold IS the collective —
       // 2 shm hops instead of 2(N-1) ring steps, bit-equal by the fold-
       // order emulation.
       timeline_.ActivityStart(tname, "STAR_ALLREDUCE");
-      ok = StarFoldAllreduce(static_cast<uint8_t*>(buf), total, dtype,
+      ok = StarFoldAllreduce(exec_buf, total, exec_dtype,
                              response.red_op, /*broadcast_result=*/true,
                              &msg);
     } else {
       timeline_.ActivityStart(tname, "RING_ALLREDUCE");
       std::string err;
       RingSpec spec = FlatRingSpec();
-      ok = ChanneledRingAllreduce(static_cast<uint8_t*>(buf), total, dtype,
-                                  response.red_op, spec, ctx, tname, &err);
+      if (quantized) {
+        ok = CompressedRingAllreduce(exec_buf, total, wire,
+                                     response.red_op, spec, ctx, tname,
+                                     &err);
+      } else {
+        spec.compressed = half_wire;
+        ok = ChanneledRingAllreduce(exec_buf, total, exec_dtype,
+                                    response.red_op, spec, ctx, tname,
+                                    &err);
+      }
       if (!ok) {
         msg = TransportError("allreduce", tname, err, (rank_ + 1) % size_,
                              (rank_ - 1 + size_) % size_);
       }
+    }
+    if (ok && half_wire) {
+      float* fp = static_cast<float*>(buf);
+      auto q0 = std::chrono::steady_clock::now();
+      if (wire == WireDtype::FP16) {
+        for (int64_t i = 0; i < total; ++i) fp[i] = HalfToFloat(halfbuf[i]);
+      } else {
+        for (int64_t i = 0; i < total; ++i) fp[i] = BF16ToFloat(halfbuf[i]);
+      }
+      quantize_ns_.fetch_add(
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now() - q0)
+              .count());
     }
     int64_t wall = std::chrono::duration_cast<std::chrono::nanoseconds>(
                        std::chrono::steady_clock::now() - t0)
@@ -3878,11 +4324,19 @@ void Engine::MaybeInjectFault() {
 int64_t Engine::Enqueue(RequestType type, const std::string& name,
                         DataType dtype, const std::vector<int64_t>& shape,
                         void* data, int root_rank, ReduceOp red_op,
-                        bool probe) {
+                        bool probe, int wire_dtype) {
   MaybeInjectFault();
   if (!initialized_.load() || shutdown_requested_.load() ||
       shut_down_.load()) {
     return -2;
+  }
+  // Resolve the wire format at enqueue time: per-tensor override wins,
+  // else the live global knob; compression only ever applies to FLOAT32
+  // allreduce payloads (probes included — they are dense allreduces).
+  WireDtype wire = WireDtype::FP32;
+  if (type == RequestType::ALLREDUCE && dtype == DataType::FLOAT32) {
+    int wv = wire_dtype >= 0 ? wire_dtype : wire_dtype_.load();
+    if (wv >= 1 && wv <= 4) wire = static_cast<WireDtype>(wv);
   }
   int64_t handle = next_handle_.fetch_add(1);
   auto hs = std::make_shared<HandleState>();
@@ -3898,6 +4352,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   e.data = data;
   e.root_rank = root_rank;
   e.red_op = red_op;
+  e.wire_dtype = wire;
   e.handle = handle;
 
   Request q;
@@ -3908,6 +4363,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   q.root_rank = root_rank;
   q.red_op = red_op;
   q.probe = probe;
+  q.wire_dtype = wire;
   q.shape = shape;
 
   {

@@ -56,6 +56,10 @@ struct TensorTableEntry {
   void* data = nullptr;   // caller-owned; in/out for allreduce & broadcast
   int root_rank = -1;
   ReduceOp red_op = ReduceOp::SUM;
+  // Resolved wire format this entry was REQUESTED with (global knob or
+  // per-tensor override at enqueue time) — part of the cache signature
+  // and of any resubmitted Request, so renegotiations keep the format.
+  WireDtype wire_dtype = WireDtype::FP32;
   int64_t handle = -1;
 };
 
@@ -143,10 +147,14 @@ class Engine {
   // it completes normally unless peers are gathering the tensor sparsely,
   // in which case the handle fails with the magic "__sparse_retry__:<dim>"
   // error and the caller re-enqueues zero-entry sparse gathers.
+  // `wire_dtype` < 0 uses the live global knob (HOROVOD_WIRE_DTYPE /
+  // TUNE); >= 0 is a per-tensor override.  Only FLOAT32 allreduces ever
+  // wire compressed; everything else is forced to the fp32 wire (i.e.
+  // its own dtype's bytes, exactly the pre-compression engine).
   int64_t Enqueue(RequestType type, const std::string& name, DataType dtype,
                   const std::vector<int64_t>& shape, void* data,
                   int root_rank, ReduceOp red_op = ReduceOp::SUM,
-                  bool probe = false);
+                  bool probe = false, int wire_dtype = -1);
 
   // Execution stats (readable from any thread).  `exec_cycles` counts
   // negotiation cycles that executed at least one response on this rank;
@@ -219,6 +227,24 @@ class Engine {
   bool shm_enabled() const { return shm_enabled_; }
   int64_t algo_threshold() const { return algo_threshold_.load(); }
 
+  // Wire-compression observability.  `wire_bytes_saved` sums, per
+  // compressed allreduce response, logical payload bytes minus
+  // wire-representation bytes (buffer-level: how much smaller the wire
+  // format is; ring traffic scales it by ~2(N-1)/N).
+  // `compressed_bytes_tx` sums ring payload bytes this rank sent in a
+  // compressed wire format; `quantize_ns` is cumulative thread-time in
+  // the (de)quantization kernels; the per-mode counters count allreduce
+  // RESPONSES executed under each wire format.
+  int64_t wire_bytes_saved() const { return wire_bytes_saved_.load(); }
+  int64_t compressed_bytes_tx() const { return compressed_bytes_tx_.load(); }
+  int64_t quantize_ns() const { return quantize_ns_.load(); }
+  int64_t wire_fp16_count() const { return wire_fp16_count_.load(); }
+  int64_t wire_bf16_count() const { return wire_bf16_count_.load(); }
+  int64_t wire_int8_count() const { return wire_int8_count_.load(); }
+  int64_t wire_fp8_count() const { return wire_fp8_count_.load(); }
+  // Effective default wire dtype (live-tunable knob #6).
+  int wire_dtype() const { return wire_dtype_.load(); }
+
   // Effective (currently in-force) values of the live-tunable knobs plus
   // the wiring-time ones, for stats()["config"]: post-TUNE, not the env
   // default — an operator reading stats sees what the engine is actually
@@ -245,7 +271,7 @@ class Engine {
   // Returns 0 queued, -1 when not initialized or not the coordinator.
   int QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
                 int64_t cycle_time_ms, int64_t wave_width,
-                int64_t algo_threshold, bool commit);
+                int64_t algo_threshold, int64_t wire_dtype, bool commit);
 
   // Why the engine aborted ("" while healthy or after a clean shutdown).
   // Safe to call from any thread: the background thread publishes
@@ -336,11 +362,29 @@ class Engine {
     ShmRing* shm_rx = nullptr;   // shm: recv from ring-prev
     bool is_shm() const { return shm_tx != nullptr; }
   };
+  // Block codec for a quantized (int8/fp8) wire: the ring's "element"
+  // becomes one BLOCK of ``[fp32 scale][block_elems quantized values]``
+  // (block sized to HOROVOD_CHUNK_BYTES worth of fp32 elements, last
+  // block zero-padded), so segment arithmetic, channel sharding and the
+  // chunk cascade all run unchanged over uniform block_bytes elements —
+  // only the reduction kernel swaps to dequantize-combine-requantize
+  // through fp32 staging.
+  struct WireCodec {
+    WireDtype wire = WireDtype::INT8;
+    int64_t block_elems = 0;     // fp32 elements per block
+    size_t block_bytes = 0;      // 4 (scale) + block_elems quantized bytes
+  };
   struct RingSpec {
     int vrank = 0;
     int rsize = 1;
     std::vector<RingPort> ports;       // indexed by global channel id
     const char* span = "RING_CH";      // timeline activity prefix
+    // Non-null: payload is block-quantized wire format (see WireCodec) —
+    // the phases reduce blocks instead of elements.  `compressed` also
+    // covers the fp16/bf16 staging wires (no codec, but the bytes on
+    // this spec's ports are compressed payload → compressed_bytes_tx).
+    const WireCodec* codec = nullptr;
+    bool compressed = false;
   };
 
   struct ExecCtx {
@@ -423,6 +467,21 @@ class Engine {
                              const std::vector<ChannelSegs>& channels,
                              DataType dtype, ReduceOp op,
                              const RingSpec& spec, std::string* err);
+  // Compressed-wire allreduce over `spec`: quantize the fp32 payload
+  // into the wire representation (fp16/bf16 halves, or int8/fp8 scaled
+  // blocks), run the SAME channel-sharded streaming ring over the wire
+  // buffer, dequantize back.  Deterministic for a fixed world (RNE
+  // quantization, fixed ring schedule); per-hop requantization makes it
+  // value-lossy by design — convergence tests, not bitwise ones.
+  bool CompressedRingAllreduce(uint8_t* base, int64_t count,
+                               WireDtype wire, ReduceOp op,
+                               RingSpec spec, const ExecCtx& ctx,
+                               const std::string& tname, std::string* err);
+  // The codec's reduction kernel: dequantize both blocks, combine in
+  // fp32, rescale + requantize into dst.  Timed into reduce_ns_.
+  void WireReduceBlocksTimed(uint8_t* dst, const uint8_t* src,
+                             int64_t nblocks, const WireCodec& codec,
+                             ReduceOp op);
   // ReduceInto + reduce_ns accounting; splits reductions at or above
   // max(2 MB, 2x the pipeline chunk) across idle pool workers (disjoint
   // element ranges — bit-equal to serial; pipeline-chunk reduces stay
@@ -587,10 +646,16 @@ class Engine {
     DataType dtype = DataType::FLOAT32;
     int32_t root_rank = -1;
     ReduceOp red_op = ReduceOp::SUM;
+    // Wire dtype is part of the signature: a live retune of the wire
+    // knob changes new requests' signatures, evicting the slot and
+    // renegotiating — a cached response can never replay a stale wire
+    // format.
+    WireDtype wire_dtype = WireDtype::FP32;
     std::vector<int64_t> shape;
     bool Matches(const Request& q) const {
       return q.type == type && q.dtype == dtype && q.root_rank == root_rank &&
-             q.red_op == red_op && q.shape == shape;
+             q.red_op == red_op && q.wire_dtype == wire_dtype &&
+             q.shape == shape;
     }
   };
   struct CacheEntry {
@@ -693,8 +758,10 @@ class Engine {
   // never change segment arithmetic — only the bytes' route.
   RingSpec FlatRingSpec();
   // Count payload bytes moved on a port (data_bytes_* always; the shm/
-  // intra-host counters when the port is an shm edge).
-  void CountPortBytes(const RingPort& port, int64_t tx, int64_t rx);
+  // intra-host counters when the port is an shm edge; compressed_bytes_tx
+  // when the bytes are wire-compressed payload).
+  void CountPortBytes(const RingPort& port, int64_t tx, int64_t rx,
+                      bool compressed = false);
   // Transport-generic primitives on one ring port (TCP socket pair or shm
   // edge) — the phase/relay code calls these and never branches on the
   // channel kind itself.  `patience_rounds` scales the shm no-progress
@@ -715,9 +782,16 @@ class Engine {
   // broadcast back down.  Deterministic per topology; value-independent
   // of transport, channels, and the algo threshold (the star emulates the
   // ring's exact per-segment fold order).
+  // `wire`: INT8/FP8 compress ONLY the leader cross-host ring (the hop
+  // that crosses a real network); the intra-host shm phases stay at the
+  // buffer's dtype.  fp16/bf16 wires never reach here as `wire` —
+  // ExecAllreduce stages the whole collective to a half buffer first
+  // and passes `compressed_payload` so the ring phases still account
+  // the bytes into compressed_bytes_tx.
   bool TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
                          ReduceOp op, const std::string& name,
-                         const ExecCtx& ctx, std::string* err);
+                         const ExecCtx& ctx, WireDtype wire,
+                         bool compressed_payload, std::string* err);
   // Star (gather→fold→broadcast) allreduce within the host group: every
   // member ships its buffer to the leader over shm, the leader reproduces
   // the ring reduce-scatter's per-segment fold ORDER exactly (same
@@ -756,6 +830,12 @@ class Engine {
   // every rank must agree or the wire patterns split).  Value-neutral by
   // construction: the star reproduces the ring's exact fold order.
   std::atomic<int64_t> algo_threshold_{32 * 1024};
+  // HOROVOD_WIRE_DTYPE: default wire format for fp32 allreduce payloads
+  // (WireDtype values; live-tunable knob #6).  Per-rank agreement comes
+  // from negotiation, not from this knob: every Request carries its
+  // resolved wire dtype and the coordinator validates cross-rank, so a
+  // heterogeneous env surfaces as a clean error — never a garbled wire.
+  std::atomic<int> wire_dtype_{0};
   // HOROVOD_SHM_RING_BYTES: per-direction shm ring capacity.
   int64_t shm_ring_bytes_ = 2 << 20;
   // Concurrent-response wave width: how many independent responses of
@@ -790,6 +870,7 @@ class Engine {
     int32_t cycle_time_ms = 0;
     int32_t wave_width = 0;
     int64_t algo_threshold = -1;  // < 0: leave unchanged (0 is a real value)
+    int32_t wire_dtype = -1;      // < 0: leave unchanged (0 = fp32 is real)
     bool commit = false;
   };
   std::mutex tune_mu_;
@@ -831,6 +912,13 @@ class Engine {
   std::atomic<int64_t> algo_small_count_{0};
   std::atomic<int64_t> algo_ring_count_{0};
   std::atomic<int64_t> tune_trials_{0};
+  std::atomic<int64_t> wire_bytes_saved_{0};
+  std::atomic<int64_t> compressed_bytes_tx_{0};
+  std::atomic<int64_t> quantize_ns_{0};
+  std::atomic<int64_t> wire_fp16_count_{0};
+  std::atomic<int64_t> wire_bf16_count_{0};
+  std::atomic<int64_t> wire_int8_count_{0};
+  std::atomic<int64_t> wire_fp8_count_{0};
 
   // -- timeline --
   Timeline timeline_;

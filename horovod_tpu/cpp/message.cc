@@ -12,6 +12,7 @@ static void SerializeRequest(const Request& q, Writer* w) {
   w->i32(q.root_rank);
   w->u8(static_cast<uint8_t>(q.red_op));
   w->u8(q.probe ? 1 : 0);
+  w->u8(static_cast<uint8_t>(q.wire_dtype));
   w->u32(static_cast<uint32_t>(q.shape.size()));
   for (auto d : q.shape) w->i64(d);
 }
@@ -24,6 +25,7 @@ static bool ParseRequest(Reader* r, Request* q) {
   q->root_rank = r->i32();
   q->red_op = static_cast<ReduceOp>(r->u8());
   q->probe = r->u8() != 0;
+  q->wire_dtype = static_cast<WireDtype>(r->u8());
   uint32_t nd = r->u32();
   q->shape.clear();
   for (uint32_t i = 0; i < nd && r->ok(); ++i) q->shape.push_back(r->i64());
@@ -100,6 +102,7 @@ static void SerializeResponse(const Response& s, Writer* w) {
   for (auto v : s.tensor_sizes) w->i64(v);
   w->i32(s.root_rank);
   w->u8(static_cast<uint8_t>(s.red_op));
+  w->u8(static_cast<uint8_t>(s.wire_dtype));
   w->u32(static_cast<uint32_t>(s.cache_slots.size()));
   for (auto c : s.cache_slots) w->i32(c);
 }
@@ -115,6 +118,7 @@ static bool ParseResponse(Reader* r, Response* s) {
   for (uint32_t i = 0; i < m && r->ok(); ++i) s->tensor_sizes.push_back(r->i64());
   s->root_rank = r->i32();
   s->red_op = static_cast<ReduceOp>(r->u8());
+  s->wire_dtype = static_cast<WireDtype>(r->u8());
   uint32_t c = r->u32();
   s->cache_slots.clear();
   for (uint32_t i = 0; i < c && r->ok(); ++i) s->cache_slots.push_back(r->i32());
@@ -145,6 +149,7 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
     w->i32(list.tune_cycle_time_ms);
     w->i32(list.tune_wave_width);
     w->i64(list.tune_algo_threshold);
+    w->i32(list.tune_wire_dtype);
   }
 }
 
@@ -170,6 +175,7 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
     out->tune_cycle_time_ms = r->i32();
     out->tune_wave_width = r->i32();
     out->tune_algo_threshold = r->i64();
+    out->tune_wire_dtype = r->i32();
   }
   return r->ok();
 }

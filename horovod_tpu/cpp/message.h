@@ -87,6 +87,12 @@ struct Request {
   // sees peers gathering the tensor sparsely, in which case the probing
   // ranks get a SPARSE_RETRY response instead of a deadlock.
   bool probe = false;
+  // Requested WIRE format for this tensor's allreduce payload (see
+  // common.h WireDtype).  Validated cross-rank exactly like dtype: the
+  // coordinator commits ONE wire format per response and a mismatch is a
+  // clean negotiated error naming the ranks.  Always FP32 for non-fp32
+  // tensors and non-allreduce ops.
+  WireDtype wire_dtype = WireDtype::FP32;
   std::vector<int64_t> shape;
 };
 
@@ -122,6 +128,10 @@ struct Response {
   std::vector<int64_t> tensor_sizes;
   int32_t root_rank = -1;
   ReduceOp red_op = ReduceOp::SUM;
+  // Committed wire format for this (possibly fused) allreduce response:
+  // every rank validated-ly requested it, so the data plane quantizes/
+  // dequantizes identically on all of them.  FP32 everywhere else.
+  WireDtype wire_dtype = WireDtype::FP32;
   // Parallel to tensor_names: the cache slot the coordinator assigned to
   // each tensor (-1 = uncached).  Every rank inserts (name → slot,
   // slot → single-tensor response) into its local cache replica on
@@ -176,6 +186,11 @@ struct ResponseList {
   // Unlike the knobs above, 0 is a REAL value (small path disabled), so
   // "leave unchanged" is < 0.
   int64_t tune_algo_threshold = -1;
+  // Live-tunable default wire dtype (the 6th knob): 0 (fp32) is a real
+  // value, so "leave unchanged" is < 0.  Applies to enqueues AFTER the
+  // frame lands; in-flight negotiations keep their requested format, and
+  // the signature change evicts affected cache slots naturally.
+  int32_t tune_wire_dtype = -1;
 };
 
 // Flat byte-buffer serialization (host byte order; in-cluster only).

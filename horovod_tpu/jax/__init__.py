@@ -217,7 +217,42 @@ def allreduce_gradients(grads, *, axis_name=None, op=Average,
 
     ``axis_name`` may be a name, tuple of names, or None (= every data-like
     axis of the default mesh: ``data`` and ``fsdp``).
+
+    Traced gradients (inside jit/shard_map) reduce as fused XLA
+    collectives.  CONCRETE gradients — the host-driven DCN path — go
+    through the eager engine per leaf, with stable tree-path names: that
+    is what lets ``compression=Compression.topk(...)`` keep one
+    error-feedback residual per gradient leaf, and the wire-level
+    compressors (``Compression.wire_int8`` etc.) negotiate their wire
+    dtype per tensor.
     """
+    leaves = jax.tree.leaves(grads)
+    if leaves and not _is_traced(leaves[0]):
+        from horovod_tpu.ops.compression import TopKCompressor
+        from horovod_tpu.runtime import eager
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        if isinstance(compression, TopKCompressor):
+            # Sparse path: per-leaf residuals keyed by stable tree-path
+            # names.  Sequential by nature (each leaf is two allgathers
+            # plus a host scatter-add) — top-k is the opt-in
+            # bandwidth-starved regime where that trade is the point.
+            out = [
+                eager.allreduce(
+                    leaf, op=op, compression=compression,
+                    name="grad" + (jax.tree_util.keystr(path) or f".{i}"))
+                for i, (path, leaf) in enumerate(flat)
+            ]
+        else:
+            # Dense/wire path: enqueue every leaf before draining any —
+            # one negotiation cycle covers the burst and the engine's
+            # response fusion batches same-dtype/same-wire leaves into
+            # few ring collectives (a per-leaf synchronous loop would
+            # serialize N round trips and defeat fusion entirely).
+            out = eager.grouped_allreduce(
+                [leaf for _, leaf in flat], op=op,
+                compression=compression, name="grad")
+        return jax.tree_util.tree_unflatten(treedef, out)
     if axis_name is None:
         axis_name = _mesh.data_axes() or ("data",)
 

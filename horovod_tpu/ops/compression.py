@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["Compressor", "NoneCompressor", "FP16Compressor", "BF16Compressor", "Compression"]
+__all__ = ["Compressor", "NoneCompressor", "FP16Compressor",
+           "BF16Compressor", "WireCompressor", "TopKCompressor",
+           "Compression"]
 
 
 class Compressor:
@@ -73,9 +75,88 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class WireCompressor(Compressor):
+    """WIRE-level compression: the tensor stays fp32 end to end in user
+    code (compress/decompress are identities); the native engine
+    quantizes on send and dequantizes-reduces-requantizes on the ring
+    with per-chunk scales (``HOROVOD_WIRE_DTYPE`` semantics, negotiated
+    and validated cross-rank).  Host/eager collectives only — inside
+    jit the collective is an XLA op and this degrades to a no-op."""
+
+    engine_wire_dtype: str = "fp32"
+
+    @classmethod
+    def compress(cls, tensor):
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor
+
+
+class _WireFP16(WireCompressor):
+    engine_wire_dtype = "fp16"
+
+
+class _WireBF16(WireCompressor):
+    engine_wire_dtype = "bf16"
+
+
+class _WireInt8(WireCompressor):
+    engine_wire_dtype = "int8"
+
+
+class _WireFP8(WireCompressor):
+    engine_wire_dtype = "fp8"
+
+
+class TopKCompressor:
+    """Top-k sparse allreduce spec with error-feedback residuals (Deep
+    Gradient Compression, Lin et al. 2018).  NOT a cast compressor: the
+    eager allreduce path recognizes instances and routes the collective
+    through :func:`horovod_tpu.runtime.sparse.sparse_allreduce_topk`,
+    which keeps one residual buffer per tensor NAME (i.e. per gradient
+    leaf) and clears it per membership epoch.  Host/eager collectives
+    only — inside jit the collective is an XLA op and this degrades to a
+    dense allreduce."""
+
+    def __init__(self, ratio=None, error_feedback: bool = True):
+        # None defers to the HOROVOD_SPARSE_TOPK env default (resolved
+        # per call by sparse_allreduce_topk) — the documented knob.
+        if ratio is not None and not 0.0 < ratio <= 1.0:
+            raise ValueError(f"topk ratio must be in (0, 1], got {ratio}")
+        self.ratio = float(ratio) if ratio is not None else None
+        self.error_feedback = bool(error_feedback)
+
+    # Identity compress/decompress so code that treats every member of
+    # the registry as a cast compressor (the traced/jit path) still
+    # composes — it just gets the dense collective.
+    def compress(self, tensor):
+        return tensor, None
+
+    def decompress(self, tensor, ctx):
+        return tensor
+
+
 class Compression:
-    """Registry of compression algorithms (reference compression.py:67-74)."""
+    """Registry of compression algorithms (reference compression.py:67-74).
+
+    ``none``/``fp16``/``bf16`` are the reference's FRONTEND casts (the
+    tensor itself changes dtype).  ``wire_fp16``/``wire_bf16``/
+    ``wire_int8``/``wire_fp8`` compress at the WIRE level instead — the
+    engine carries quantized bytes with per-chunk scales and hands back
+    fp32 — and ``topk(ratio)`` builds a sparse top-k spec with
+    error-feedback residuals per gradient leaf."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    wire_fp16 = _WireFP16
+    wire_bf16 = _WireBF16
+    wire_int8 = _WireInt8
+    wire_fp8 = _WireFP8
+
+    @staticmethod
+    def topk(ratio=None, error_feedback: bool = True) -> TopKCompressor:
+        """``ratio=None`` defers to HOROVOD_SPARSE_TOPK (default 0.01)."""
+        return TopKCompressor(ratio, error_feedback)

@@ -29,7 +29,7 @@ import numpy as np
 from horovod_tpu.common.basics import basics
 from horovod_tpu.ops.collective_ops import (Average, Max, Min,
                                              Product, ReduceOp, Sum)
-from horovod_tpu.ops.compression import Compression
+from horovod_tpu.ops.compression import Compression, TopKCompressor
 
 __all__ = ["allreduce", "grouped_allreduce", "allgather", "broadcast",
            "reducescatter", "alltoall"]
@@ -49,11 +49,44 @@ _WIRE_OPS = {Sum: "sum", Average: "sum", Min: "min", Max: "max",
 from horovod_tpu.runtime import engine_or_none as _engine  # noqa: E402
 
 
+def _topk_spec(compression) -> Optional[TopKCompressor]:
+    return compression if isinstance(compression, TopKCompressor) else None
+
+
+def _engine_wire(compression) -> Optional[str]:
+    """A WireCompressor's engine wire dtype ("int8", ...), else None —
+    the wire-level family compresses in the ENGINE (per-chunk-scaled
+    quantized ring), not by casting the tensor."""
+    wd = getattr(compression, "engine_wire_dtype", None)
+    return wd if wd in ("fp16", "bf16", "int8", "fp8") else None
+
+
 def allreduce(tensor, *, op=Average, average=None,
               compression=Compression.none, name: Optional[str] = None):
     op = _resolve_op(op, average)
     eng = _engine()
     arr = jnp.asarray(tensor)
+    topk = _topk_spec(compression)
+    if topk is not None:
+        # Sparse top-k with error feedback: dense in, dense out, the
+        # residual keyed by the collective name.  The name is REQUIRED:
+        # auto-naming would mint a fresh name per call (residuals never
+        # accumulate), and a shared default would cross-contaminate
+        # different tensors' residual buffers — both silent corruption.
+        from horovod_tpu.runtime import sparse
+
+        if name is None:
+            raise ValueError(
+                "top-k sparse allreduce requires a stable per-tensor "
+                "name= (it keys the error-feedback residual buffer)")
+        if op not in (Average, Sum):
+            raise NotImplementedError(
+                "top-k sparse allreduce supports SUM/AVERAGE only")
+        out = sparse.sparse_allreduce_topk(
+            np.asarray(arr, dtype=np.float32), name=name,
+            ratio=topk.ratio, error_feedback=topk.error_feedback,
+            average=(op is Average))
+        return jnp.asarray(out)
     wire, ctx = compression.compress(arr)
     if eng is None:
         return compression.decompress(wire, ctx)
@@ -64,7 +97,8 @@ def allreduce(tensor, *, op=Average, average=None,
         )
     host = np.ascontiguousarray(np.asarray(wire))
     reduced = eng.allreduce(host, average=(op is Average), name=name,
-                            red_op=_WIRE_OPS[op])
+                            red_op=_WIRE_OPS[op],
+                            wire_dtype=_engine_wire(compression))
     return compression.decompress(jnp.asarray(reduced), ctx)
 
 
@@ -76,6 +110,20 @@ def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
     (reference response fusion, operations.cc:1815-1842)."""
     op = _resolve_op(op, average)
     eng = _engine()
+    topk = _topk_spec(compression)
+    if topk is not None:
+        # Per-leaf residuals need stable per-tensor names; a default
+        # base would collide across different grouped call sites and
+        # cross-contaminate their residuals — require the name.
+        if name is None:
+            raise ValueError(
+                "grouped top-k sparse allreduce requires name= (per-leaf "
+                "residual buffers are keyed '<name>.<i>')")
+        return [
+            allreduce(t, op=op, compression=compression,
+                      name=f"{name}.{i}")
+            for i, t in enumerate(tensors)
+        ]
     if eng is None:
         return [
             allreduce(t, op=op, compression=compression) for t in tensors
@@ -90,10 +138,11 @@ def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
         wire, ctx = compression.compress(jnp.asarray(t))
         ctxs.append(ctx)
         hosts.append(np.ascontiguousarray(np.asarray(wire)).copy())
+    wd = _engine_wire(compression)
     handles = [
         eng.enqueue_allreduce(
             h, None if name is None else f"{name}.{i}",
-            red_op=_WIRE_OPS[op])
+            red_op=_WIRE_OPS[op], wire_dtype=wd)
         for i, h in enumerate(hosts)
     ]
     # Drain EVERY handle even when one fails: abandoning the rest would

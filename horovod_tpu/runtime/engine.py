@@ -67,6 +67,23 @@ _OP_REDUCESCATTER, _OP_ALLTOALL = 3, 4
 #: ReduceOp codes, keep in sync with cpp/message.h.
 _RED_OPS = {"sum": 0, "min": 1, "max": 2, "prod": 3}
 
+#: WireDtype codes, keep in sync with cpp/common.h (negotiated wire
+#: format for fp32 allreduce payloads; fp32 = uncompressed default).
+WIRE_DTYPES = {"fp32": 0, "fp16": 1, "bf16": 2, "int8": 3, "fp8": 4}
+_WIRE_NAMES = {v: k for k, v in WIRE_DTYPES.items()}
+
+#: Python-side counter for top-k sparse allreduces (the sparse path
+#: rides the engine's allgather wire; the engine itself cannot tell a
+#: sparse gather from any other).  Cumulative like the C counters, so
+#: stats_delta() handles it transparently.
+_SPARSE_COUNT = 0
+
+
+def note_sparse_allreduce() -> None:
+    """Called by runtime.sparse once per completed sparse allreduce."""
+    global _SPARSE_COUNT
+    _SPARSE_COUNT += 1
+
 
 def _dtype_code(dtype) -> int:
     name = np.dtype(dtype).name if np.dtype(dtype).name in _DTYPE_CODES \
@@ -98,6 +115,15 @@ class NativeEngine:
             ctypes.c_int,
         ]
         lib.horovod_enqueue.restype = ctypes.c_int64
+        try:
+            lib.horovod_enqueue_wire.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.horovod_enqueue_wire.restype = ctypes.c_int64
+        except AttributeError:
+            pass  # stale .so: per-tensor wire overrides raise in _enqueue
         lib.horovod_enqueue_probe.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
@@ -162,6 +188,14 @@ class NativeEngine:
                         "horovod_topology_local_ranks",
                         "horovod_shm_enabled",
                         "horovod_algo_threshold",
+                        "horovod_wire_bytes_saved",
+                        "horovod_compressed_bytes_tx",
+                        "horovod_quantize_ns",
+                        "horovod_wire_fp16_count",
+                        "horovod_wire_bf16_count",
+                        "horovod_wire_int8_count",
+                        "horovod_wire_fp8_count",
+                        "horovod_wire_dtype",
                         "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
@@ -178,7 +212,8 @@ class NativeEngine:
         try:
             lib.horovod_autotune_set.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-                ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int,
             ]
             lib.horovod_autotune_set.restype = ctypes.c_int
         except AttributeError:
@@ -241,13 +276,30 @@ class NativeEngine:
     # -- async enqueue API --
 
     def _enqueue(self, op: int, arr: np.ndarray, name: str,
-                 root_rank: int = -1, red_op: str = "sum") -> int:
+                 root_rank: int = -1, red_op: str = "sum",
+                 wire_dtype: Optional[str] = None) -> int:
         shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
-        handle = self._lib.horovod_enqueue(
-            op, name.encode(), _dtype_code(arr.dtype), arr.ndim, shape,
-            arr.ctypes.data_as(ctypes.c_void_p), root_rank,
-            _RED_OPS[red_op],
-        )
+        if wire_dtype is not None:
+            if wire_dtype not in WIRE_DTYPES:
+                raise ValueError(
+                    f"unknown wire_dtype {wire_dtype!r} "
+                    f"(want one of {sorted(WIRE_DTYPES)})")
+            fn = getattr(self._lib, "horovod_enqueue_wire", None)
+            if getattr(fn, "restype", None) is not ctypes.c_int64:
+                raise RuntimeError(
+                    "libhorovod_core.so predates per-tensor wire dtypes — "
+                    "rebuild it with `make -C horovod_tpu/cpp`")
+            handle = fn(
+                op, name.encode(), _dtype_code(arr.dtype), arr.ndim, shape,
+                arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                _RED_OPS[red_op], WIRE_DTYPES[wire_dtype],
+            )
+        else:
+            handle = self._lib.horovod_enqueue(
+                op, name.encode(), _dtype_code(arr.dtype), arr.ndim, shape,
+                arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                _RED_OPS[red_op],
+            )
         if handle == -1:
             raise HorovodInternalError(
                 f"a collective named {name!r} is already in flight "
@@ -261,12 +313,16 @@ class NativeEngine:
 
     def enqueue_allreduce(self, arr: np.ndarray,
                           name: Optional[str] = None,
-                          red_op: str = "sum") -> int:
+                          red_op: str = "sum",
+                          wire_dtype: Optional[str] = None) -> int:
         """In-place allreduce of a contiguous array (``red_op``:
-        sum/min/max/prod). Returns handle."""
+        sum/min/max/prod).  ``wire_dtype`` (fp32/fp16/bf16/int8/fp8)
+        overrides the HOROVOD_WIRE_DTYPE wire format for THIS tensor —
+        fp32 payloads only; every rank must request the same format or
+        negotiation fails cleanly.  Returns handle."""
         return self._enqueue(
             _OP_ALLREDUCE, arr, self._auto_name("allreduce", name),
-            red_op=red_op)
+            red_op=red_op, wire_dtype=wire_dtype)
 
     def enqueue_allgather(self, arr: np.ndarray,
                           name: Optional[str] = None) -> int:
@@ -367,12 +423,12 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_algo_threshold", None),
+        if getattr(getattr(self._lib, "horovod_wire_dtype", None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the execution/control-plane/"
-                "data-plane/shm/autotune counters — rebuild it with "
-                "`make -C horovod_tpu/cpp`")
+                "libhorovod_core.so predates the wire-compression "
+                "counters (and possibly earlier counter families) — "
+                "rebuild it with `make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
         ar_bytes = self._lib.horovod_allreduce_bytes()
         ar_ns = self._lib.horovod_allreduce_ns()
@@ -407,6 +463,21 @@ class NativeEngine:
             "intra_host_bytes": self._lib.horovod_intra_host_bytes(),
             "algo_small_count": self._lib.horovod_algo_small_count(),
             "algo_ring_count": self._lib.horovod_algo_ring_count(),
+            # Wire compression (HOROVOD_WIRE_DTYPE / per-tensor wire
+            # overrides): buffer-level bytes the wire representation
+            # saved, compressed ring payload this rank sent, cumulative
+            # (de)quantization time, allreduce responses per wire mode,
+            # and top-k sparse allreduces completed on this process
+            # (Python-side: the sparse path rides the allgather wire).
+            "wire_bytes_saved": self._lib.horovod_wire_bytes_saved(),
+            "compressed_bytes_tx":
+                self._lib.horovod_compressed_bytes_tx(),
+            "quantize_ns": self._lib.horovod_quantize_ns(),
+            "wire_fp16_count": self._lib.horovod_wire_fp16_count(),
+            "wire_bf16_count": self._lib.horovod_wire_bf16_count(),
+            "wire_int8_count": self._lib.horovod_wire_int8_count(),
+            "wire_fp8_count": self._lib.horovod_wire_fp8_count(),
+            "sparse_count": _SPARSE_COUNT,
             "topology": {
                 "hosts": self._lib.horovod_topology_hosts(),
                 "local_ranks": self._lib.horovod_topology_local_ranks(),
@@ -423,6 +494,8 @@ class NativeEngine:
                 "socket_buf_bytes": self._lib.horovod_socket_buf_bytes(),
                 "shm_enabled": bool(self._lib.horovod_shm_enabled()),
                 "algo_threshold": self._lib.horovod_algo_threshold(),
+                "wire_dtype": _WIRE_NAMES.get(
+                    int(self._lib.horovod_wire_dtype()), "fp32"),
             },
         }
 
@@ -455,20 +528,27 @@ class NativeEngine:
     def autotune_set(self, *, chunk_bytes: int = 0,
                      fusion_threshold: int = 0, cycle_time_ms: int = 0,
                      wave_width: int = 0, algo_threshold: int = -1,
-                     commit: bool = False) -> bool:
+                     wire_dtype: int = -1, commit: bool = False) -> bool:
         """Queue a TUNE proposal (coordinator only): the engine
         broadcasts it in the next cycle's epoch-stamped frame and every
         rank applies it between cycles.  Values <= 0 leave that knob
-        unchanged — except ``algo_threshold``, where 0 is a real value
-        (small-tensor star path off) and "leave unchanged" is < 0.
-        Returns False when the engine refused (not initialized, not the
-        coordinator, or a stale prebuilt .so)."""
+        unchanged — except ``algo_threshold`` and ``wire_dtype``, where
+        0 is a real value (star path off / fp32 wire) and "leave
+        unchanged" is < 0.  Returns False when the engine refused (not
+        initialized, not the coordinator, or a stale prebuilt .so)."""
         fn = getattr(self._lib, "horovod_autotune_set", None)
         if getattr(fn, "restype", None) is not ctypes.c_int:
             return False
+        # A stale prebuilt .so still EXPORTS horovod_autotune_set with
+        # the old 6-arg signature — passing 7 args would land wire_dtype
+        # in its `commit` slot (-1 is truthy: every trial committed).
+        # Gate on a symbol that only exists alongside the 7-arg version.
+        if getattr(getattr(self._lib, "horovod_wire_dtype", None),
+                   "restype", None) is not ctypes.c_int64:
+            return False
         return fn(int(chunk_bytes), int(fusion_threshold),
                   int(cycle_time_ms), int(wave_width), int(algo_threshold),
-                  1 if commit else 0) == 0
+                  int(wire_dtype), 1 if commit else 0) == 0
 
     # -- handle API --
 
@@ -520,9 +600,12 @@ class NativeEngine:
 
     def allreduce(self, tensor, *, average: bool = False,
                   name: Optional[str] = None,
-                  red_op: str = "sum") -> np.ndarray:
+                  red_op: str = "sum",
+                  wire_dtype: Optional[str] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor).copy()
-        out = self.synchronize(self.enqueue_allreduce(arr, name, red_op))
+        out = self.synchronize(
+            self.enqueue_allreduce(arr, name, red_op,
+                                   wire_dtype=wire_dtype))
         return self._apply_average(out) if average else out
 
     def allgather(self, tensor, *, name: Optional[str] = None) -> np.ndarray:

@@ -144,20 +144,33 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return hook
 
     def _allreduce_grad_async(self, p):
+        from horovod_tpu.torch.compression import TopKCompressor
+
         name = self._param_names.get(id(p))
         if p.grad.is_sparse:
             if not self._sparse_as_dense:
                 self._sparse_params[id(p)] = p.grad.sparse_dim()
                 return self._sparse_allgather_async(p, name)
             p.grad = p.grad.to_dense()
+        if isinstance(self._compression, TopKCompressor) and \
+                p.grad.is_floating_point():
+            # Top-k with error feedback: deferred to synchronize() — the
+            # sparse path is two allgathers plus a host scatter-add, and
+            # the residual buffer is keyed by this param's NAME (one per
+            # gradient leaf, epoch-stamped in runtime.sparse).
+            return ("topk", p)
+        # Engine-wire compression (Compression.wire_*): the tensor stays
+        # fp32; the engine quantizes on the ring.
+        wire = getattr(self._compression, "engine_wire_dtype", None)
         tensor_compressed, ctx = self._compression.compress(p.grad.data)
         if tensor_compressed.data_ptr() == p.grad.data.data_ptr():
             # In-place reduce directly into .grad when uncompressed.
             handle = allreduce_async_(tensor_compressed, average=True,
-                                      name=name)
+                                      name=name, wire_dtype=wire)
         else:
             handle = allreduce_async_(
-                tensor_compressed.contiguous(), average=True, name=name)
+                tensor_compressed.contiguous(), average=True, name=name,
+                wire_dtype=wire)
         return handle, tensor_compressed, ctx
 
     def _sparse_allgather_async(self, p, name):
@@ -204,6 +217,9 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         case the coordinator answers SPARSE_RETRY and this rank joins the
         peers' '.idx'/'.vals' allgathers with zero entries — no warmup
         step needed, no stall."""
+        from horovod_tpu.torch.compression import TopKCompressor
+
+        topk_mode = isinstance(self._compression, TopKCompressor)
         for group in self.param_groups:
             for p in group["params"]:
                 if p.requires_grad and p not in self._handles:
@@ -213,16 +229,31 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                             p.grad = self._zero_sparse_grad(p, sd)
                         else:
                             p.grad = p.data.new_zeros(p.shape)
-                            if not self._sparse_as_dense:
+                            # No layout probe under top-k: peers submit
+                            # '<name>.topk_idx'/'.topk_val' allgathers a
+                            # dense probe could never rendezvous with.
+                            # A zero gradient takes the topk path like
+                            # everyone else (it ships top-k of its own
+                            # residual — exactly the EF semantics).
+                            if not self._sparse_as_dense and not topk_mode:
                                 self._handles[p] = self._probe_grad_async(p)
                                 continue
                     self._handles[p] = self._allreduce_grad_async(p)
         from horovod_tpu.runtime.engine import SparseGradRetry
 
+        topk_params = []
         for p, entry in self._handles.items():
             if entry[0] == "sparse":
                 _, h_idx, h_val = entry
                 self._finish_sparse(p, h_idx, h_val)
+            elif entry[0] == "topk":
+                # Deferred: the sparse allreduce is BLOCKING (two
+                # allgathers per param), and _handles insertion order
+                # follows this rank's hook-fire order — which a
+                # data-dependent graph may permute across ranks.  All
+                # topk params drain below in name-sorted order so every
+                # rank submits the same collective sequence.
+                topk_params.append(p)
             elif entry[0] == "probe":
                 _, handle, tensor_compressed, ctx = entry
                 try:
@@ -240,6 +271,28 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 output = synchronize(handle)
                 p.grad.data.set_(
                     self._compression.decompress(output, ctx).data)
+        if topk_params:
+            from horovod_tpu.runtime.sparse import sparse_allreduce_topk
+
+            def _topk_name(p):
+                name = self._param_names.get(id(p))
+                if not name:
+                    # Never fall back to an id-derived name: ids differ
+                    # across ranks, so the allgather rendezvous would
+                    # wedge until the stall detector fires.
+                    raise ValueError(
+                        "top-k compression requires every parameter to "
+                        "have a cross-rank-stable name (pass "
+                        "named_parameters=...)")
+                return name
+
+            for p in sorted(topk_params, key=_topk_name):
+                out = sparse_allreduce_topk(
+                    p.grad.detach().cpu().numpy(), name=_topk_name(p),
+                    ratio=self._compression.ratio,
+                    error_feedback=self._compression.error_feedback,
+                    average=True)
+                p.grad.data.copy_(torch.from_numpy(out))
         self._handles.clear()
 
     def _probe_grad_async(self, p):

@@ -12,7 +12,8 @@ from __future__ import annotations
 import torch
 
 __all__ = ["Compressor", "NoneCompressor", "FP16Compressor",
-           "BF16Compressor", "Compression"]
+           "BF16Compressor", "WireCompressor", "TopKCompressor",
+           "Compression"]
 
 
 class Compressor:
@@ -60,9 +61,74 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = torch.bfloat16
 
 
+class WireCompressor(Compressor):
+    """WIRE-level compression: identity on the tensor; the native engine
+    carries per-chunk-scaled quantized bytes (HOROVOD_WIRE_DTYPE
+    semantics, negotiated cross-rank) and hands back fp32."""
+
+    engine_wire_dtype: str = "fp32"
+
+    @classmethod
+    def compress(cls, tensor):
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor
+
+
+class _WireFP16(WireCompressor):
+    engine_wire_dtype = "fp16"
+
+
+class _WireBF16(WireCompressor):
+    engine_wire_dtype = "bf16"
+
+
+class _WireInt8(WireCompressor):
+    engine_wire_dtype = "int8"
+
+
+class _WireFP8(WireCompressor):
+    engine_wire_dtype = "fp8"
+
+
+class TopKCompressor:
+    """Top-k sparse allreduce spec with error-feedback residuals, keyed
+    per parameter name by ``DistributedOptimizer`` (the residual state
+    lives in horovod_tpu.runtime.sparse, epoch-stamped so an elastic
+    resize clears it)."""
+
+    def __init__(self, ratio=None, error_feedback: bool = True):
+        # None defers to the HOROVOD_SPARSE_TOPK env default (resolved
+        # per call by sparse_allreduce_topk) — the documented knob.
+        if ratio is not None and not 0.0 < ratio <= 1.0:
+            raise ValueError(f"topk ratio must be in (0, 1], got {ratio}")
+        self.ratio = float(ratio) if ratio is not None else None
+        self.error_feedback = bool(error_feedback)
+
+    def compress(self, tensor):
+        return tensor, None
+
+    def decompress(self, tensor, ctx):
+        return tensor
+
+
 class Compression:
-    """Registry (reference compression.py:67-74)."""
+    """Registry (reference compression.py:67-74).  ``fp16``/``bf16``
+    cast the tensor itself; the ``wire_*`` members compress at the wire
+    level inside the engine, and ``topk(ratio)`` selects the sparse
+    error-feedback path per parameter."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    wire_fp16 = _WireFP16
+    wire_bf16 = _WireBF16
+    wire_int8 = _WireInt8
+    wire_fp8 = _WireFP8
+
+    @staticmethod
+    def topk(ratio=None, error_feedback: bool = True) -> TopKCompressor:
+        """``ratio=None`` defers to HOROVOD_SPARSE_TOPK (default 0.01)."""
+        return TopKCompressor(ratio, error_feedback)

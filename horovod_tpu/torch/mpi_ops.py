@@ -122,13 +122,16 @@ def _div_in_place(t: torch.Tensor, n: int) -> torch.Tensor:
 
 
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
-                     name: Optional[str] = None) -> int:
-    """In-place async sum/average over all processes."""
+                     name: Optional[str] = None,
+                     wire_dtype: Optional[str] = None) -> int:
+    """In-place async sum/average over all processes.  ``wire_dtype``
+    (fp32/fp16/bf16/int8/fp8) overrides the engine's HOROVOD_WIRE_DTYPE
+    wire format for this tensor (fp32 payloads only)."""
     eng = _engine()
     if eng is None:
         return _local_handle(tensor)  # sum over 1 rank = identity
     view = _np_view(tensor)
-    handle = eng.enqueue_allreduce(view, name)
+    handle = eng.enqueue_allreduce(view, name, wire_dtype=wire_dtype)
 
     def post(t, _out):
         return _div_in_place(t, basics.size()) if average else t

@@ -221,6 +221,54 @@ def scenario_stale(rank, size, eng):
         assert s["stale_epoch_msgs"] >= 1, s["stale_epoch_msgs"]
 
 
+def scenario_wire_sweep(rank, size, eng):
+    # The wire-dtype knob in the live search (HOROVOD_AUTOTUNE_WIRE=1,
+    # knobs restricted to wire_dtype): the tuner must try fp32/fp16/int8,
+    # score each on EFFECTIVE bus bandwidth (allreduce_bytes counts
+    # LOGICAL payload, so compressed trials are scored on pre-compression
+    # bytes over wall time), converge, and commit a wire_dtype.  The
+    # value loop tolerates the compressed trials' quantization error —
+    # that is the knob's documented trade (and why it's opt-in).  Under
+    # the stale-epoch fault injection the same body doubles as the
+    # "stale TUNE/control frames while wire-tuning are structurally
+    # dropped" regression test.
+    from horovod_tpu.autotune import get_tuner
+
+    tuner = get_tuner() if rank == 0 else None
+    if rank == 0:
+        assert tuner is not None
+    expected = size * (size + 1) / 2.0
+    keep, steps = 1, 0
+    while keep:
+        x = np.full(_MiB, float(rank + 1), dtype=np.float32)
+        out = eng.synchronize(eng.enqueue_allreduce(x, name="at.w"))
+        # int8 trial error bound: ~maxabs/127 per quantization hop.
+        assert np.allclose(out, expected, atol=0.2 * size * size), (
+            steps, out[0])
+        steps += 1
+        if rank == 0:
+            keep = 0 if (tuner.converged or steps >= 5000) else 1
+        flag = eng.broadcast(np.asarray([keep], dtype=np.int8),
+                             root_rank=0, name="at.ctl")
+        keep = int(flag[0])
+    stats = eng.stats()
+    if rank == 0:
+        assert tuner.converged, f"no convergence after {steps} steps"
+        tried = {t["config"]["wire_dtype"] for t in tuner.trace}
+        assert tried == {0, 1, 3}, tried  # fp32, fp16, int8 all trialed
+        scored = [t for t in tuner.trace if t["score"] is not None]
+        assert scored, "no trial ever scored"
+        assert "wire_dtype" in tuner.committed, tuner.committed
+        if os.environ.get("HOROVOD_FAULT_INJECT"):
+            assert stats["stale_epoch_msgs"] >= 1, stats["stale_epoch_msgs"]
+    # Compressed trials must actually have run compressed: at least one
+    # fp16 or int8 response executed somewhere in the world.
+    compressed = stats["wire_fp16_count"] + stats["wire_int8_count"]
+    total = eng.allreduce(
+        np.asarray([compressed], dtype=np.int64), name="at.wsum")
+    assert int(total[0]) >= 2, int(total[0])
+
+
 def scenario_hang(rank, size, eng):
     # A rank wedges mid-trial (HOROVOD_FAULT_INJECT hang +
     # HOROVOD_FAULT_TIMEOUT_SEC): the coordinator's failure detector
@@ -258,6 +306,7 @@ SCENARIOS = {
     "warm_restart": scenario_warm_restart,
     "epoch": scenario_epoch,
     "stale": scenario_stale,
+    "wire_sweep": scenario_wire_sweep,
     "hang": scenario_hang,
 }
 

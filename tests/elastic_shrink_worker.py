@@ -59,6 +59,45 @@ def mean_target(size: int) -> np.ndarray:
 # freshly relaunched worker).
 seen_sizes: set = set()
 
+# Last membership epoch the sparse side-channel ran under (process-local,
+# like seen_sizes) — and whether the post-resize clearing was verified.
+sparse_last_epoch: dict = {"epoch": None, "verified_clear": False}
+
+
+def sparse_step(rank: int):
+    """One top-k sparse allreduce per training step, proving the
+    error-feedback residuals are EPOCH-STAMPED: on the first step of any
+    new membership epoch (fresh process or post-resize survivor) every
+    member ships a ZERO gradient — if a survivor's pre-resize residual
+    leaked into the new world, the result would be nonzero and every
+    rank asserts.  Steady-state steps accumulate real residual mass so
+    there is always something TO leak."""
+    from horovod_tpu.runtime import sparse
+
+    ep = basics.epoch()
+    n = 32
+    if sparse_last_epoch["epoch"] != ep:
+        had_residual = sparse.residual_norm("el.sparse") > 0.0
+        out = sparse.sparse_allreduce_topk(
+            np.zeros(n, np.float32), name="el.sparse", ratio=0.1,
+            average=True)
+        assert np.all(out == 0.0), (
+            "a dead incarnation's residual leaked into epoch "
+            f"{ep}: {out}")
+        assert sparse.residual_norm("el.sparse") == 0.0
+        if had_residual:
+            # This process carried residual across the resize and proved
+            # it was cleared (reported at the end).
+            sparse_last_epoch["verified_clear"] = True
+        sparse_last_epoch["epoch"] = ep
+    else:
+        # Steady state: 0.5s everywhere, top-10% ships 3 entries — the
+        # rest accumulates as residual (the leak candidate).
+        sparse.sparse_allreduce_topk(
+            np.full(n, 0.5 + basics.rank(), np.float32),
+            name="el.sparse", ratio=0.1, average=True)
+        assert sparse.residual_norm("el.sparse") > 0.0
+
 
 def train(state: ElasticState):
     eng = engine_or_none()  # re-evaluated every (re-)entry: None at size 1
@@ -70,6 +109,7 @@ def train(state: ElasticState):
                 f"{state.last_sync_size}")
         grad = 2.0 * (state.w - rank_target(basics.rank()))
         if eng is not None:
+            sparse_step(basics.rank())
             # Deliberately UNNAMED (exercises the auto-name counter reset
             # across re-inits, like elastic_worker).
             grad = eng.allreduce(grad, average=True)
@@ -125,7 +165,8 @@ def main():
     print(
         f"ELASTIC_OK id={os.environ.get('HOROVOD_RANK')} "
         f"rank={basics.rank()} size={size} epoch={epoch} "
-        f"sizes={','.join(map(str, sorted(seen_sizes)))} loss={loss:.12e}",
+        f"sizes={','.join(map(str, sorted(seen_sizes)))} loss={loss:.12e} "
+        f"residuals_cleared={int(sparse_last_epoch['verified_clear'])}",
         flush=True)
     basics.shutdown()
 

@@ -768,6 +768,254 @@ def scenario_hier_exact(rank, size, eng):
     assert eng.stats()["intra_host_bytes"] > 0
 
 
+def scenario_wire_parity(rank, size, eng):
+    # The fp32-wire default contract: HOROVOD_WIRE_DTYPE unset, =fp32,
+    # and a per-tensor wire_dtype="fp32" override must all produce
+    # BIT-IDENTICAL results (the wire field rides the control plane; the
+    # data plane is untouched).  Runs the full parity corpus: every
+    # dtype, sum/min/max/prod, prime counts, fused bursts, sharded MBs.
+    cases = _parity_cases(rank, size)
+    base = _parity_run(eng, cases, "wdef")
+    s = eng.stats()
+    assert s["config"]["wire_dtype"] == "fp32", s["config"]
+    assert s["wire_fp16_count"] == 0 and s["wire_int8_count"] == 0, s
+    assert s["compressed_bytes_tx"] == 0, s
+    basics.shutdown()
+    os.environ["HOROVOD_WIRE_DTYPE"] = "fp32"
+    basics.init()
+    explicit = _parity_run(eng, cases, "wfp32")
+    # Per-tensor explicit override on top.
+    outs3 = []
+    for i, (arr, op) in enumerate(cases):
+        h = eng.enqueue_allreduce(arr.copy(), name=f"wovr.{i}",
+                                  red_op=op, wire_dtype="fp32")
+        outs3.append(eng.synchronize(h))
+    for i, (a, b) in enumerate(zip(base, explicit)):
+        assert a.tobytes() == b.tobytes(), (
+            f"case {i}: HOROVOD_WIRE_DTYPE=fp32 differs from default "
+            f"(dtype {a.dtype})")
+    for i, (a, c) in enumerate(zip(base, outs3)):
+        assert a.tobytes() == c.tobytes(), (
+            f"case {i}: wire_dtype='fp32' override differs from default")
+
+
+def scenario_wire_values(rank, size, eng):
+    # Compressed wires are value-lossy but bounded and DETERMINISTIC:
+    # repeat runs must be bitwise identical, and results must sit within
+    # each format's error envelope of the fp32 reference.
+    rng = np.random.default_rng(4000 + rank)
+    x = rng.standard_normal(1 << 18).astype(np.float32)
+    ref = eng.allreduce(x.copy(), name="wv.ref")
+    scale = float(np.max(np.abs(ref))) + 1e-9
+    for wd, tol in (("fp16", 2e-3), ("bf16", 2e-2), ("int8", 4e-2),
+                    ("fp8", 1e-1)):
+        a = eng.allreduce(x.copy(), name=f"wv.{wd}.a", wire_dtype=wd)
+        b = eng.allreduce(x.copy(), name=f"wv.{wd}.b", wire_dtype=wd)
+        assert a.tobytes() == b.tobytes(), (
+            f"{wd}: same-world repeat not deterministic")
+        err = float(np.max(np.abs(a - ref))) / scale
+        assert err < tol, (wd, err)
+    # Non-finite propagation: a mixed-precision overflow element must
+    # surface as NaNs in its quantized block on EVERY rank — never
+    # silently zero the gradient out from under an overflow detector.
+    bad = np.ones(1 << 12, dtype=np.float32)
+    if rank == 0:
+        bad[17] = np.inf
+    h = eng.enqueue_allreduce(bad, name="wv.inf", red_op="sum",
+                              wire_dtype="int8")
+    out = eng.synchronize(h)
+    assert np.isnan(out).any(), "overflow silently vanished on the wire"
+    # non-fp32 payloads are never compressed even when the env asks:
+    # int64 sums stay exact under a global int8 wire.
+    z = (np.arange(257) + rank).astype(np.int64)
+    h = eng.enqueue_allreduce(z.copy(), name="wv.int64", red_op="sum",
+                              wire_dtype="int8")
+    out = eng.synchronize(h)
+    exp = size * np.arange(257, dtype=np.int64) + size * (size - 1) // 2
+    assert np.array_equal(out, exp), out[:4]
+
+
+def scenario_wire_stats(rank, size, eng):
+    # Counter contract on a 16 MB fp32 allreduce: int8 must cut this
+    # rank's data_bytes_tx >= 3.3x vs the fp32 wire (the wire payload is
+    # ~1/4 + per-chunk scale headers), wire_bytes_saved/compressed_
+    # bytes_tx/quantize_ns must move, per-mode counts must count, and
+    # the effective busbw numerator (allreduce_bytes) must stay LOGICAL.
+    n = (16 << 20) // 4
+    x = np.ones(n, dtype=np.float32)
+    s0 = eng.stats()
+    out = eng.allreduce(x.copy(), name="ws.fp32")
+    assert np.allclose(out, float(size))
+    s1 = eng.stats()
+    out = eng.allreduce(x.copy(), name="ws.int8", wire_dtype="int8")
+    assert np.allclose(out, float(size), atol=1e-2)
+    s2 = eng.stats()
+    out = eng.allreduce(x.copy(), name="ws.fp16", wire_dtype="fp16")
+    assert np.allclose(out, float(size), atol=1e-2)
+    s3 = eng.stats()
+    fp32_tx = s1["data_bytes_tx"] - s0["data_bytes_tx"]
+    int8_tx = s2["data_bytes_tx"] - s1["data_bytes_tx"]
+    fp16_tx = s3["data_bytes_tx"] - s2["data_bytes_tx"]
+    assert fp32_tx > 0 and int8_tx > 0
+    ratio8 = int8_tx / fp32_tx
+    assert ratio8 <= 0.30, f"int8 wire ratio {ratio8:.3f} (want <= 0.30)"
+    assert fp32_tx / int8_tx >= 3.3, (fp32_tx, int8_tx)
+    assert 0.4 <= fp16_tx / fp32_tx <= 0.6, fp16_tx / fp32_tx
+    # logical (pre-compression) bytes: identical for all three runs.
+    assert s2["allreduce_bytes"] - s1["allreduce_bytes"] == n * 4, s2
+    assert s3["allreduce_bytes"] - s2["allreduce_bytes"] == n * 4, s3
+    assert s2["wire_bytes_saved"] > s1["wire_bytes_saved"], s2
+    assert s2["compressed_bytes_tx"] > s1["compressed_bytes_tx"], s2
+    assert s2["quantize_ns"] > s1["quantize_ns"], s2
+    assert s1["compressed_bytes_tx"] == s0["compressed_bytes_tx"], s1
+    assert s2["wire_int8_count"] - s1["wire_int8_count"] == 1, s2
+    assert s3["wire_fp16_count"] - s2["wire_fp16_count"] == 1, s3
+    assert s1["wire_int8_count"] == s0["wire_int8_count"], s1
+
+
+def scenario_wire_mismatch(rank, size, eng):
+    # Ranks disagreeing on the wire format must get the negotiated typed
+    # error naming both formats — never a garbled ring.
+    x = np.zeros(64, dtype=np.float32)
+    try:
+        h = eng.enqueue_allreduce(
+            x, name="bad_wire",
+            wire_dtype="int8" if rank == 0 else "fp32")
+        eng.synchronize(h)
+        if size == 1:
+            return
+    except HorovodInternalError as e:
+        msg = str(e)
+        assert "Mismatched wire dtypes" in msg, msg
+        assert "int8" in msg and "fp32" in msg, msg
+        return
+    raise AssertionError("expected HorovodInternalError")
+
+
+def scenario_wire_fused(rank, size, eng):
+    # Fused bursts under a global compressed wire: same-wire responses
+    # fuse and the whole batch reduces through one quantized ring; the
+    # cache replays the committed wire on later steps (hits, not
+    # renegotiation).
+    assert os.environ.get("HOROVOD_WIRE_DTYPE") == "int8"
+    assert eng.stats()["config"]["wire_dtype"] == "int8"
+    for step in range(3):
+        handles = [
+            eng.enqueue_allreduce(
+                np.full((4096,), float(rank + i), dtype=np.float32),
+                name=f"wf.{i}")
+            for i in range(8)
+        ]
+        # int8 absolute error bound: the fused block's max |value| is
+        # size-1+7; each of the ~size quantization hops contributes up
+        # to maxabs/127 — scale the tolerance accordingly.
+        atol = (size + 6) / 127.0 * (size + 1) * 1.5
+        for i, h in enumerate(handles):
+            out = eng.synchronize(h)
+            exp = sum(r + i for r in range(size))
+            assert np.allclose(out, exp, atol=atol), (
+                step, i, out[0], exp, atol)
+    s = eng.stats()
+    assert s["wire_int8_count"] > 0, s
+    assert s["cache_hits"] > 0, s
+
+
+def scenario_wire_tune(rank, size, eng):
+    # The wire dtype as the 6th live-tunable knob: a TUNE frame flips the
+    # default between cycles on EVERY rank; enqueues after it negotiate
+    # (and execute) under the new wire; stats()["config"] tracks it.
+    assert eng.stats()["config"]["wire_dtype"] == "fp32"
+    x = np.ones(1 << 16, dtype=np.float32)
+    assert np.allclose(eng.allreduce(x.copy(), name="wt.t"), float(size))
+    tt = eng.stats()["tune_trials"]
+    if rank == 0:
+        assert eng.autotune_set(wire_dtype=3)  # int8
+    import time
+    deadline = time.time() + 20
+    while eng.stats()["tune_trials"] <= tt:
+        assert time.time() < deadline, "TUNE frame never applied"
+        time.sleep(0.002)
+    assert eng.stats()["config"]["wire_dtype"] == "int8"
+    s0 = eng.stats()
+    # Same name, new signature (wire changed): the slot evicts and the
+    # collective renegotiates + executes under int8.
+    out = eng.allreduce(x.copy(), name="wt.t")
+    assert np.allclose(out, float(size), atol=1e-2)
+    s1 = eng.stats()
+    assert s1["wire_int8_count"] - s0["wire_int8_count"] == 1, s1
+    assert s1["cache_evictions"] > s0["cache_evictions"], s1
+    # ... and back to fp32: bitwise-identical to an untouched run.
+    tt = s1["tune_trials"]
+    if rank == 0:
+        assert eng.autotune_set(wire_dtype=0)
+    deadline = time.time() + 20
+    while eng.stats()["tune_trials"] <= tt:
+        assert time.time() < deadline, "TUNE frame never applied"
+        time.sleep(0.002)
+    assert eng.stats()["config"]["wire_dtype"] == "fp32"
+    out = eng.allreduce(x.copy(), name="wt.t")
+    assert np.array_equal(out, np.full_like(x, float(size))), out[:4]
+
+
+def scenario_wire_death(rank, size, eng):
+    # Worker death MID-COMPRESSED-ALLREDUCE: the highest rank dies while
+    # an int8-wire 8 MB allreduce is in flight; every survivor must get
+    # the clean attributed abort (a dead peer EOFs every channel of the
+    # quantized ring exactly like the uncompressed one).
+    assert eng.stats()["config"]["wire_dtype"] == "int8"
+    x = np.full((1 << 16,), float(rank + 1), dtype=np.float32)
+    out = eng.allreduce(x, name="wd.pre")
+    # int8 tolerance: ~maxabs/127 per quantization hop.
+    assert np.allclose(out, size * (size + 1) / 2.0,
+                       atol=0.1 * size * size), out[0]
+    assert eng.stats()["wire_int8_count"] >= 1
+    if rank == size - 1:
+        os._exit(31)  # crash without shutdown handshake
+    try:
+        big = np.full(((8 << 20) // 4,), 1.0, dtype=np.float32)
+        eng.allreduce(big, name="wd.mid")
+        # One allreduce may complete from buffered data; the next cannot.
+        eng.allreduce(big, name="wd.mid2")
+    except HorovodInternalError as e:
+        msg = str(e)
+        assert ("disconnected" in msg or "lost connection" in msg
+                or "could not reach" in msg or "closed" in msg), msg
+        return
+    raise AssertionError("expected HorovodInternalError after peer death")
+
+
+def scenario_wire_sparse(rank, size, eng):
+    # Top-k sparse allreduce with error feedback over the allgather
+    # path: selection is deterministic, the mean of the selected entries
+    # is exact, unsent mass accumulates in the residual and drains on
+    # later steps; sparse_count tracks completions.
+    from horovod_tpu.runtime import sparse
+
+    n = 1000
+    x = np.zeros(n, dtype=np.float32)
+    x[7] = 10.0 + rank          # always the biggest entry
+    x[1:4] = 0.25               # never in the top-1%
+    s0 = eng.stats()
+    out = sparse.sparse_allreduce_topk(x, name="sp.t", ratio=0.001,
+                                       average=True)
+    # k = 1: only index 7 ships; its mean is exact.
+    exp7 = float(np.mean([10.0 + r for r in range(size)]))
+    assert np.isclose(out[7], exp7), (out[7], exp7)
+    assert np.all(out[1:4] == 0.0), out[1:4]
+    assert sparse.residual_norm("sp.t") > 0.0
+    assert eng.stats()["sparse_count"] - s0["sparse_count"] == 1
+    # Second step with zero gradient: the residual (0.25s) is the whole
+    # signal; top-1 selects one of them and ships it.
+    out2 = sparse.sparse_allreduce_topk(np.zeros(n, np.float32),
+                                       name="sp.t", ratio=0.001,
+                                       average=True)
+    assert np.sum(np.abs(out2)) > 0.0, "residual never drained"
+    # No error feedback: the registry holds nothing for this name.
+    sparse.sparse_allreduce_topk(x, name="sp.nef", ratio=0.001,
+                                 error_feedback=False, average=True)
+    assert sparse.residual_norm("sp.nef") == 0.0
+
+
 def scenario_spin(rank, size, eng):
     # Keep allreducing until killed (the shm leak test SIGKILLs the job
     # mid-collective and then inspects /dev/shm); bounded so an un-killed
@@ -821,6 +1069,14 @@ SCENARIOS = {
     "channels_big": scenario_channels_big,
     "shm_parity": scenario_shm_parity,
     "algo_parity": scenario_algo_parity,
+    "wire_parity": scenario_wire_parity,
+    "wire_values": scenario_wire_values,
+    "wire_stats": scenario_wire_stats,
+    "wire_mismatch": scenario_wire_mismatch,
+    "wire_fused": scenario_wire_fused,
+    "wire_tune": scenario_wire_tune,
+    "wire_death": scenario_wire_death,
+    "wire_sparse": scenario_wire_sparse,
     "shm_stats": scenario_shm_stats,
     "hier_exact": scenario_hier_exact,
     "spin": scenario_spin,

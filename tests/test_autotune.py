@@ -177,6 +177,35 @@ def test_stale_tune_frames_dropped_while_tuning():
                            "HOROVOD_FAULT_INJECT": "1:20:stale-epoch"})
 
 
+def test_autotune_wire_dtype_knob_swept_and_committed():
+    """The 6th live-tunable knob: under HOROVOD_AUTOTUNE_WIRE=1 with the
+    sweep restricted to wire_dtype, the tuner trials fp32/fp16/int8,
+    scores them on EFFECTIVE bus bandwidth (logical bytes over wall
+    time — allreduce_bytes is pre-compression by design), and commits a
+    wire dtype; compressed trials really executed compressed (per-mode
+    counters moved)."""
+    run_workers(2, "wire_sweep", timeout=240, worker=WORKER,
+                extra_env={**TUNE_ENV,
+                           "HOROVOD_AUTOTUNE_WIRE": "1",
+                           "HOROVOD_AUTOTUNE_KNOBS": "wire_dtype"})
+
+
+@pytest.mark.fault
+def test_stale_control_frames_dropped_while_wire_tuning():
+    """A dead incarnation's stale-epoch control frame injected while the
+    WIRE knob is being tuned: structurally dropped + counted, the wire
+    search still converges and commits — stale frames can never flip the
+    wire dtype of the live world."""
+    run_workers(2, "wire_sweep", timeout=240, worker=WORKER,
+                extra_env={**TUNE_ENV,
+                           "HOROVOD_AUTOTUNE_WIRE": "1",
+                           "HOROVOD_AUTOTUNE_KNOBS": "wire_dtype",
+                           # Early: the 3-value wire ladder converges in
+                           # a handful of steps, and the injection must
+                           # land while the search is still running.
+                           "HOROVOD_FAULT_INJECT": "1:4:stale-epoch"})
+
+
 @pytest.mark.fault
 def test_hang_mid_trial_discards_trial_no_wedge():
     """A rank wedges mid-trial: the failure detector aborts the world

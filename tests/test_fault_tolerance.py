@@ -199,6 +199,11 @@ def test_shrink_to_survivors_completes_at_smaller_size():
     assert int(oks[0][3]) >= 2                     # epoch advanced
     assert len({ok[5] for ok in oks}) == 1, oks    # identical final loss
     assert b"committed membership epoch" in p.stdout, out
+    # Both survivors carried sparse error-feedback residuals across the
+    # resize and verified they were CLEARED under the new epoch (a dead
+    # incarnation's residual leaking into the new world would have
+    # asserted inside the worker instead).
+    assert p.stdout.decode().count("residuals_cleared=1") == 2, out
 
 
 def test_relaunched_worker_rejoins_and_world_grows_back():

@@ -215,3 +215,58 @@ def test_unnamed_fallback_names_unique_across_param_groups():
         torch.optim.SGD([{"params": [a]}, {"params": [b]}], lr=0.1))
     names = list(opt._param_names.values())
     assert len(names) == len(set(names)) == 2
+
+
+def test_distributed_optimizer_topk_residuals_per_param():
+    """compression=Compression.topk: the optimizer routes gradients
+    through the sparse error-feedback path, one residual buffer per
+    PARAMETER name; at world-of-one the selected entries apply and the
+    unsent mass accumulates for the next step."""
+    from horovod_tpu.runtime import sparse
+
+    sparse.reset_residuals()
+    w = torch.nn.Parameter(torch.zeros(100))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([w], lr=1.0),
+        named_parameters=[("topk.w", w)],
+        compression=hvd.Compression.topk(0.02, error_feedback=True),
+    )
+    opt.zero_grad()
+    # Hand-build the gradient: two dominant entries + one small one.
+    loss = 5.0 * w[3] - 7.0 * w[10] + 1.0 * w[50]
+    loss.backward()
+    opt.step()
+    # k=2: the |7| and |5| entries applied; the 1.0 stayed behind.
+    assert w.data[3].item() == pytest.approx(-5.0)
+    assert w.data[10].item() == pytest.approx(7.0)
+    assert w.data[50].item() == 0.0
+    assert sparse.residual_norm("topk.w") == pytest.approx(1.0)
+    # Next step with zero grad: the residual drains.
+    opt.zero_grad()
+    (0.0 * w.sum()).backward()
+    opt.step()
+    assert w.data[50].item() == pytest.approx(-1.0)
+    assert sparse.residual_norm("topk.w") == 0.0
+    sparse.reset_residuals()
+
+
+def test_distributed_optimizer_wire_compressor_identity_at_size_one():
+    """compression=Compression.wire_int8 keeps tensors fp32 in user code
+    (the ENGINE compresses); at world-of-one it is exactly plain SGD."""
+    torch.manual_seed(3)
+    model1 = torch.nn.Linear(4, 2)
+    model2 = torch.nn.Linear(4, 2)
+    model2.load_state_dict(model1.state_dict())
+    opt1 = torch.optim.SGD(model1.parameters(), lr=0.1)
+    opt2 = hvd.DistributedOptimizer(
+        torch.optim.SGD(model2.parameters(), lr=0.1),
+        named_parameters=model2.named_parameters(),
+        compression=hvd.Compression.wire_int8,
+    )
+    X, Y = torch.randn(8, 4), torch.randn(8, 2)
+    for opt, model in ((opt1, model1), (opt2, model2)):
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(model(X), Y).backward()
+        opt.step()
+    for p1, p2 in zip(model1.parameters(), model2.parameters()):
+        assert torch.allclose(p1, p2, atol=1e-7)
