@@ -441,11 +441,16 @@ def main() -> None:
             import subprocess
             import sys
 
+            # 1500 s: the engine bench grew the big-world scale sweep
+            # (4/16/64-rank fleets, <=300 s each worst case) on top of
+            # the data-plane/wire/autotune sweeps — a shared 900 s
+            # budget could silently drop the WHOLE engine section on a
+            # loaded box (the except path discards every engine_* key).
             proc = subprocess.run(
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "bench_engine.py")],
-                capture_output=True, timeout=900, text=True)
+                capture_output=True, timeout=1500, text=True)
             eng = json.loads(proc.stdout.strip().splitlines()[-1])
             for k, v in eng.items():
                 if k != "metric":

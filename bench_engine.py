@@ -697,7 +697,83 @@ def main() -> None:
             autotune_cfg[str(n)] = json.loads(m.group(3))
     result["allreduce_bus_bw_mb_s_autotuned"] = autotuned
     result["autotune_committed_config"] = autotune_cfg
+
+    # Big-world control-plane sweep (tests/scale harness): cycle latency,
+    # coordinator control-cycle percentiles, rendezvous time and
+    # steady-state negotiation bytes/cycle vs world size, hierarchical
+    # coordination on.  HOROVOD_SKIP_SCALE_BENCH=1 skips (64 ranks).
+    if os.environ.get("HOROVOD_SKIP_SCALE_BENCH") != "1":
+        result["scale_sweep"] = _scale_sweep()
     print(json.dumps(result))
+
+
+def _scale_sweep() -> dict:
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from scale.harness import run_world
+
+    sweep: dict = {}
+    for n, groups in ((4, 2), (16, 4), (64, 8)):
+        r = run_world(n, groups=groups, steps=50, timeout=300)
+        s = r["stats"] or {}
+        sweep[str(n)] = {
+            "cycle_latency_ms_p50": s.get("step_ms_p50"),
+            "cycle_latency_ms_p99": s.get("step_ms_p99"),
+            "coordinator_cycle_ms_p50":
+                (s.get("coordinator_cycle_ns_p50") or 0) / 1e6,
+            "coordinator_cycle_ms_p99":
+                (s.get("coordinator_cycle_ns_p99") or 0) / 1e6,
+            "rendezvous_ms": r["rendezvous_ms"],
+            "negotiation_bytes_per_cycle":
+                s.get("negotiation_bytes_per_cycle"),
+            "hierarchical": s.get("hier"),
+            "hosts": s.get("hosts"),
+        }
+    return sweep
+
+
+def scale_gate() -> None:
+    """CI big-world gate: 64 single-process engine ranks rendezvous and
+    run 50 steady steps within the outer hard timeout (the hang
+    detector), and hierarchical coordination cuts rank 0's steady-state
+    negotiation bytes/cycle to <= HOROVOD_SCALE_GATE_RATIO (default 0.5)
+    x the flat path.  Judged on DETERMINISTIC byte counters, never wall
+    time — the PR 4/6 loopback-ceiling lesson: this box's wall numbers
+    swing with ambient load, its byte counters do not."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from scale.harness import run_world
+
+    threshold = float(os.environ.get("HOROVOD_SCALE_GATE_RATIO", "0.5"))
+    hier = run_world(64, groups=8, steps=50, timeout=300)
+    flat = run_world(64, groups=8, steps=50, hier=False, timeout=300)
+    hs, fs = hier["stats"], flat["stats"]
+    if not hs or not fs:
+        print("SCALE GATE FAILED: missing rank-0 measurements")
+        sys.exit(1)
+    hb, fb = (hs["negotiation_bytes_per_cycle"],
+              fs["negotiation_bytes_per_cycle"])
+    ratio = hb / fb if fb > 0 else float("inf")
+    print(f"scale gate: 64 ranks / 8 hosts — hier {hb:.0f} B/cycle vs "
+          f"flat {fb:.0f} B/cycle (x{ratio:.3f}, threshold "
+          f"x{threshold:.2f}); rendezvous {hier['rendezvous_ms']:.0f} ms "
+          f"hier / {flat['rendezvous_ms']:.0f} ms flat; coordinator "
+          f"cycle p50 {hs['coordinator_cycle_ns_p50'] / 1e6:.2f} ms / "
+          f"p99 {hs['coordinator_cycle_ns_p99'] / 1e6:.2f} ms")
+    failed = []
+    if hs["hier"] != 1:
+        failed.append("hierarchical coordination did not activate")
+    if fs["hier"] != 0:
+        failed.append("flat run unexpectedly hierarchical")
+    if hs["cache_hits"] < 49 or fs["cache_hits"] < 49:
+        failed.append("steady state did not ride the response cache")
+    if ratio > threshold:
+        failed.append(
+            f"negotiation bytes/cycle ratio x{ratio:.3f} exceeds "
+            f"x{threshold:.2f}")
+    if failed:
+        for f in failed:
+            print(f"SCALE GATE FAILED: {f}")
+        sys.exit(1)
+    print("SCALE GATE PASSED")
 
 
 #: Shared env for the autotune bench/gate runs: small fixed-bytes
@@ -937,6 +1013,8 @@ if __name__ == "__main__":
         _autotune_gate_worker()
     elif "--autotune-gate" in sys.argv:
         autotune_gate()
+    elif "--scale-gate" in sys.argv:
+        scale_gate()
     elif "--gate" in sys.argv:
         gate()
     else:

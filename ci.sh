@@ -27,7 +27,7 @@ echo "== test suite (8-device virtual CPU mesh) =="
 # Caller args go BEFORE the marker filter so a user-passed -m cannot
 # override it — the fault tests must only ever run under the hard
 # timeout below (a reintroduced hang would otherwise eat the CI budget).
-PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault"
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale"
 
 echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # These tests previously WOULD HANG when a rank died mid-collective; the
@@ -36,7 +36,7 @@ echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # AND slow) get their own budget below, and the shrink test runs in its
 # dedicated gate — not twice.
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
-    python -m pytest tests/ -q -m "fault and not slow" \
+    python -m pytest tests/ -q -m "fault and not slow and not scale" \
     --deselect tests/test_fault_tolerance.py::test_shrink_to_survivors_completes_at_smaller_size
 
 echo "== chaos membership soak (seeded multi-failure, hard timeout) =="
@@ -44,7 +44,7 @@ echo "== chaos membership soak (seeded multi-failure, hard timeout) =="
 # must converge or stop with the clean HOROVOD_ELASTIC_MIN_SIZE error —
 # never hang (the timeout is the hang detector).
 PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
-    python -m pytest tests/ -q -m "fault and slow"
+    python -m pytest tests/ -q -m "fault and slow and not scale"
 
 echo "== elastic resize gate (3 ranks, kill rank 2, no replacement) =="
 # In-place membership regression gate: rank 2 dies with no replacement;
@@ -123,6 +123,22 @@ echo "== autotune gate (online knob search vs static grid, hard timeout) =="
 # SIGTERMed mid-measurement.
 PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
     python bench_engine.py --autotune-gate
+
+echo "== scale gate (64-rank control plane + hier elastic, hard timeout) =="
+# Big-world control plane: (1) HOROVOD_HIERARCHICAL_COORDINATOR=0 must
+# be bit-for-bit identical to the hierarchical path over the same
+# topology (control may never change data); (2) 64 single-process engine
+# ranks rendezvous and run 50 steady steps on this box, with rank 0's
+# negotiation bytes/cycle <= 0.5x the flat path — deterministic byte
+# counters, not wall time (the PR 4/6 loopback-ceiling lesson); (3) a
+# sub-coordinator (group leader) killed at 16 ranks fails over through
+# the elastic re-rendezvous and the relaunched incarnation grows the
+# world back — never a hang (the timeouts are the hang detectors).
+PALLAS_AXON_POOL_IPS= timeout -k 15 300 \
+    python -m pytest "tests/scale/test_scale.py::test_hier_off_bitwise_parity" -q
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 python bench_engine.py --scale-gate
+PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
+    python -m pytest tests/scale/ -q -m "scale"
 
 echo "== serve gate (2-replica Poisson load, hard timeout) =="
 # Production-serving regression gate: a short open-loop Poisson run

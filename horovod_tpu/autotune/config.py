@@ -146,6 +146,15 @@ KNOBS: List[Knob] = [
          lambda raw: raw or "(hostname#boot-id)",
          "co-location grouping override for rendezvous (two-level "
          "hierarchy + shm edges form per host key)"),
+    Knob("HOROVOD_HIERARCHICAL_COORDINATOR", "1",
+         lambda raw: str(1 if _int_env(raw, 1) else 0),
+         "per-host sub-coordinators aggregate readiness so rank 0 "
+         "handles O(hosts) control frames per cycle (active on >1-group "
+         "topologies; 0 restores the flat rank-0 star bit-for-bit; "
+         "docs/scaling.md)"),
+    Knob("HOROVOD_RENDEZVOUS_TIMEOUT_SEC", "120",
+         lambda raw: str(max(5, _int_env(raw, 120))),
+         "first-rendezvous / join-exchange deadline"),
     Knob("HOROVOD_ELASTIC", "0", lambda raw: str(_int_env(raw, 0)),
          "in-place elastic membership"),
     Knob("HOROVOD_AUTOTUNE", "0", lambda raw: str(_int_env(raw, 0)),

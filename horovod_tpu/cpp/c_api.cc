@@ -121,6 +121,24 @@ int64_t horovod_stale_epoch_msgs() {
   return Engine::Get().stale_epoch_msgs();
 }
 
+// Big-world control plane: rendezvous ASSIGN bytes this coordinator has
+// sent (deterministic, the scale harness's frame-compaction metric), the
+// coordinator's control-plane cycle-time percentiles over a sliding
+// window of payload cycles (0 on workers / idle worlds), and whether
+// hierarchical coordination (per-host sub-coordinators) is committed.
+int64_t horovod_assign_bytes_tx() {
+  return Engine::Get().assign_bytes_tx();
+}
+int64_t horovod_coordinator_cycle_ns_p50() {
+  return Engine::Get().coordinator_cycle_ns_p50();
+}
+int64_t horovod_coordinator_cycle_ns_p99() {
+  return Engine::Get().coordinator_cycle_ns_p99();
+}
+int64_t horovod_hier_coordinator() {
+  return Engine::Get().hier_coordinator() ? 1 : 0;
+}
+
 // Data-plane observability: payload bytes moved over ring data sockets
 // (all collectives, all channels), cumulative thread-time split between
 // socket progress (wire) and reduction kernels (reduce) — each sums

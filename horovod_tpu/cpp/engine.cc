@@ -662,6 +662,10 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   two_level_ = false;
   shm_ring_active_ = false;
   rank_host_.clear();
+  // Hierarchical coordination: the coordinator's env resolution is
+  // committed in the ASSIGN frame (rendezvous sets this); refined after
+  // AdoptTopology — it only activates on a >1-group topology.
+  hier_coord_ = false;
   // A previous incarnation's unshipped TUNE proposal must not leak into
   // the new world (tune_trials_ stays process-cumulative like every
   // other counter).
@@ -852,6 +856,12 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     // host, one-rank-per-host, shm off) is a flat ring — over shm when
     // the whole world is one group and shm is on, over TCP otherwise.
     two_level_ = shm_enabled_ && nnodes_ > 1 && size_ > nnodes_;
+    // Control-plane hierarchy activates on any committed >1-group
+    // topology with at least one multi-member group — independent of
+    // shm: the member ↔ leader control conns are plain TCP, so a
+    // synthetic host grouping (HOROVOD_HOST_KEY) scales the control
+    // plane even where the data plane fell back to the flat ring.
+    hier_coord_ = hier_coord_ && nnodes_ > 1 && size_ > nnodes_;
     if (!shm_enabled_ && nnodes_ > 1 && size_ > nnodes_ && rank_ == 0) {
       // A hierarchical topology exists but the intra-group phases cannot
       // run (shm off or unavailable on some host), so every rank joins
@@ -877,7 +887,7 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     // channel slot in the new world's wiring.  Connect cannot deadlock:
     // every listener already exists, so connects complete from the
     // backlog even before the peer accepts.
-    enum RingId : int32_t { GLOBAL = 0, LOCAL = 1, CROSS = 2 };
+    enum RingId : int32_t { GLOBAL = 0, LOCAL = 1, CROSS = 2, CTRL = 3 };
     struct Edge {
       int peer;
       int32_t ring;
@@ -895,6 +905,23 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       outgoing.push_back({(rank_ + 1) % size_, GLOBAL, c, &ring_next_[c]});
       incoming.push_back(
           {(rank_ - 1 + size_) % size_, GLOBAL, c, &ring_prev_[c]});
+    }
+    // Hierarchical-coordination control edges: every non-leader member
+    // wires ONE control connection to its group leader (the leader's
+    // per-cycle aggregation fan-in), reusing the epoch-stamped data-ring
+    // handshake so a dead incarnation's connect can never steal a slot.
+    leader_conn_.Close();
+    member_conns_.clear();
+    if (hier_coord_ && group_size_ > 1) {
+      if (local_index_ == 0) {
+        member_conns_.resize(group_size_);
+        for (int m = 1; m < group_size_; ++m) {
+          incoming.push_back({group_members_[m], CTRL, 0,
+                              &member_conns_[m]});
+        }
+      } else {
+        outgoing.push_back({group_members_[0], CTRL, 0, &leader_conn_});
+      }
     }
     if (two_level_ && local_index_ == 0 && nnodes_ > 1) {
       // One leader per host participates in the inter-host ring, with the
@@ -1003,6 +1030,19 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         c.EnableKeepalive();
       }
     }
+    // Hierarchical control edges get the control-plane transport bounds
+    // (not the data-socket buffer sizing): a dead member/leader must
+    // surface within the same patience budget as any control peer.
+    if (leader_conn_.valid()) {
+      leader_conn_.SetTimeouts(socket_timeout_sec_);
+      leader_conn_.EnableKeepalive();
+    }
+    for (auto& c : member_conns_) {
+      if (c.valid()) {
+        c.SetTimeouts(socket_timeout_sec_);
+        c.EnableKeepalive();
+      }
+    }
     // Shared-memory intra-host edges: the second channel kind.  Wired
     // AFTER the TCP rings so a failure here can still use BroadcastAbort-
     // free cleanup (init error on every rank via its own wiring timeout).
@@ -1026,6 +1066,7 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   }
 
   last_stall_check_ = std::chrono::steady_clock::now();
+  last_sub_stall_check_ = last_stall_check_;
   last_exec_time_ = std::chrono::steady_clock::now();
   fusion_buffers_.assign(std::max(1, num_channels_),
                          std::vector<uint8_t>());
@@ -1205,10 +1246,36 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
   }
   rank_host_ = groups;
   shm_enabled_ = shm_commit;
+  // Control-plane hierarchy: the coordinator's env resolution is THE
+  // resolution (default on; =0 restores the flat rank-0 star bit-for-
+  // bit) — a per-rank split would leave leaders aggregating members
+  // that still talk straight to rank 0.
+  hier_coord_ = EnvInt64("HOROVOD_HIERARCHICAL_COORDINATOR", 1) != 0;
   // Crash-mid-wiring leftovers from dead incarnations: no current-epoch
   // segment exists yet (members create edges only after ASSIGN), so
   // everything under this job's prefix is stale.
   if (shm_enabled_) ShmSweepStale(shm_prefix_);
+  // Peer-table compaction: the host strings are near-always a handful of
+  // distinct values repeated across ranks — dictionary-encode them once
+  // and reference by varint index, with ports/group ids as varints too,
+  // so ASSIGN bytes grow with hosts + ranks·few-bytes instead of
+  // ranks·(host string + 8).  assign_bytes_tx counts what actually went
+  // out, per member, re-rendezvous included.
+  std::vector<std::string> uniq_hosts;
+  {
+    std::unordered_map<std::string, uint32_t> seen_hosts;
+    for (int i = 0; i < new_size; ++i) {
+      if (seen_hosts.emplace((*peer_hosts)[i],
+                             static_cast<uint32_t>(uniq_hosts.size()))
+              .second) {
+        uniq_hosts.push_back((*peer_hosts)[i]);
+      }
+    }
+  }
+  std::unordered_map<std::string, uint32_t> host_ids;
+  for (uint32_t i = 0; i < uniq_hosts.size(); ++i) {
+    host_ids[uniq_hosts[i]] = i;
+  }
   for (int r = 1; r < new_size; ++r) {
     Writer w;
     w.u8(0);  // ok
@@ -1219,6 +1286,8 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     // probe): per-rank fallback would desync the wire pattern, so the
     // whole world runs shm or none of it does.
     w.u8(shm_enabled_ ? 1 : 0);
+    // Committed control-plane hierarchy flag (see hier_coord_ above).
+    w.u8(hier_coord_ ? 1 : 0);
     // The coordinator's data-plane fan-out is THE fan-out: every member
     // wires exactly this many channels per ring edge, so a rank whose
     // env disagrees cannot deadlock the channel accepts.  The wave width
@@ -1230,16 +1299,19 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     w.i32(num_channels_);
     w.i32(wave_width_.load());
     w.i64(algo_threshold_.load());
+    w.vu(uniq_hosts.size());
+    for (const auto& h : uniq_hosts) w.str(h);
     for (int i = 0; i < new_size; ++i) {
-      w.str((*peer_hosts)[i]);
-      w.i32((*peer_ports)[i]);
-      w.i32(groups[i]);
+      w.vu(host_ids[(*peer_hosts)[i]]);
+      w.vu(static_cast<uint64_t>((*peer_ports)[i]));
+      w.vu(static_cast<uint64_t>(groups[i]));
     }
     if (!conns[r].SendFrame(w.bytes())) {
       last_error_ = "rendezvous assign to worker id " +
                     std::to_string(member_ids[r]) + " failed";
       return 1;
     }
+    assign_bytes_tx_.fetch_add(static_cast<int64_t>(w.bytes().size()) + 8);
   }
   worker_conns_.clear();
   worker_conns_.resize(new_size);
@@ -1333,6 +1405,7 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     int32_t new_rank = r.i32();
     int32_t new_size = r.i32();
     uint8_t shm_on = r.u8();
+    uint8_t hier_on = r.u8();
     int32_t committed_channels = r.i32();
     int32_t committed_wave = r.i32();
     int64_t committed_algo = r.i64();
@@ -1342,14 +1415,30 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
       lasterr = "bad membership assignment frame";
       break;
     }
+    // Dictionary-coded peer table (see CoordinatorRendezvous): unique
+    // host strings once, then per-rank (host index, port, group id)
+    // varint triples.
+    uint64_t nhosts = r.vu();
+    if (!r.ok() || nhosts < 1 ||
+        nhosts > static_cast<uint64_t>(new_size)) {
+      lasterr = "bad membership assignment frame";
+      break;
+    }
+    std::vector<std::string> uniq_hosts(nhosts);
+    for (uint64_t i = 0; i < nhosts; ++i) uniq_hosts[i] = r.str();
     peer_hosts->assign(new_size, "");
     peer_ports->assign(new_size, 0);
     rank_host_.assign(new_size, 0);
     bool groups_ok = true;
     for (int i = 0; i < new_size; ++i) {
-      (*peer_hosts)[i] = r.str();
-      (*peer_ports)[i] = r.i32();
-      rank_host_[i] = r.i32();
+      uint64_t hidx = r.vu();
+      if (hidx >= nhosts) {
+        groups_ok = false;
+        break;
+      }
+      (*peer_hosts)[i] = uniq_hosts[hidx];
+      (*peer_ports)[i] = static_cast<int>(r.vu());
+      rank_host_[i] = static_cast<int32_t>(r.vu());
       // Group ids index leader tables (AdoptTopology) — an out-of-range
       // id from a garbled frame must fail here like the fields above,
       // not as an OOB write or a multi-GB nnodes_ allocation there.
@@ -1360,6 +1449,7 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
       break;
     }
     shm_enabled_ = shm_on != 0;
+    hier_coord_ = hier_on != 0;
     num_channels_ = committed_channels;
     wave_width_.store(committed_wave);
     algo_threshold_.store(committed_algo);
@@ -1444,6 +1534,7 @@ void Engine::ClearCacheState() {
   coord_slot_by_name_.clear();
   free_slots_.clear();
   next_slot_ = 0;
+  sub_slot_bits_.clear();
 }
 
 // ---------------------------------------------------------------------------
@@ -1536,6 +1627,8 @@ void Engine::CloseSockets() {
   CloseShmEdges();
   coordinator_conn_.Close();
   for (auto& c : worker_conns_) c.Close();
+  leader_conn_.Close();
+  for (auto& c : member_conns_) c.Close();
   control_listener_.Close();
   data_listener_.Close();
 }
@@ -1773,6 +1866,202 @@ void Engine::BroadcastAbort(int culprit, const std::string& message) {
     // discovering the death via their own transport timeouts.
     worker_conns_[r].SendFrame(w.bytes());
   }
+  // Hierarchical mode: rank 0's own group members read leader_conn_ (the
+  // member_conns_ pair), not the direct worker conn the loop above wrote
+  // — relay the verdict there too.  Other groups' members get it from
+  // their leader, which receives this frame as its response.
+  RelayToMembers(w.bytes());
+}
+
+// Epoch-gated control-frame read shared by every control gather point:
+// rank 0 ← leaders (or ← workers on the flat path), leaders ← members.
+bool Engine::RecvRequestListGated(Socket& conn, int patience,
+                                  const char* who, RequestList* out,
+                                  std::string* what) {
+  for (int stale = 0;; ++stale) {
+    std::vector<uint8_t> frame;
+    if (!conn.RecvFrame(&frame, patience, who)) {
+      *what = "lost";
+      return false;
+    }
+    negotiation_bytes_rx_.fetch_add(static_cast<int64_t>(frame.size()) + 8);
+    Reader reader(frame.data(), frame.size());
+    if (!ParseRequestList(&reader, out)) {
+      *what = "corrupt";
+      return false;
+    }
+    if (out->epoch == epoch_.load()) return true;
+    stale_epoch_msgs_.fetch_add(1);
+    std::fprintf(stderr,
+                 "horovod_tpu rank %d: dropped a stale %s (epoch %lld, "
+                 "current epoch %lld)\n",
+                 rank_, who, static_cast<long long>(out->epoch),
+                 static_cast<long long>(epoch_.load()));
+    *out = RequestList();
+    if (stale >= 15) {
+      *what = "stale-flood";
+      return false;
+    }
+  }
+}
+
+void Engine::AggregateGroup(RequestList* agg) {
+  AssertBackgroundThread();
+  if (group_size_ <= 1) return;
+  // Fold the leader's OWN hit bits through the same sub table as its
+  // members' — a slot's bit goes up only when the whole group is ready,
+  // the leader included (rank 0 counts GROUP grants, not rank grants).
+  std::vector<uint32_t> own_hits;
+  own_hits.swap(agg->cache_hits);
+  auto note_hits = [&](const std::vector<uint32_t>& hits, int pos) {
+    for (uint32_t slot : hits) {
+      auto& sp = sub_slot_bits_[slot];
+      if (sp.seen.empty()) {
+        sp.seen.assign(group_size_, false);
+        sp.first_seen = std::chrono::steady_clock::now();
+      }
+      if (!sp.seen[pos]) {
+        sp.seen[pos] = true;
+        sp.count++;
+      }
+    }
+  };
+  note_hits(own_hits, 0);
+  std::set<uint32_t> evicts(agg->cache_evicts.begin(),
+                            agg->cache_evicts.end());
+  for (int m = 1; m < group_size_; ++m) {
+    RequestList ml;
+    std::string what;
+    std::string who =
+        "control frame from rank " + std::to_string(group_members_[m]);
+    if (!member_conns_[m].valid() ||
+        !RecvRequestListGated(member_conns_[m], control_patience_rounds_,
+                              who.c_str(), &ml, &what)) {
+      // Report the first dead member upward instead of failing the
+      // cycle here: rank 0 broadcasts the abort naming the member, so
+      // every rank — other groups included — gets the true culprit.
+      if (agg->fail_rank < 0) {
+        agg->fail_rank = group_members_[m];
+        agg->fail_message =
+            "sub-coordinator rank " + std::to_string(rank_) +
+            " lost its group member rank " +
+            std::to_string(group_members_[m]) +
+            " — that process crashed, hung, or dropped its connection; "
+            "check its logs. Aborting all ranks.";
+      }
+      continue;
+    }
+    if (ml.shutdown) agg->shutdown = true;
+    if (ml.fail_rank >= 0 && agg->fail_rank < 0) {
+      agg->fail_rank = ml.fail_rank;
+      agg->fail_message = std::move(ml.fail_message);
+    }
+    for (auto& q : ml.requests) agg->requests.push_back(std::move(q));
+    for (uint32_t s : ml.cache_evicts) evicts.insert(s);
+    note_hits(ml.cache_hits, m);
+  }
+  agg->cache_evicts.assign(evicts.begin(), evicts.end());
+  // A slot evicted this very cycle can never fire: drop its held bits
+  // (the evict broadcast makes pending-hit members resubmit in full, so
+  // nothing strands — and a freed id reassigned to a NEW tensor must not
+  // inherit a stale group grant).
+  for (uint32_t s : agg->cache_evicts) sub_slot_bits_.erase(s);
+  for (auto it = sub_slot_bits_.begin(); it != sub_slot_bits_.end();) {
+    if (it->second.count == group_size_) {
+      agg->cache_hits.push_back(it->first);
+      it = sub_slot_bits_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+bool Engine::RelayToMembers(const std::vector<uint8_t>& frame) {
+  bool ok = true;
+  for (int m = 1; m < static_cast<int>(member_conns_.size()); ++m) {
+    if (!member_conns_[m].valid() || !member_conns_[m].SendFrame(frame)) {
+      // Non-fatal: a member that died after reporting is detected by
+      // the next cycle's gather (or by the collective's own transport
+      // error) — the rest of the group still gets the frame.
+      ok = false;
+      continue;
+    }
+    negotiation_bytes_tx_.fetch_add(static_cast<int64_t>(frame.size()) + 8);
+  }
+  return ok;
+}
+
+void Engine::RelayAbortToMembers(const std::string& message) {
+  if (member_conns_.empty()) return;
+  ResponseList rl;
+  rl.epoch = epoch_.load();
+  rl.abort = true;
+  rl.abort_rank = -1;
+  rl.abort_message = message;
+  Writer w;
+  SerializeResponseList(rl, &w);
+  RelayToMembers(w.bytes());
+}
+
+// Leader-side stall detection over held partial readiness bits (see
+// engine.h): without it, a slot whose group never completes stalls
+// SILENTLY under hierarchical coordination — the leader forwards
+// nothing, so rank 0's detector sees count == 0 for it and skips.
+void Engine::CheckForStalledSubBits() {
+  if (stall_check_disabled_ || sub_slot_bits_.empty()) return;
+  auto now = std::chrono::steady_clock::now();
+  if (now - last_sub_stall_check_ <
+      std::chrono::seconds(stall_warning_sec_)) {
+    return;
+  }
+  last_sub_stall_check_ = now;
+  AssertBackgroundThread();
+  for (auto& kv : sub_slot_bits_) {
+    if (kv.second.count == 0) continue;
+    auto age = std::chrono::duration_cast<std::chrono::seconds>(
+                   now - kv.second.first_seen)
+                   .count();
+    if (age < stall_warning_sec_) continue;
+    std::string missing;
+    for (int m = 0; m < group_size_ &&
+                    m < static_cast<int>(kv.second.seen.size()); ++m) {
+      if (!kv.second.seen[m]) {
+        if (!missing.empty()) missing += ", ";
+        missing += std::to_string(group_members_[m]);
+      }
+    }
+    std::fprintf(stderr,
+                 "horovod_tpu sub-coordinator rank %d (host %d): cached "
+                 "slot %u has waited %llds for local ranks %s to "
+                 "re-enqueue — a subset of this host's ranks is "
+                 "submitting the tensor, which will cause deadlock.\n",
+                 rank_, node_id_, kv.first, static_cast<long long>(age),
+                 missing.c_str());
+  }
+}
+
+void Engine::RecordCoordCycleNs(int64_t ns) {
+  std::lock_guard<std::mutex> lk(cycle_ns_mu_);
+  constexpr size_t kCap = 4096;
+  if (cycle_ns_samples_.size() < kCap) {
+    cycle_ns_samples_.push_back(ns);
+  } else {
+    cycle_ns_samples_[cycle_ns_next_ % kCap] = ns;
+  }
+  ++cycle_ns_next_;
+}
+
+int64_t Engine::CoordCycleNsPercentile(double p) const {
+  std::vector<int64_t> snap;
+  {
+    std::lock_guard<std::mutex> lk(cycle_ns_mu_);
+    snap = cycle_ns_samples_;
+  }
+  if (snap.empty()) return 0;
+  size_t idx = static_cast<size_t>(p * (snap.size() - 1) + 0.5);
+  if (idx >= snap.size()) idx = snap.size() - 1;
+  std::nth_element(snap.begin(), snap.begin() + idx, snap.end());
+  return snap[idx];
 }
 
 // "Did this control frame carry negotiation payload?" — the shared rule
@@ -1781,7 +2070,7 @@ void Engine::BroadcastAbort(int culprit, const std::string& message) {
 // work belongs here, or the stat skews between rank 0 and workers.
 static bool HasPayload(const RequestList& l) {
   return !l.requests.empty() || !l.cache_hits.empty() ||
-         !l.cache_evicts.empty() || l.shutdown;
+         !l.cache_evicts.empty() || l.shutdown || l.fail_rank >= 0;
 }
 
 static bool HasPayload(const ResponseList& l) {
@@ -1867,55 +2156,57 @@ bool Engine::RunLoopOnce() {
   }
 
   if (rank_ == 0) {
-    std::vector<RequestList> lists(size_);
-    lists[0] = std::move(my_list);
-    // A worker's next frame only arrives after it finished executing the
+    const auto cyc0 = std::chrono::steady_clock::now();
+    const bool hier = HierActive();
+    // A peer's next frame only arrives after it finished executing the
     // previous cycle's collectives, which can legitimately span several
     // socket-timeout rounds on slow links — hence the idle allowance,
     // bounded by HOROVOD_CONTROL_PATIENCE_SEC rather than scaling with
-    // world size (a crashed worker still fails immediately via
+    // world size (a crashed peer still fails immediately via
     // EOF/keepalive).
-    for (int r = 1; r < size_; ++r) {
-      // Epoch gate: a frame stamped with a different membership epoch is
-      // a delayed message from a dead incarnation of the world — drop it,
-      // count it, and read the next frame from the same rank.  Bounded so
-      // a peer stuck in the past cannot spin the coordinator forever.
-      for (int stale = 0;; ++stale) {
-        std::vector<uint8_t> frame;
-        std::string who = "control frame from rank " + std::to_string(r);
-        if (!worker_conns_[r].RecvFrame(&frame, control_patience_rounds_,
-                                        who.c_str())) {
-          BroadcastAbort(
-              r, "coordinator lost connection to rank " + std::to_string(r) +
-                     " — that process crashed, hung, or dropped its "
-                     "connection; check its logs. Aborting all ranks.");
-          return false;
-        }
-        negotiation_bytes_rx_.fetch_add(
-            static_cast<int64_t>(frame.size()) + 8);
-        Reader reader(frame.data(), frame.size());
-        if (!ParseRequestList(&reader, &lists[r])) {
-          BroadcastAbort(
-              r, "coordinator received a corrupt control frame from rank " +
-                     std::to_string(r) + ". Aborting all ranks.");
-          return false;
-        }
-        if (lists[r].epoch == epoch_.load()) break;
-        stale_epoch_msgs_.fetch_add(1);
-        std::fprintf(stderr,
-                     "horovod_tpu coordinator: dropped a stale control "
-                     "frame from rank %d (epoch %lld, current epoch "
-                     "%lld)\n",
-                     r, static_cast<long long>(lists[r].epoch),
-                     static_cast<long long>(epoch_.load()));
-        lists[r] = RequestList();  // discard the stale payload entirely
-        if (stale >= 15) {
-          BroadcastAbort(
-              r, "rank " + std::to_string(r) +
-                     " keeps sending control frames from a stale "
-                     "membership epoch. Aborting all ranks.");
-          return false;
-        }
+    //
+    // Hierarchical coordination: rank 0 gathers ONE aggregated frame per
+    // host group (its own group's members folded in via AggregateGroup)
+    // instead of one per rank — the control plane's per-cycle work and
+    // bytes scale with hosts, not ranks.  The epoch gate is inside
+    // RecvRequestListGated either way.
+    std::vector<RequestList> lists(hier ? nnodes_ : size_);
+    lists[0] = std::move(my_list);
+    if (hier) AggregateGroup(&lists[0]);
+    for (int v = 1; v < static_cast<int>(lists.size()); ++v) {
+      const int peer = hier ? group_leaders_[v] : v;
+      std::string what;
+      std::string who = "control frame from rank " + std::to_string(peer);
+      if (!RecvRequestListGated(worker_conns_[peer],
+                                control_patience_rounds_, who.c_str(),
+                                &lists[v], &what)) {
+        BroadcastAbort(
+            peer,
+            what == "corrupt"
+                ? ("coordinator received a corrupt control frame from "
+                   "rank " + std::to_string(peer) + ". Aborting all ranks.")
+            : what == "stale-flood"
+                ? ("rank " + std::to_string(peer) +
+                   " keeps sending control frames from a stale membership "
+                   "epoch. Aborting all ranks.")
+                : ("coordinator lost connection to rank " +
+                   std::to_string(peer) +
+                   " — that process crashed, hung, or dropped its "
+                   "connection; check its logs. Aborting all ranks."));
+        return false;
+      }
+    }
+    // A sub-coordinator that lost one of its members reports the culprit
+    // in its aggregate; the abort broadcast names the member, not the
+    // leader that noticed.
+    for (auto& l : lists) {
+      if (l.fail_rank >= 0) {
+        BroadcastAbort(l.fail_rank,
+                       l.fail_message.empty()
+                           ? ("rank " + std::to_string(l.fail_rank) +
+                              " failed. Aborting all ranks.")
+                           : l.fail_message);
+        return false;
       }
     }
     ResponseList response_list = CoordinatorStep(lists);
@@ -1924,28 +2215,50 @@ bool Engine::RunLoopOnce() {
     // the cycle's responses, so the knobs flip atomically between
     // cycles on the whole world.
     DrainPendingTune(&response_list);
+    // Slots the coordinator evicted beyond the gathered evict lists
+    // (full-request-implies-evict): drop any readiness bits this
+    // sub-coordinator still holds for them — a freed id reassigned to a
+    // new tensor must not inherit a stale group grant.
+    if (hier) {
+      for (uint32_t s : response_list.evict_slots) sub_slot_bits_.erase(s);
+    }
     Writer w;
     SerializeResponseList(response_list, &w);
-    for (int r = 1; r < size_; ++r) {
-      if (!worker_conns_[r].SendFrame(w.bytes())) {
+    const int nsends = hier ? nnodes_ : size_;
+    for (int v = 1; v < nsends; ++v) {
+      const int peer = hier ? group_leaders_[v] : v;
+      if (!worker_conns_[peer].SendFrame(w.bytes())) {
         BroadcastAbort(
-            r, "coordinator could not reach rank " + std::to_string(r) +
-                   " — that process likely crashed; check its logs. "
-                   "Aborting all ranks.");
+            peer, "coordinator could not reach rank " +
+                      std::to_string(peer) +
+                      " — that process likely crashed; check its logs. "
+                      "Aborting all ranks.");
         return false;
       }
       negotiation_bytes_tx_.fetch_add(
           static_cast<int64_t>(w.bytes().size()) + 8);
     }
+    // Hier: rank 0 is its own group's sub-coordinator — relay the frame
+    // down to its local members exactly like every other leader.
+    if (hier) RelayToMembers(w.bytes());
     // Count NEGOTIATION round trips only — cycles where some rank shipped
     // requests/hit-bits/evicts or the frame carried work back.  Idle
     // heartbeats (empty frames while every rank computes) would otherwise
     // drown the per-step signal bench and CI gate on.
     bool carried_payload = HasPayload(response_list);
-    for (int r = 0; r < size_ && !carried_payload; ++r) {
-      carried_payload = HasPayload(lists[r]);
+    for (size_t v = 0; v < lists.size() && !carried_payload; ++v) {
+      carried_payload = HasPayload(lists[v]);
     }
-    if (carried_payload) control_round_trips_.fetch_add(1);
+    if (carried_payload) {
+      control_round_trips_.fetch_add(1);
+      // Control-plane cycle time: gather + negotiate + distribute, the
+      // quantity the big-world scale harness tracks against world size
+      // (execution below is data-plane time, excluded on purpose).
+      RecordCoordCycleNs(
+          std::chrono::duration_cast<std::chrono::nanoseconds>(
+              std::chrono::steady_clock::now() - cyc0)
+              .count());
+    }
     // The coordinator is a cache participant like any worker: update the
     // local replica from the list it just broadcast, execute the fully
     // negotiated responses, then the agreed cached slots.
@@ -1956,55 +2269,98 @@ bool Engine::RunLoopOnce() {
     if (executed_any) exec_cycles_.fetch_add(1);
     if (response_list.tune) ApplyTune(response_list);
     if (!stall_check_disabled_) CheckForStalledTensors();
+    if (hier) CheckForStalledSubBits();  // rank 0 leads group 0 too
     return !response_list.shutdown;
   }
 
-  // Worker: ship requests up, execute the agreed response list.
-  const std::string lost_coordinator =
-      "lost connection to the coordinator (rank 0) — it likely crashed or "
-      "another rank failed; check rank 0's logs.";
+  // Non-coordinator ranks.  Three roles:
+  //   * flat worker       — ship requests to rank 0, execute its response
+  //   * hier group leader — aggregate the group's frames, ship ONE frame
+  //     to rank 0, relay the response down verbatim, then execute
+  //   * hier member       — ship requests to the group leader, execute
+  //     the relayed response
+  // The leader aggregates BEFORE sending (one frame carries the whole
+  // group), and relays BEFORE executing (members start their data-plane
+  // work in the same wave as the leader).
+  const bool leader = HierActive() && IsGroupLeader();
+  const bool member = HierActive() && !IsGroupLeader();
+  if (leader) AggregateGroup(&my_list);
+  Socket& up = member ? leader_conn_ : coordinator_conn_;
+  const std::string lost_upstream =
+      member ? ("lost connection to the sub-coordinator (rank " +
+                std::to_string(group_members_[0]) +
+                ") — it crashed, or the world is aborting; check rank " +
+                std::to_string(group_members_[0]) + "'s and rank 0's logs.")
+             : "lost connection to the coordinator (rank 0) — it likely "
+               "crashed or another rank failed; check rank 0's logs.";
+  // A member that lost its leader may still salvage the REAL verdict:
+  // rank 0 broadcasts aborts DIRECTLY to every rank's rendezvous conn
+  // (BroadcastAbort), so the culprit-naming frame is (or shortly will
+  // be) in coordinator_conn_'s buffer even though the relay path died.
+  auto salvage_abort = [&](bool wait_direct) {
+    std::vector<uint8_t> frame;
+    ResponseList rl;
+    if (up.valid() && up.RecvFrame(&frame)) {
+      Reader r(frame.data(), frame.size());
+      if (ParseResponseList(&r, &rl) && rl.abort) {
+        abort_reason_ = rl.abort_message;
+        return;
+      }
+    }
+    if (member && coordinator_conn_.valid() &&
+        (!wait_direct || WaitReadable(coordinator_conn_, 3000))) {
+      frame.clear();
+      if (coordinator_conn_.RecvFrame(&frame)) {
+        Reader r(frame.data(), frame.size());
+        rl = ResponseList();
+        if (ParseResponseList(&r, &rl) && rl.abort) {
+          abort_reason_ = rl.abort_message;
+        }
+      }
+    }
+  };
   Writer w;
   SerializeRequestList(my_list, &w);
   if (fault_stale_epoch_.exchange(false)) {
     // Injected dead-incarnation replay (HOROVOD_FAULT_INJECT
     // kind=stale-epoch): the same payload stamped with the PREVIOUS epoch
-    // precedes the real frame; the coordinator must drop and count it
+    // precedes the real frame; the receiver must drop and count it
     // (stale_epoch_msgs) and negotiate from the genuine frame only.
     RequestList ghost = my_list;
     ghost.epoch = my_list.epoch - 1;
     Writer gw;
     SerializeRequestList(ghost, &gw);
-    coordinator_conn_.SendFrame(gw.bytes());
+    up.SendFrame(gw.bytes());
   }
   negotiation_bytes_tx_.fetch_add(static_cast<int64_t>(w.bytes().size()) + 8);
-  if (!coordinator_conn_.SendFrame(w.bytes())) {
-    // The coordinator may have broadcast an abort (naming the culprit
-    // rank) just before tearing down; that frame survives in our receive
-    // buffer even though the send direction is dead.  Salvage it so the
-    // error names the rank that actually failed, not just "rank 0 gone".
-    std::vector<uint8_t> frame;
-    ResponseList rl;
-    if (coordinator_conn_.RecvFrame(&frame)) {
-      Reader r(frame.data(), frame.size());
-      if (ParseResponseList(&r, &rl) && rl.abort) {
-        abort_reason_ = rl.abort_message;
-      }
-    }
-    if (abort_reason_.empty()) abort_reason_ = lost_coordinator;
+  if (!up.SendFrame(w.bytes())) {
+    salvage_abort(/*wait_direct=*/false);
+    if (abort_reason_.empty()) abort_reason_ = lost_upstream;
+    if (leader) RelayAbortToMembers(abort_reason_);
     std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
                  abort_reason_.c_str());
     return false;
   }
   ResponseList response_list;
-  // Epoch gate, worker side: a response frame — including an abort
+  std::vector<uint8_t> accepted_frame;
+  // Epoch gate, downstream side: a response frame — including an abort
   // verdict — stamped with a different membership epoch is a dead
   // incarnation's delayed message; drop, count, read the next frame.
+  // The member's allowance exceeds the leader's (which exceeds the
+  // coordinator's): each relay hop must out-wait the one above it so the
+  // most-informative verdict wins the race.
+  const int up_patience =
+      member ? worker_patience_rounds_ + control_patience_rounds_
+             : worker_patience_rounds_;
+  const char* up_label = member
+      ? "response frame from the sub-coordinator"
+      : "response frame from the coordinator (rank 0)";
   for (int stale = 0;; ++stale) {
     std::vector<uint8_t> frame;
-    if (!coordinator_conn_.RecvFrame(&frame, worker_patience_rounds_,
-                                     "response frame from the coordinator "
-                                     "(rank 0)")) {
-      abort_reason_ = lost_coordinator;
+    if (!up.RecvFrame(&frame, up_patience, up_label)) {
+      salvage_abort(/*wait_direct=*/true);
+      if (abort_reason_.empty()) abort_reason_ = lost_upstream;
+      if (leader) RelayAbortToMembers(abort_reason_);
       std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
                    abort_reason_.c_str());
       return false;
@@ -2012,12 +2368,16 @@ bool Engine::RunLoopOnce() {
     negotiation_bytes_rx_.fetch_add(static_cast<int64_t>(frame.size()) + 8);
     Reader reader(frame.data(), frame.size());
     if (!ParseResponseList(&reader, &response_list)) {
-      abort_reason_ = "corrupt control frame from the coordinator.";
+      abort_reason_ = "corrupt control frame from upstream.";
+      if (leader) RelayAbortToMembers(abort_reason_);
       std::fprintf(stderr, "horovod_tpu rank %d: bad response frame\n",
                    rank_);
       return false;
     }
-    if (response_list.epoch == epoch_.load()) break;
+    if (response_list.epoch == epoch_.load()) {
+      accepted_frame = std::move(frame);
+      break;
+    }
     stale_epoch_msgs_.fetch_add(1);
     std::fprintf(stderr,
                  "horovod_tpu rank %d: dropped a stale response frame "
@@ -2026,12 +2386,23 @@ bool Engine::RunLoopOnce() {
                  static_cast<long long>(epoch_.load()));
     response_list = ResponseList();
     if (stale >= 15) {
-      abort_reason_ = "the coordinator keeps sending control frames from "
-                      "a stale membership epoch.";
+      abort_reason_ = "upstream keeps sending control frames from a "
+                      "stale membership epoch.";
+      if (leader) RelayAbortToMembers(abort_reason_);
       std::fprintf(stderr, "horovod_tpu rank %d: %s\n", rank_,
                    abort_reason_.c_str());
       return false;
     }
+  }
+  // Leader: relay the accepted frame verbatim — identical bytes, so
+  // members parse exactly what rank 0 serialized (aborts, TUNE payloads
+  // and shutdown flags included) — BEFORE processing it locally.
+  if (leader) {
+    RelayToMembers(accepted_frame);
+    // Evicted slots drop any readiness bits still held in the sub table
+    // (see AggregateGroup): pending-hit members resubmit on this very
+    // frame, so nothing strands and no stale grant survives.
+    for (uint32_t s : response_list.evict_slots) sub_slot_bits_.erase(s);
   }
   if (response_list.abort) {
     // Coordinator-initiated collective abort: another rank failed.
@@ -2054,6 +2425,7 @@ bool Engine::RunLoopOnce() {
   if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
   if (executed_any) exec_cycles_.fetch_add(1);
   if (response_list.tune) ApplyTune(response_list);
+  if (leader) CheckForStalledSubBits();
   return !response_list.shutdown;
 }
 
@@ -2328,21 +2700,29 @@ void Engine::CoordinatorEvictSlot(uint32_t slot, ResponseList* out) {
 // mapped onto this coordinator.
 ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
   AssertBackgroundThread();
+  // One entry per VOTER: ranks on the flat path, host groups under
+  // hierarchical coordination (each group's leader aggregated its
+  // members, so a voter's hit bit means "my whole group is ready").
+  // Full Requests carry their true request_rank either way, so
+  // validation and per-rank readiness stay rank-granular.
+  const int nvoters = static_cast<int>(lists.size());
   ResponseList out;
   out.epoch = epoch_.load();
   // Cache evictions first — readiness bits and slot reassignments below
   // must see the slot freed, and bits arriving for a slot evicted in the
   // same cycle are dropped (their senders renegotiate on receipt of the
   // evict broadcast).
-  for (int r = 0; r < size_; ++r) {
-    for (uint32_t slot : lists[r].cache_evicts) {
+  for (int v = 0; v < nvoters; ++v) {
+    for (uint32_t slot : lists[v].cache_evicts) {
       CoordinatorEvictSlot(slot, &out);
     }
   }
   std::vector<std::string> became_ready;
-  for (int r = 0; r < size_; ++r) {
-    if (lists[r].shutdown) out.shutdown = true;
-    for (auto& q : lists[r].requests) {
+  for (int v = 0; v < nvoters; ++v) {
+    if (lists[v].shutdown) out.shutdown = true;
+    for (auto& q : lists[v].requests) {
+      const int r = q.request_rank;
+      if (r < 0 || r >= size_) continue;  // garbled frame: ignore
       // A full request for a name that still holds a slot means some rank
       // invalidated it (or a replica missed the assignment): drop the
       // slot globally and fall through to full renegotiation.
@@ -2371,23 +2751,23 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
       }
     }
   }
-  // Readiness bits against live slots; when every rank's bit is in, the
+  // Readiness bits against live slots; when every voter's bit is in, the
   // slot fires this cycle as a slot id — ConstructResponse is skipped
   // entirely (the validated response is replayed from each replica).
   std::vector<uint32_t> agreed;
-  for (int r = 0; r < size_; ++r) {
-    for (uint32_t slot : lists[r].cache_hits) {
+  for (int v = 0; v < nvoters; ++v) {
+    for (uint32_t slot : lists[v].cache_hits) {
       if (coord_slot_names_.find(slot) == coord_slot_names_.end()) continue;
       SlotPending& sp = coord_slot_bits_[slot];
       if (sp.seen.empty()) {
-        sp.seen.assign(size_, false);
+        sp.seen.assign(nvoters, false);
         sp.first_seen = std::chrono::steady_clock::now();
       }
-      if (!sp.seen[r]) {
-        sp.seen[r] = true;
+      if (!sp.seen[v]) {
+        sp.seen[v] = true;
         sp.count++;
       }
-      if (sp.count == size_) agreed.push_back(slot);
+      if (sp.count == nvoters) agreed.push_back(slot);
     }
   }
   std::sort(agreed.begin(), agreed.end());
@@ -4237,12 +4617,32 @@ void Engine::CheckForStalledTensors() {
     std::fprintf(stderr, "Stalled ops:\n");
     preamble = true;
   };
+  // Once host grouping is active, a stalled negotiation names the slow
+  // HOST alongside each rank — at fleet scale "rank 37" sends the
+  // operator grepping rendezvous logs, "host 4" names the machine.
   auto missing_ranks = [&](const std::vector<bool>& seen) {
     std::string missing;
     for (int r = 0; r < size_; ++r) {
       if (!seen[r]) {
         if (!missing.empty()) missing += ", ";
         missing += std::to_string(r);
+        if (nnodes_ > 1 && r < static_cast<int>(rank_host_.size())) {
+          missing += " (host " + std::to_string(rank_host_[r]) + ")";
+        }
+      }
+    }
+    return missing;
+  };
+  // Under hierarchical coordination slot-readiness bits are GROUP
+  // granular: name the silent hosts (and their leader ranks) directly.
+  auto missing_voters = [&](const std::vector<bool>& seen) {
+    if (!HierActive()) return missing_ranks(seen);
+    std::string missing;
+    for (int g = 0; g < nnodes_ && g < static_cast<int>(seen.size()); ++g) {
+      if (!seen[g]) {
+        if (!missing.empty()) missing += ", ";
+        missing += "host " + std::to_string(g) + " (leader rank " +
+                   std::to_string(group_leaders_[g]) + ")";
       }
     }
     return missing;
@@ -4258,17 +4658,18 @@ void Engine::CheckForStalledTensors() {
   }
   // Cache-hit readiness bits stall the same way full requests do (a
   // subset of ranks re-enqueued a cached tensor, the rest never did).
+  const int nvoters = HierActive() ? nnodes_ : size_;
   for (auto& kv : coord_slot_bits_) {
-    if (kv.second.count == 0 || kv.second.count == size_) continue;
+    if (kv.second.count == 0 || kv.second.count == nvoters) continue;
     auto age = std::chrono::duration_cast<std::chrono::seconds>(
                    now - kv.second.first_seen)
                    .count();
     if (age < stall_warning_sec_) continue;
     warn_preamble();
     auto nit = coord_slot_names_.find(kv.first);
-    std::fprintf(stderr, "%s [cached slot %u; missing ranks: %s]\n",
+    std::fprintf(stderr, "%s [cached slot %u; missing: %s]\n",
                  nit == coord_slot_names_.end() ? "?" : nit->second.c_str(),
-                 kv.first, missing_ranks(kv.second.seen).c_str());
+                 kv.first, missing_voters(kv.second.seen).c_str());
   }
 }
 

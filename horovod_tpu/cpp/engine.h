@@ -185,6 +185,24 @@ class Engine {
   int64_t negotiation_bytes_tx() const { return negotiation_bytes_tx_.load(); }
   int64_t negotiation_bytes_rx() const { return negotiation_bytes_rx_.load(); }
   int64_t control_round_trips() const { return control_round_trips_.load(); }
+  // Rendezvous ASSIGN traffic this coordinator sent (frame bytes + the
+  // 8-byte length prefix, summed over members and re-rendezvous) — the
+  // deterministic counter the scale harness tracks across world sizes.
+  int64_t assign_bytes_tx() const { return assign_bytes_tx_.load(); }
+  // Control-plane cycle time on the coordinator: wall time from the
+  // start of a payload-carrying cycle's frame gathering to the last
+  // response send (execution excluded).  p50/p99 over a sliding window
+  // of recent cycles, 0 when no sample exists (workers, idle worlds).
+  int64_t coordinator_cycle_ns_p50() const {
+    return CoordCycleNsPercentile(0.50);
+  }
+  int64_t coordinator_cycle_ns_p99() const {
+    return CoordCycleNsPercentile(0.99);
+  }
+  // Hierarchical coordination (HOROVOD_HIERARCHICAL_COORDINATOR,
+  // committed in the ASSIGN frame): sub-coordinators per host group
+  // aggregate readiness so rank 0 handles O(hosts) control frames.
+  bool hier_coordinator() const { return hier_coord_; }
   // Control frames dropped because they were stamped with a different
   // membership epoch than this rank's committed one (a delayed message
   // from a dead incarnation after an elastic resize).
@@ -317,6 +335,43 @@ class Engine {
   // rendezvous and the candidate is admitted under epoch+1; returns true
   // when the cycle loop must exit for that re-rendezvous.
   bool PollJoinCandidate();
+  // -- hierarchical coordination (control-plane two-level tree) --
+  // Active when the committed HOROVOD_HIERARCHICAL_COORDINATOR flag is
+  // set AND the committed topology has >1 host group with >O(hosts)
+  // ranks: each group's leader (lowest committed rank) aggregates its
+  // members' per-cycle frames into ONE frame toward rank 0, and relays
+  // rank 0's response frame back down verbatim — rank 0 exchanges
+  // O(hosts) control frames per cycle instead of O(ranks).
+  bool HierActive() const { return hier_coord_ && size_ > 1; }
+  bool IsGroupLeader() const { return local_index_ == 0; }
+  // Epoch-gated control-frame read shared by every gather point (rank 0
+  // reading leaders, leaders reading members, workers reading relays):
+  // drops + counts frames stamped with a stale membership epoch, bounded
+  // so a peer stuck in the past cannot spin the receiver forever.
+  // Returns false on transport failure / corrupt frame / stale flood,
+  // with *what set to a short reason.
+  bool RecvRequestListGated(Socket& conn, int patience, const char* who,
+                            RequestList* out, std::string* what);
+  // Leader side of one hierarchical cycle: drain the local queue, gather
+  // one frame from every group member (epoch-gated), merge — member
+  // requests forwarded verbatim (they carry request_rank), member hit
+  // bits accumulated in sub_slot_bits_ and forwarded only once the WHOLE
+  // group is ready on a slot, evicts unioned, shutdown ORed.  A member
+  // transport failure does not fail the cycle: it is reported in the
+  // aggregate's fail_rank/fail_message so rank 0 broadcasts the abort
+  // naming the member.
+  void AggregateGroup(RequestList* agg);
+  // Leader → members: relay a raw response frame (identical bytes, so
+  // members parse exactly what rank 0 serialized, abort verdicts and
+  // TUNE payloads included).  Returns false when a member send failed.
+  bool RelayToMembers(const std::vector<uint8_t>& frame);
+  // Leader's own failure path: synthesize an abort ResponseList to the
+  // members (they are blocked on the relay) before this leader's loop
+  // exits — the sub-coordinator analogue of BroadcastAbort.
+  void RelayAbortToMembers(const std::string& message);
+  // Record one payload cycle's control-plane wall time (rank 0).
+  void RecordCoordCycleNs(int64_t ns);
+  int64_t CoordCycleNsPercentile(double p) const;
   // Pop the message queue into `my_list`, classifying each request
   // against the local cache replica: known signature → hit bit, changed
   // signature → evict + full request, unknown → full request.  Also
@@ -684,6 +739,38 @@ class Engine {
   std::set<uint32_t> free_slots_;
   uint32_t next_slot_ = 0;
 
+  // -- hierarchical coordination state --
+  // Committed flag (coordinator env resolution broadcast in the ASSIGN
+  // frame; active only when the topology has >1 group and >1 rank in
+  // some group — see HierActive).  =0 restores the flat rank-0 star
+  // bit-for-bit.
+  bool hier_coord_ = false;
+  // Member ↔ leader control connections, wired next to the data rings
+  // with the same (origin, ring=CTRL, channel, epoch) handshake: a
+  // member holds ONE conn to its group leader; a leader holds one per
+  // member, indexed by group position ([0] = itself, unused).
+  Socket leader_conn_;                 // member → group leader
+  std::vector<Socket> member_conns_;   // leader side, by group position
+  // Leader-held partial readiness per cache slot (background-thread-
+  // only, like coord_slot_bits_): seen is indexed by GROUP POSITION;
+  // the slot's bit goes up to rank 0 only when count == group_size_.
+  // Bits for slots evicted by a relayed response are dropped — a stale
+  // held bit forwarded after a slot's reassignment would count a false
+  // group grant for the new tensor.
+  struct SubSlotPending {
+    std::vector<bool> seen;
+    int count = 0;
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  std::unordered_map<uint32_t, SubSlotPending> sub_slot_bits_;
+  // Leader-side stall warning over the held partial bits: a slot whose
+  // group never completes would otherwise stall SILENTLY — the leader
+  // forwards nothing, so rank 0's detector has count == 0 and prints
+  // nothing.  Named after the missing MEMBER ranks, same cadence as
+  // CheckForStalledTensors.
+  void CheckForStalledSubBits();
+  std::chrono::steady_clock::time_point last_sub_stall_check_;
+
   // -- network --
   Socket control_listener_;                // rank 0
   std::vector<Socket> worker_conns_;       // rank 0: [size-1] control conns
@@ -900,6 +987,13 @@ class Engine {
   std::atomic<int64_t> negotiation_bytes_rx_{0};
   std::atomic<int64_t> control_round_trips_{0};
   std::atomic<int64_t> stale_epoch_msgs_{0};
+  std::atomic<int64_t> assign_bytes_tx_{0};
+  // Sliding window of coordinator payload-cycle control times (ns) for
+  // the p50/p99 getters; guarded by cycle_ns_mu_ (one lock per cycle on
+  // rank 0, read by API threads).
+  mutable std::mutex cycle_ns_mu_;
+  std::vector<int64_t> cycle_ns_samples_;
+  size_t cycle_ns_next_ = 0;
   std::atomic<int64_t> data_bytes_tx_{0};
   std::atomic<int64_t> data_bytes_rx_{0};
   std::atomic<int64_t> reduce_ns_{0};

@@ -4,35 +4,41 @@
 
 namespace hvd {
 
+// Per-cycle control frames are varint-coded end to end (see Writer::vu):
+// a steady-state negotiation frame is a handful of one-byte fields, and
+// the worst offenders of the fixed-width format — 8-byte epochs, 4-byte
+// counts, 8-byte shape dims — shrink to their value's natural size.
+
 static void SerializeRequest(const Request& q, Writer* w) {
-  w->i32(q.request_rank);
+  w->vu(static_cast<uint64_t>(q.request_rank));
   w->u8(static_cast<uint8_t>(q.type));
   w->u8(static_cast<uint8_t>(q.dtype));
   w->str(q.tensor_name);
-  w->i32(q.root_rank);
+  w->vi(q.root_rank);
   w->u8(static_cast<uint8_t>(q.red_op));
   w->u8(q.probe ? 1 : 0);
   w->u8(static_cast<uint8_t>(q.wire_dtype));
-  w->u32(static_cast<uint32_t>(q.shape.size()));
-  for (auto d : q.shape) w->i64(d);
+  w->vu(q.shape.size());
+  for (auto d : q.shape) w->vi(d);
 }
 
 static bool ParseRequest(Reader* r, Request* q) {
-  q->request_rank = r->i32();
+  q->request_rank = static_cast<int32_t>(r->vu());
   q->type = static_cast<RequestType>(r->u8());
   q->dtype = static_cast<DataType>(r->u8());
   q->tensor_name = r->str();
-  q->root_rank = r->i32();
+  q->root_rank = static_cast<int32_t>(r->vi());
   q->red_op = static_cast<ReduceOp>(r->u8());
   q->probe = r->u8() != 0;
   q->wire_dtype = static_cast<WireDtype>(r->u8());
-  uint32_t nd = r->u32();
+  uint64_t nd = r->vu();
+  if (nd > (1u << 16)) return false;  // corrupt frame guard
   q->shape.clear();
-  for (uint32_t i = 0; i < nd && r->ok(); ++i) q->shape.push_back(r->i64());
+  for (uint64_t i = 0; i < nd && r->ok(); ++i) q->shape.push_back(r->vi());
   return r->ok();
 }
 
-// Cache-hit slot ids travel bit-packed: u32 bit count (highest set slot
+// Cache-hit slot ids travel bit-packed: varint bit count (highest set slot
 // + 1, 0 when no hits) followed by ceil(nbits/8) bytes.  Slot ids are
 // dense and bounded by HOROVOD_CACHE_CAPACITY, so a steady-state cycle's
 // whole readiness report is a handful of bytes.
@@ -40,7 +46,7 @@ static void SerializeSlotBitvector(const std::vector<uint32_t>& slots,
                                    Writer* w) {
   uint32_t nbits = 0;
   for (auto s : slots) nbits = std::max(nbits, s + 1);
-  w->u32(nbits);
+  w->vu(nbits);
   std::vector<uint8_t> bits((nbits + 7) / 8, 0);
   for (auto s : slots) bits[s / 8] |= static_cast<uint8_t>(1u << (s % 8));
   for (auto b : bits) w->u8(b);
@@ -48,80 +54,120 @@ static void SerializeSlotBitvector(const std::vector<uint32_t>& slots,
 
 static bool ParseSlotBitvector(Reader* r, std::vector<uint32_t>* slots) {
   slots->clear();
-  uint32_t nbits = r->u32();
+  uint64_t nbits = r->vu();
   if (!r->ok() || nbits > (1u << 20)) return false;  // corrupt frame guard
-  for (uint32_t byte = 0; byte < (nbits + 7) / 8; ++byte) {
+  for (uint64_t byte = 0; byte < (nbits + 7) / 8; ++byte) {
     uint8_t b = r->u8();
-    for (int i = 0; i < 8 && byte * 8 + i < nbits; ++i) {
-      if (b & (1u << i)) slots->push_back(byte * 8 + i);
+    for (uint64_t i = 0; i < 8 && byte * 8 + i < nbits; ++i) {
+      if (b & (1u << i)) {
+        slots->push_back(static_cast<uint32_t>(byte * 8 + i));
+      }
     }
   }
   return r->ok();
 }
 
-static void SerializeSlotList(const std::vector<uint32_t>& slots, Writer* w) {
-  w->u32(static_cast<uint32_t>(slots.size()));
-  for (auto s : slots) w->u32(s);
+// Explicit slot lists (cached/evicted ids) go ascending delta-varint:
+// sorted once, each id is encoded as its distance from the previous one —
+// dense id ranges (the common case: smallest-first reuse keeps them low)
+// collapse to one byte per slot.  Order was never semantic: the receiver
+// applies evictions idempotently and executes cached slots in ascending
+// id order anyway (the sort here IS that order).
+static void SerializeSlotList(std::vector<uint32_t> slots, Writer* w) {
+  std::sort(slots.begin(), slots.end());
+  w->vu(slots.size());
+  uint32_t prev = 0;
+  for (auto s : slots) {
+    w->vu(s - prev);
+    prev = s;
+  }
 }
 
 static bool ParseSlotList(Reader* r, std::vector<uint32_t>* slots) {
   slots->clear();
-  uint32_t n = r->u32();
-  for (uint32_t i = 0; i < n && r->ok(); ++i) slots->push_back(r->u32());
+  uint64_t n = r->vu();
+  if (n > (1u << 20)) return false;  // corrupt frame guard
+  uint32_t prev = 0;
+  for (uint64_t i = 0; i < n && r->ok(); ++i) {
+    prev += static_cast<uint32_t>(r->vu());
+    slots->push_back(prev);
+  }
   return r->ok();
 }
 
 void SerializeRequestList(const RequestList& list, Writer* w) {
-  w->i64(list.epoch);
+  w->vi(list.epoch);
   w->u8(list.shutdown ? 1 : 0);
-  w->u32(static_cast<uint32_t>(list.requests.size()));
+  w->vu(list.requests.size());
   for (const auto& q : list.requests) SerializeRequest(q, w);
   SerializeSlotBitvector(list.cache_hits, w);
   SerializeSlotList(list.cache_evicts, w);
+  // Sub-coordinator member-failure report behind a flag byte: the
+  // healthy frame grows by exactly one byte.
+  w->u8(list.fail_rank >= 0 ? 1 : 0);
+  if (list.fail_rank >= 0) {
+    w->vi(list.fail_rank);
+    w->str(list.fail_message);
+  }
 }
 
 bool ParseRequestList(Reader* r, RequestList* out) {
-  out->epoch = r->i64();
+  out->epoch = r->vi();
   out->shutdown = r->u8() != 0;
-  uint32_t n = r->u32();
+  uint64_t n = r->vu();
+  if (n > (1u << 20)) return false;
   out->requests.resize(n);
-  for (uint32_t i = 0; i < n; ++i) {
+  for (uint64_t i = 0; i < n; ++i) {
     if (!ParseRequest(r, &out->requests[i])) return false;
   }
   if (!ParseSlotBitvector(r, &out->cache_hits)) return false;
   if (!ParseSlotList(r, &out->cache_evicts)) return false;
+  if (r->u8() != 0) {
+    out->fail_rank = static_cast<int32_t>(r->vi());
+    out->fail_message = r->str();
+  } else {
+    out->fail_rank = -1;
+    out->fail_message.clear();
+  }
   return r->ok();
 }
 
 static void SerializeResponse(const Response& s, Writer* w) {
   w->u8(static_cast<uint8_t>(s.type));
-  w->u32(static_cast<uint32_t>(s.tensor_names.size()));
+  w->vu(s.tensor_names.size());
   for (const auto& n : s.tensor_names) w->str(n);
   w->str(s.error_message);
-  w->u32(static_cast<uint32_t>(s.tensor_sizes.size()));
-  for (auto v : s.tensor_sizes) w->i64(v);
-  w->i32(s.root_rank);
+  w->vu(s.tensor_sizes.size());
+  for (auto v : s.tensor_sizes) w->vi(v);
+  w->vi(s.root_rank);
   w->u8(static_cast<uint8_t>(s.red_op));
   w->u8(static_cast<uint8_t>(s.wire_dtype));
-  w->u32(static_cast<uint32_t>(s.cache_slots.size()));
-  for (auto c : s.cache_slots) w->i32(c);
+  w->vu(s.cache_slots.size());
+  for (auto c : s.cache_slots) w->vi(c);
 }
 
 static bool ParseResponse(Reader* r, Response* s) {
   s->type = static_cast<ResponseType>(r->u8());
-  uint32_t n = r->u32();
+  uint64_t n = r->vu();
+  if (n > (1u << 20)) return false;
   s->tensor_names.resize(n);
-  for (uint32_t i = 0; i < n; ++i) s->tensor_names[i] = r->str();
+  for (uint64_t i = 0; i < n; ++i) s->tensor_names[i] = r->str();
   s->error_message = r->str();
-  uint32_t m = r->u32();
+  uint64_t m = r->vu();
+  if (m > (1u << 20)) return false;
   s->tensor_sizes.clear();
-  for (uint32_t i = 0; i < m && r->ok(); ++i) s->tensor_sizes.push_back(r->i64());
-  s->root_rank = r->i32();
+  for (uint64_t i = 0; i < m && r->ok(); ++i) {
+    s->tensor_sizes.push_back(r->vi());
+  }
+  s->root_rank = static_cast<int32_t>(r->vi());
   s->red_op = static_cast<ReduceOp>(r->u8());
   s->wire_dtype = static_cast<WireDtype>(r->u8());
-  uint32_t c = r->u32();
+  uint64_t c = r->vu();
+  if (c > (1u << 20)) return false;
   s->cache_slots.clear();
-  for (uint32_t i = 0; i < c && r->ok(); ++i) s->cache_slots.push_back(r->i32());
+  for (uint64_t i = 0; i < c && r->ok(); ++i) {
+    s->cache_slots.push_back(static_cast<int32_t>(r->vi()));
+  }
   // Normalize: every tensor name has a slot entry (-1 = uncached), so
   // consumers can index the two vectors in lockstep unconditionally.
   s->cache_slots.resize(s->tensor_names.size(), -1);
@@ -129,12 +175,12 @@ static bool ParseResponse(Reader* r, Response* s) {
 }
 
 void SerializeResponseList(const ResponseList& list, Writer* w) {
-  w->i64(list.epoch);
+  w->vi(list.epoch);
   w->u8(list.shutdown ? 1 : 0);
   w->u8(list.abort ? 1 : 0);
-  w->i32(list.abort_rank);
+  w->vi(list.abort_rank);
   w->str(list.abort_message);
-  w->u32(static_cast<uint32_t>(list.responses.size()));
+  w->vu(list.responses.size());
   for (const auto& s : list.responses) SerializeResponse(s, w);
   SerializeSlotList(list.cached_slots, w);
   SerializeSlotList(list.evict_slots, w);
@@ -143,25 +189,26 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
   w->u8(list.tune ? 1 : 0);
   if (list.tune) {
     w->u8(list.tune_commit ? 1 : 0);
-    w->i64(list.tune_trial_id);
-    w->i64(list.tune_chunk_bytes);
-    w->i64(list.tune_fusion_threshold);
-    w->i32(list.tune_cycle_time_ms);
-    w->i32(list.tune_wave_width);
-    w->i64(list.tune_algo_threshold);
-    w->i32(list.tune_wire_dtype);
+    w->vi(list.tune_trial_id);
+    w->vi(list.tune_chunk_bytes);
+    w->vi(list.tune_fusion_threshold);
+    w->vi(list.tune_cycle_time_ms);
+    w->vi(list.tune_wave_width);
+    w->vi(list.tune_algo_threshold);
+    w->vi(list.tune_wire_dtype);
   }
 }
 
 bool ParseResponseList(Reader* r, ResponseList* out) {
-  out->epoch = r->i64();
+  out->epoch = r->vi();
   out->shutdown = r->u8() != 0;
   out->abort = r->u8() != 0;
-  out->abort_rank = r->i32();
+  out->abort_rank = static_cast<int32_t>(r->vi());
   out->abort_message = r->str();
-  uint32_t n = r->u32();
+  uint64_t n = r->vu();
+  if (n > (1u << 20)) return false;
   out->responses.resize(n);
-  for (uint32_t i = 0; i < n; ++i) {
+  for (uint64_t i = 0; i < n; ++i) {
     if (!ParseResponse(r, &out->responses[i])) return false;
   }
   if (!ParseSlotList(r, &out->cached_slots)) return false;
@@ -169,13 +216,13 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
   out->tune = r->u8() != 0;
   if (out->tune) {
     out->tune_commit = r->u8() != 0;
-    out->tune_trial_id = r->i64();
-    out->tune_chunk_bytes = r->i64();
-    out->tune_fusion_threshold = r->i64();
-    out->tune_cycle_time_ms = r->i32();
-    out->tune_wave_width = r->i32();
-    out->tune_algo_threshold = r->i64();
-    out->tune_wire_dtype = r->i32();
+    out->tune_trial_id = r->vi();
+    out->tune_chunk_bytes = r->vi();
+    out->tune_fusion_threshold = r->vi();
+    out->tune_cycle_time_ms = static_cast<int32_t>(r->vi());
+    out->tune_wave_width = static_cast<int32_t>(r->vi());
+    out->tune_algo_threshold = r->vi();
+    out->tune_wire_dtype = static_cast<int32_t>(r->vi());
   }
   return r->ok();
 }

@@ -106,6 +106,12 @@ struct RequestList {
   int64_t epoch = 0;
   std::vector<Request> requests;
   bool shutdown = false;    // shutdown piggybacks on the control stream
+  // Hierarchical coordination: a sub-coordinator (per-host group leader)
+  // that loses one of its local members cannot broadcast an abort itself
+  // — it reports the culprit here so rank 0's abort verdict names the
+  // rank that actually died, not the leader that noticed.  -1 = healthy.
+  int32_t fail_rank = -1;
+  std::string fail_message;
   // Response-cache control (upstream Horovod 0.21's bitvector idea): a
   // tensor whose (name, type, dtype, shape, root, op) was negotiated
   // before is reported as a single bit — the coordinator-assigned cache
@@ -194,14 +200,33 @@ struct ResponseList {
 };
 
 // Flat byte-buffer serialization (host byte order; in-cluster only).
+// Fixed-width u32/i32/i64 remain for rendezvous handshakes (magic tags,
+// pre-negotiation fields); the per-cycle control frames use the varint
+// encoders below so steady-state negotiation bytes scale with the VALUES
+// on the wire (small slot ids, small counts, small dims), not with the
+// widest field any frame might ever need.
 class Writer {
  public:
   void u8(uint8_t v) { buf_.push_back(v); }
   void u32(uint32_t v) { append(&v, 4); }
   void i32(int32_t v) { append(&v, 4); }
   void i64(int64_t v) { append(&v, 8); }
+  // LEB128 varint: 7 value bits per byte, high bit = continuation.
+  void vu(uint64_t v) {
+    while (v >= 0x80) {
+      buf_.push_back(static_cast<uint8_t>(v) | 0x80);
+      v >>= 7;
+    }
+    buf_.push_back(static_cast<uint8_t>(v));
+  }
+  // ZigZag-mapped signed varint: small magnitudes of either sign stay
+  // one byte (epochs, root ranks incl. -1, tensor dims).
+  void vi(int64_t v) {
+    vu((static_cast<uint64_t>(v) << 1) ^
+       static_cast<uint64_t>(v >> 63));
+  }
   void str(const std::string& s) {
-    u32(static_cast<uint32_t>(s.size()));
+    vu(s.size());
     append(s.data(), s.size());
   }
   const std::vector<uint8_t>& bytes() const { return buf_; }
@@ -221,16 +246,42 @@ class Reader {
   uint32_t u32() { uint32_t v; memcpy(&v, take(4), 4); return v; }
   int32_t i32() { int32_t v; memcpy(&v, take(4), 4); return v; }
   int64_t i64() { int64_t v; memcpy(&v, take(8), 8); return v; }
+  uint64_t vu() {
+    uint64_t v = 0;
+    for (int shift = 0; shift < 64; shift += 7) {
+      uint8_t b = u8();
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if ((b & 0x80) == 0) return v;
+    }
+    ok_ = false;  // > 10 continuation bytes: corrupt frame
+    return 0;
+  }
+  int64_t vi() {
+    uint64_t v = vu();
+    return static_cast<int64_t>((v >> 1) ^ (~(v & 1) + 1));
+  }
   std::string str() {
-    uint32_t n = u32();
-    const uint8_t* s = take(n);
+    uint64_t n = vu();
+    // Compare against the REMAINING length, never via p_ + n: with an
+    // untrusted varint n near 2^64 the pointer sum overflows (UB) and
+    // the check silently passes — a corrupt frame must fail parse
+    // cleanly, not wrap into a multi-exabyte string construction.
+    if (n > static_cast<uint64_t>(end_ - p_)) {
+      ok_ = false;
+      return std::string();
+    }
+    const uint8_t* s = take(static_cast<size_t>(n));
     return std::string(reinterpret_cast<const char*>(s), n);
   }
   bool ok() const { return ok_; }
 
  private:
   const uint8_t* take(size_t n) {
-    if (p_ + n > end_) { ok_ = false; static uint8_t zero[8] = {0}; return zero; }
+    if (n > static_cast<size_t>(end_ - p_)) {
+      ok_ = false;
+      static uint8_t zero[8] = {0};
+      return zero;
+    }
     const uint8_t* r = p_;
     p_ += n;
     return r;

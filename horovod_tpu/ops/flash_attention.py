@@ -40,6 +40,7 @@ import functools
 import math
 import threading
 import warnings
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -519,20 +520,23 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _pad_head_dim(q, k, v):
-    """Zero-pad D to the next MXU tile (64) and fold the TRUE softmax
-    scale into q: with zero-padded dims the scores are unchanged, and
-    (q * sqrt(Dp)/sqrt(D)) under the kernel's 1/sqrt(Dp) scale equals q
-    under 1/sqrt(D).  Autodiff slices the grads back through the pad
+    """Zero-pad D to the next MXU tile (64).  Zero dims contribute
+    nothing to any q·k score, so the padded kernel computes identical
+    attention PROVIDED the caller threads the TRUE head dim's softmax
+    scale through as the kernel's fp32 ``sm_scale`` (a nondiff Python
+    float).  It must NOT be folded into q: pre-multiplying by a
+    ``q.dtype``-rounded ``sqrt(Dpad)/sqrt(D)`` constant perturbs every
+    score's softmax temperature in bf16 (~0.4% max), smearing padded vs
+    dense parity.  Autodiff slices the grads back through the pad
     (grad-of-pad = slice).  Returns padded (q, k, v)."""
     d = q.shape[-1]
     dp = -(-d // 64) * 64
     pad = ((0, 0), (0, 0), (0, 0), (0, dp - d))
-    qp = jnp.pad(q, pad) * jnp.asarray(
-        math.sqrt(dp) / math.sqrt(d), q.dtype)
-    return qp, jnp.pad(k, pad), jnp.pad(v, pad)
+    return jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
 
 
-def flash_attention_lse(q, k, v, *, causal: bool = True):
+def flash_attention_lse(q, k, v, *, causal: bool = True,
+                        _sm_scale: Optional[float] = None):
     """Flash attention returning ``(out [B,S,H,D], lse [B,H,S] fp32)``.
 
     The lse output makes partial attentions COMPOSABLE: blockwise
@@ -547,9 +551,10 @@ def flash_attention_lse(q, k, v, *, causal: bool = True):
     a silent dense path would defeat the memory contract the caller is
     composing for).  Off-tile head dims ARE handled: D % 64 != 0 is
     zero-padded to the next MXU tile and sliced back (zero dims change
-    neither the scores nor the lse; the true 1/sqrt(D) scale is folded
-    into q), so ring attention keeps its per-hop kernel for small-head
-    models.
+    neither the scores nor the lse; the TRUE head dim's 1/sqrt(D) rides
+    through as the kernel's fp32 sm_scale rather than a q.dtype-rounded
+    multiplier on q — see ``_pad_head_dim``), so ring attention keeps
+    its per-hop kernel for small-head models.
     """
     B, S, Hq, D = q.shape
     if not flash_lse_supported(S, D):
@@ -558,9 +563,12 @@ def flash_attention_lse(q, k, v, *, causal: bool = True):
             f"got S={S}, D={D}; gate on flash_lse_supported()")
     if D % 64 != 0:
         qp, kp, vp = _pad_head_dim(q, k, v)
-        out, lse = flash_attention_lse(qp, kp, vp, causal=causal)
+        out, lse = flash_attention_lse(
+            qp, kp, vp, causal=causal,
+            _sm_scale=_sm_scale if _sm_scale is not None
+            else 1.0 / math.sqrt(D))
         return out[..., :D], lse
-    sm_scale = 1.0 / math.sqrt(D)
+    sm_scale = _sm_scale if _sm_scale is not None else 1.0 / math.sqrt(D)
     qt, kt, vt = _flat_layout(q, k, v)
     out, lse = _flash_lse(qt, kt, vt, causal, sm_scale)
     return (out.reshape(B, Hq, S, D).transpose(0, 2, 1, 3),
@@ -667,7 +675,8 @@ def _pad_to_tile(q, k, v, causal, key_padding_mask, segment_ids):
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
-                    key_padding_mask=None, segment_ids=None):
+                    key_padding_mask=None, segment_ids=None,
+                    _sm_scale: Optional[float] = None):
     """Flash attention on [B, S, H, D] tensors (the model zoo seam).
 
     ``key_padding_mask``: optional [B, S] boolean (True = attend to that
@@ -684,8 +693,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     the block size keep the kernel.  Head dims off the MXU tiling (D not
     a multiple of 64) are likewise zero-padded to the next multiple of 64
     and sliced back — zero dims contribute nothing to the scores, and the
-    softmax scale is folded into q (q·sqrt(Dpad/D) with the kernel's
-    1/sqrt(Dpad) equals the true 1/sqrt(D)) — so small-head models keep
+    TRUE head dim's 1/sqrt(D) is threaded through as the kernel's fp32
+    sm_scale (never a q.dtype-rounded multiplier on q, which would shift
+    every score's softmax temperature in bf16; see ``_pad_head_dim``) —
+    so small-head models keep
     the kernel and its O(S) memory contract instead of materializing the
     [B, H, S, S] dense scores (measured 1.2x faster than the dense path
     at D=32, S=4096 fwd+bwd on v5e, and the only option that does not
@@ -714,15 +725,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
         qp, kp, vp = _pad_head_dim(q, k, v)  # see _pad_head_dim
         out = flash_attention(
             qp, kp, vp, causal=causal,
-            key_padding_mask=key_padding_mask, segment_ids=segment_ids)
+            key_padding_mask=key_padding_mask, segment_ids=segment_ids,
+            _sm_scale=_sm_scale if _sm_scale is not None
+            else 1.0 / math.sqrt(D))
         return out[..., :D]
     if S % 128 != 0:
         q, k, v, key_padding_mask, segment_ids = _pad_to_tile(
             q, k, v, causal, key_padding_mask, segment_ids)
         return flash_attention(
             q, k, v, causal=causal, key_padding_mask=key_padding_mask,
-            segment_ids=segment_ids)[:, :S]
-    sm_scale = 1.0 / math.sqrt(D)
+            segment_ids=segment_ids, _sm_scale=_sm_scale)[:, :S]
+    sm_scale = _sm_scale if _sm_scale is not None else 1.0 / math.sqrt(D)
     qt, kt, vt = _flat_layout(q, k, v)
     if segment_ids is not None:
         starts = _segment_starts(jnp.asarray(segment_ids))

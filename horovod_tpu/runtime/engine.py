@@ -196,6 +196,10 @@ class NativeEngine:
                         "horovod_wire_int8_count",
                         "horovod_wire_fp8_count",
                         "horovod_wire_dtype",
+                        "horovod_assign_bytes_tx",
+                        "horovod_coordinator_cycle_ns_p50",
+                        "horovod_coordinator_cycle_ns_p99",
+                        "horovod_hier_coordinator",
                         "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
@@ -423,10 +427,11 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_wire_dtype", None),
+        if getattr(getattr(self._lib, "horovod_coordinator_cycle_ns_p99",
+                           None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the wire-compression "
+                "libhorovod_core.so predates the big-world control-plane "
                 "counters (and possibly earlier counter families) — "
                 "rebuild it with `make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
@@ -450,6 +455,17 @@ class NativeEngine:
                 self._lib.horovod_control_round_trips(),
             "stale_epoch_msgs":
                 self._lib.horovod_stale_epoch_msgs(),
+            # Big-world control plane: rendezvous ASSIGN bytes this
+            # coordinator sent (frame compaction metric), and the
+            # coordinator's control-plane cycle time p50/p99 over a
+            # sliding window of payload cycles (gather + negotiate +
+            # distribute, execution excluded; 0 on workers) — cycle
+            # latency is observable without the timeline.
+            "assign_bytes_tx": self._lib.horovod_assign_bytes_tx(),
+            "coordinator_cycle_ns_p50":
+                self._lib.horovod_coordinator_cycle_ns_p50(),
+            "coordinator_cycle_ns_p99":
+                self._lib.horovod_coordinator_cycle_ns_p99(),
             "data_bytes_tx": self._lib.horovod_data_bytes_tx(),
             "data_bytes_rx": self._lib.horovod_data_bytes_rx(),
             "reduce_ns": self._lib.horovod_reduce_ns(),
@@ -494,6 +510,8 @@ class NativeEngine:
                 "socket_buf_bytes": self._lib.horovod_socket_buf_bytes(),
                 "shm_enabled": bool(self._lib.horovod_shm_enabled()),
                 "algo_threshold": self._lib.horovod_algo_threshold(),
+                "hierarchical_coordinator":
+                    bool(self._lib.horovod_hier_coordinator()),
                 "wire_dtype": _WIRE_NAMES.get(
                     int(self._lib.horovod_wire_dtype()), "fp32"),
             },
@@ -512,8 +530,12 @@ class NativeEngine:
         now = self.stats()
         delta: dict = {}
         for k, v in now.items():
+            # Percentiles are sliding-window statistics, not cumulative
+            # counters — carry the current value like config/topology.
             if k in ("config", "num_channels", "topology",
-                     "allreduce_bus_bw_bytes_per_sec"):
+                     "allreduce_bus_bw_bytes_per_sec",
+                     "coordinator_cycle_ns_p50",
+                     "coordinator_cycle_ns_p99"):
                 delta[k] = v
                 continue
             delta[k] = v - since.get(k, 0)

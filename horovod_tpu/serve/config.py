@@ -15,7 +15,8 @@ import dataclasses
 import os
 from typing import List, Optional
 
-__all__ = ["ServeConfig", "resolved_serve_config", "SERVE_KNOBS"]
+__all__ = ["ServeConfig", "resolved_serve_config", "SERVE_KNOBS",
+           "resolve_probe_knobs"]
 
 
 def _int_env(environ, name: str, dflt: int) -> int:
@@ -150,4 +151,50 @@ def resolved_serve_config(environ=os.environ) -> List[dict]:
             "effective": str(getattr(cfg, field)),
             "doc": doc,
         })
+    # Router-side liveness-probe knobs (not ServeConfig fields): the
+    # ONE resolver the router itself uses, so --print-config can never
+    # drift from the live values.
+    probe, deadline = resolve_probe_knobs(environ)
+    rows.append({
+        "env": "HOROVOD_SERVE_PROBE_SEC",
+        "set": environ.get("HOROVOD_SERVE_PROBE_SEC") or "",
+        "default": "5", "effective": str(probe),
+        "doc": "router liveness-probe ping interval for WEDGED (not "
+               "dead) replicas (<= 0 disables)"})
+    rows.append({
+        "env": "HOROVOD_SERVE_PROBE_DEADLINE_SEC",
+        "set": environ.get("HOROVOD_SERVE_PROBE_DEADLINE_SEC") or "",
+        "default": "max(60, 3*probe)", "effective": str(deadline),
+        "doc": "no-healthy-pong bound: a replica whose scheduler "
+               "heartbeat stays stale this long is killed so its "
+               "requests requeue like the death path (keep it above "
+               "the model's worst single-call time — first-request "
+               "jit compiles run inside one scheduler phase)"})
     return rows
+
+
+def _float_env(environ, name: str, dflt: float) -> float:
+    raw = environ.get(name)
+    if raw is None or raw == "":
+        return dflt
+    try:
+        return float(raw)
+    except ValueError:
+        return dflt
+
+
+def resolve_probe_knobs(environ=os.environ):
+    """(probe_interval_sec, probe_deadline_sec) for the router's
+    wedged-replica liveness probes — shared by Router and the
+    --print-config rows (one resolver, no drift; empty/garbled values
+    fall back to defaults instead of crashing the serve plane).
+
+    The deadline default is deliberately generous (60 s): the scheduler
+    heartbeat is stamped per PHASE, and a first-request jit compile
+    legitimately runs inside one phase — a deadline below the model's
+    worst single-call time would kill a healthy, compiling fleet one
+    replica at a time."""
+    probe = _float_env(environ, "HOROVOD_SERVE_PROBE_SEC", 5.0)
+    deadline = _float_env(environ, "HOROVOD_SERVE_PROBE_DEADLINE_SEC",
+                          max(60.0, 3 * probe))
+    return probe, deadline

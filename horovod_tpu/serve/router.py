@@ -51,6 +51,11 @@ class _Replica:
         self.writer: Optional[asyncio.StreamWriter] = None
         self.pending: Dict[str, "_ClientConn"] = {}
         self.alive = False
+        # Liveness probing (wedged-replica detection): when the last
+        # HEALTHY pong — answered AND its scheduler heartbeat fresh —
+        # was seen, reset on (re)spawn so a slow cold start is not
+        # mistaken for a wedge.
+        self.last_healthy = 0.0
         #: set by the supervisor once this replica can NEVER come back
         #: (clean exit, budget exhausted, or relaunch failed) — the
         #: router's queue-parking hope is "any replica not terminal".
@@ -96,8 +101,23 @@ class Router:
         self.counters = {
             "dispatched": 0, "completed": 0, "requeued": 0,
             "replica_deaths": 0, "rejoins": 0, "failed": 0,
-            "cancelled": 0,
+            "cancelled": 0, "wedged_kills": 0,
         }
+        # Liveness probes for WEDGED (not dead) replicas: a replica whose
+        # scheduler thread hangs keeps its socket open and its asyncio
+        # front-end answering, so death detection alone never fires.  The
+        # router pings every probe_sec; a replica with no HEALTHY pong —
+        # answered, with a fresh scheduler heartbeat — inside
+        # probe_deadline_sec is killed, which routes it through the
+        # normal death path: in-flight requests requeue onto survivors
+        # and the supervisor relaunches it under the restart budget
+        # (fault schedule scrubbed).  probe_sec <= 0 disables.  Resolved
+        # by serve.config.resolve_probe_knobs (the --print-config rows
+        # use the same resolver, and the deadline default is sized for
+        # in-phase jit compiles).
+        from horovod_tpu.serve.config import resolve_probe_knobs
+
+        self.probe_sec, self.probe_deadline_sec = resolve_probe_knobs()
 
     # -- replica lifecycle --
 
@@ -146,6 +166,7 @@ class Router:
         else:
             raise RuntimeError(f"cannot connect to replica {rep.idx}")
         rep.alive = True
+        rep.last_healthy = time.monotonic()
         self._tasks.append(asyncio.ensure_future(self._replica_reader(rep)))
         self._tasks.append(asyncio.ensure_future(self._supervise(rep)))
 
@@ -232,6 +253,24 @@ class Router:
                             and not rep.stats_waiter.done():
                         rep.stats_waiter.set_result(ev["stats"])
                     continue
+                if ev.get("event") == "pong":
+                    # Healthy = the asyncio side answered AND the
+                    # scheduler thread's heartbeat is FRESH — a wedged
+                    # scheduler behind a live socket must not refresh
+                    # the liveness clock.  Freshness is judged against a
+                    # few probe intervals, NOT the kill deadline: a pong
+                    # whose heartbeat is already deadline-old refreshing
+                    # the clock would double the effective detection
+                    # latency (stale clock only starts after the beat
+                    # has been stale a whole deadline).  The deadline
+                    # itself remains the grace for legitimately long
+                    # single phases (first-request jit compiles).
+                    age = ev.get("sched_age_sec")
+                    fresh = min(self.probe_deadline_sec,
+                                max(2 * self.probe_sec, 5.0))
+                    if age is None or age <= fresh:
+                        rep.last_healthy = time.monotonic()
+                    continue
                 rid = ev.get("id")
                 client = self._owners.get(rid)
                 if client is None:
@@ -294,6 +333,42 @@ class Router:
         self._queue.clear()
         for rid in pending:
             self._dispatch(rid)
+
+    # -- liveness probes (wedged-replica detection) --
+
+    async def _probe_loop(self) -> None:
+        while not self._shutdown.is_set():
+            await asyncio.sleep(self.probe_sec)
+            if self._shutdown.is_set():
+                return
+            now = time.monotonic()
+            for rep in self.replicas:
+                if not rep.alive or rep.proc is None:
+                    continue
+                stale = now - rep.last_healthy
+                if stale > self.probe_deadline_sec:
+                    # Kill, don't just mark down: the process is alive
+                    # but useless, and killing it routes everything
+                    # through the one battle-tested failure path — the
+                    # supervisor requeues its in-flight requests onto
+                    # survivors and relaunches it under the restart
+                    # budget with the fault schedule scrubbed.
+                    self.counters["wedged_kills"] += 1
+                    sys.stderr.write(
+                        f"replica {rep.idx} is wedged (no healthy pong "
+                        f"for {stale:.1f}s > "
+                        f"{self.probe_deadline_sec:.1f}s deadline); "
+                        f"killing it so its requests requeue\n")
+                    sys.stderr.flush()
+                    try:
+                        rep.proc.kill()
+                    except ProcessLookupError:
+                        pass
+                    continue
+                try:
+                    rep.writer.write(b'{"op": "ping"}\n')
+                except (ConnectionResetError, OSError):
+                    self._on_replica_down(rep)
 
     # -- client side --
 
@@ -433,6 +508,8 @@ class Router:
             raise
         server = await asyncio.start_server(self._handle_client, self.host,
                                             self.port)
+        if self.probe_sec > 0:
+            self._tasks.append(asyncio.ensure_future(self._probe_loop()))
         port = server.sockets[0].getsockname()[1]
         print(f"SERVE_ROUTER_READY port={port} replicas="
               f"{self.num_replicas} startup_sec="

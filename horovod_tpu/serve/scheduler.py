@@ -126,6 +126,12 @@ class Scheduler:
         self._running: List[_Seq] = []
         self._next_sid = 1
         self._t0 = time.monotonic()
+        # Liveness heartbeat: stamped every loop iteration (idle waits
+        # included), so a scheduler thread wedged inside a step — a hung
+        # model call, an injected `hang` fault — is distinguishable from
+        # a merely idle one.  The server's pong carries its age; the
+        # router's probe treats a stale heartbeat like a dead replica.
+        self.last_beat = time.monotonic()
         # Counters (cumulative; stats() snapshots them).
         self._c = {
             "requests_submitted": 0,
@@ -189,6 +195,7 @@ class Scheduler:
         """Loop until :meth:`stop`; call from a dedicated thread."""
         while True:
             with self._wake:
+                self.last_beat = time.monotonic()
                 if self._stop:
                     self._drain_all_locked()
                     return
@@ -200,15 +207,22 @@ class Scheduler:
 
     def step(self) -> None:
         """One scheduling iteration: intake, admission+prefill waves,
-        one batched decode step."""
+        one batched decode step.  The liveness heartbeat is stamped at
+        every PHASE boundary (not just per loop pass): a long-but-
+        progressing step — first-request jit compiles live inside one
+        prefill/decode call — keeps beating between phases, while a
+        genuinely wedged phase freezes the beat."""
+        self.last_beat = time.monotonic()
         self._intake()
         self._apply_cancellations()
         max_batch = max(1, int(self.max_batch))
         for _ in range(max(1, int(self.prefill_waves))):
+            self.last_beat = time.monotonic()
             if len(self._running) >= max_batch or not self._waiting:
                 break
             if not self._admit_and_prefill():
                 break  # head-of-line sequence not fundable yet
+        self.last_beat = time.monotonic()
         self._decode(max_batch)
         if self._tuner is not None:
             self._tuner.on_step()

@@ -21,7 +21,7 @@ Streamed responses (interleaved across in-flight requests)::
     {"event": "cancelled", "id": "r1"}
     {"event": "requeued", "id": "r1"}   # router only: stream restarts
     {"event": "stats", "stats": {...}}
-    {"event": "pong"}
+    {"event": "pong", "sched_age_sec": 0.004}
 
 Tokens stream as they are produced by the continuous-batching scheduler;
 after a replica death the router re-queues the request and the token
@@ -142,7 +142,16 @@ class ReplicaServer:
                     outbox.put_nowait({"event": "stats",
                                        "stats": self.scheduler.stats()})
                 elif op == "ping":
-                    outbox.put_nowait({"event": "pong"})
+                    # The pong carries the scheduler heartbeat's age: the
+                    # asyncio front-end answers even when the scheduler
+                    # THREAD is wedged (hung model call, injected hang),
+                    # so liveness probes must judge the scheduler, not
+                    # the socket.  See Router._probe_replicas.
+                    outbox.put_nowait({
+                        "event": "pong",
+                        "sched_age_sec": round(
+                            time.monotonic() - self.scheduler.last_beat,
+                            3)})
                 elif op == "shutdown":
                     outbox.put_nowait({"event": "bye"})
                     self.shutdown()

@@ -41,6 +41,11 @@ def pytest_configure(config):
         "markers",
         "fault: fault-injection multiproc tests; ci.sh reruns them under a "
         "hard timeout so a reintroduced hang fails fast")
+    config.addinivalue_line(
+        "markers",
+        "scale: big-world fleet tests (64+ engine ranks / 16-rank elastic "
+        "under hierarchical coordination); ci.sh runs them in the scale "
+        "gate under a hard timeout")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,117 @@
+"""Fleet launcher for the big-world scale harness.
+
+Spawns N real engine processes (tests/scale/scale_worker.py — ctypes
+only, ~10 MB RSS each, so 64 ranks fit the CI box), waits them out under
+a hard timeout, and returns rank 0's measurements.  Synthetic host
+grouping (HOROVOD_SCALE_GROUPS=G) makes the coordinator commit a G-group
+topology from per-rank HOROVOD_HOST_KEYs, which is what activates
+hierarchical coordination without G machines.
+
+Defaults keep a 64-rank world lightweight and control-plane-focused:
+shm off (64 ranks' ring-buffer wiring is data-plane load the control
+measurements don't need), one channel per edge, tiny payloads.  Bench
+(`bench_engine.py --scale`/`--scale-gate`) and tests/scale/test_scale.py
+share this module.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+from typing import Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "scale_worker.py")
+
+_STATS_RE = re.compile(r"SCALE_STATS (\{.*\})")
+_RDV_RE = re.compile(r"SCALE_RDV_MS ([\d.]+)")
+_PARITY_RE = re.compile(r"SCALE_PARITY ([0-9a-f]{16})")
+
+
+def ensure_lib() -> str:
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from horovod_tpu.common.native_build import ensure_native_lib
+
+    path = ensure_native_lib()
+    assert path is not None, "native engine build failed"
+    return path
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_world(n: int, *, groups: int = 1, steps: int = 50,
+              scenario: str = "steady", hier: bool = True,
+              payload_floats: int = 64, timeout: int = 240,
+              extra_env: Optional[dict] = None) -> dict:
+    """Run one world; returns {"stats": rank0 SCALE_STATS dict or None,
+    "rendezvous_ms": float, "parity": [per-rank hash]}."""
+    lib = ensure_lib()
+    port = _free_port()
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("HOROVOD_FAULT_INJECT", None)
+        env.pop("HOROVOD_HOST_KEY", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(n),
+            "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
+            "HOROVOD_SCALE_LIB": lib,
+            "HOROVOD_SCALE_GROUPS": str(groups),
+            "HOROVOD_SCALE_STEPS": str(steps),
+            "HOROVOD_SCALE_PAYLOAD_FLOATS": str(payload_floats),
+            "HOROVOD_HIERARCHICAL_COORDINATOR": "1" if hier else "0",
+            # Control-plane focus: tiny payloads over the flat TCP ring,
+            # fast cycles, and a bounded failure detector so a wedged
+            # fleet fails inside the gate timeout instead of at it.
+            "HOROVOD_SHM_DISABLE": "1",
+            "HOROVOD_NUM_CHANNELS": "1",
+            "HOROVOD_CYCLE_TIME": "2",
+            "HOROVOD_FAULT_TIMEOUT_SEC": "30",
+            # One engine worth of threads per rank is already N threads
+            # on this box; keep the per-rank pool minimal.
+            "HOROVOD_CHANNEL_DRIVERS": "1",
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, scenario], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    try:
+        results = [p.communicate(timeout=timeout) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for rank, (p, (out, err)) in enumerate(zip(procs, results)):
+        assert p.returncode == 0, (
+            f"scale rank {rank}/{n} failed (rc={p.returncode}):\n"
+            f"stdout: {out.decode()}\nstderr: {err.decode()[-4000:]}")
+    out0 = results[0][0].decode()
+    stats = None
+    m = _STATS_RE.search(out0)
+    if m:
+        stats = json.loads(m.group(1))
+    rdv = _RDV_RE.search(out0)
+    parity = []
+    for out, err in results:
+        pm = _PARITY_RE.search(out.decode())
+        if pm:
+            parity.append(pm.group(1))
+    return {
+        "stats": stats,
+        "rendezvous_ms": float(rdv.group(1)) if rdv else None,
+        "parity": parity,
+    }

@@ -1,0 +1,29 @@
+"""elastic_shrink_worker under a synthetic multi-group topology.
+
+Adopts a per-rank HOROVOD_HOST_KEY (HOROVOD_SCALE_GROUPS groups over the
+launch-time world) BEFORE the engine env is read, then runs the standard
+elastic-membership worker body — so the shrink/rejoin machinery executes
+with hierarchical coordination active: per-host sub-coordinators,
+aggregated readiness frames, leader relays.  Killing a group LEADER mid-
+run therefore exercises sub-coordinator failover: the re-rendezvous
+regroups the survivors by their (persistent) host keys and the next
+lowest surviving rank of the group becomes its leader under the new
+epoch.  Group membership keys off the persistent worker id, so a
+relaunched worker rejoins its original group.
+"""
+
+import os
+import runpy
+import sys
+
+_rank = int(os.environ.get("HOROVOD_RANK", "0"))
+_size = int(os.environ.get("HOROVOD_SIZE", "1"))
+_groups = int(os.environ.get("HOROVOD_SCALE_GROUPS", "4"))
+_per = max(1, _size // _groups)
+os.environ.setdefault(
+    "HOROVOD_HOST_KEY", f"scalehost{min(_rank // _per, _groups - 1)}")
+
+_TESTS = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(_TESTS))
+runpy.run_path(os.path.join(_TESTS, "elastic_shrink_worker.py"),
+               run_name="__main__")

@@ -1,0 +1,154 @@
+"""Big-world scale harness: 64 engine ranks on this box, hierarchical
+coordination parity/efficiency, and elastic membership under per-host
+sub-coordinators.
+
+Fast tests (16-rank steady run, 4-rank control-plane parity) run in
+tier-1; the 64-rank fleet and the 16-rank elastic failover runs carry
+the ``scale`` marker and run in ci.sh's scale gate under hard timeouts
+(the timeout is the hang detector — a wedged fleet fails fast).
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from scale.harness import REPO, run_world  # noqa: E402
+
+HIER_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "hier_elastic_worker.py")
+
+
+def test_steady_state_16_ranks_under_hierarchical_coordination():
+    # 16 ranks / 4 synthetic hosts: the control plane goes hierarchical
+    # (committed in the ASSIGN frame), steady state rides aggregated
+    # cache-hit bits at ~1 round trip per step, and the coordinator's
+    # cycle-time percentiles populate.
+    r = run_world(16, groups=4, steps=30, timeout=180)
+    s = r["stats"]
+    assert s is not None
+    assert s["hier"] == 1 and s["hosts"] == 4, s
+    assert s["cache_hits"] >= 29, s
+    assert s["control_round_trips"] <= 45, s  # ~1/step + warmup slack
+    assert s["coordinator_cycle_ns_p99"] > 0, s
+    assert s["coordinator_cycle_ns_p50"] <= s["coordinator_cycle_ns_p99"], s
+    assert s["stale_epoch_msgs"] == 0, s
+    assert r["rendezvous_ms"] is not None and r["rendezvous_ms"] < 60000
+
+
+def test_hier_off_bitwise_parity():
+    # HOROVOD_HIERARCHICAL_COORDINATOR=0 must restore the flat rank-0
+    # control star bit-for-bit: the full dtype/op corpus (fused bursts,
+    # broadcast, allgather, cached steady steps) produces byte-identical
+    # results with the hierarchy on and off over the SAME committed
+    # topology and transport.
+    on = run_world(4, groups=2, scenario="parity", hier=True, timeout=120)
+    off = run_world(4, groups=2, scenario="parity", hier=False, timeout=120)
+    assert len(on["parity"]) == 4 and len(off["parity"]) == 4
+    assert len(set(on["parity"])) == 1, on["parity"]
+    assert set(on["parity"]) == set(off["parity"]), (on["parity"],
+                                                    off["parity"])
+
+
+@pytest.mark.scale
+@pytest.mark.slow
+def test_64_rank_fleet_completes():
+    # 64 single-process engine ranks rendezvous and run 50 steady steps
+    # on this box under hierarchical coordination, every rank exiting
+    # clean with correct sums.  The hier-vs-flat byte-ratio assertion
+    # lives in bench_engine.py --scale-gate (one place to keep the
+    # threshold); this test is the fleet-completes hang detector the ci
+    # scale gate runs under its hard timeout.
+    r = run_world(64, groups=8, steps=50, timeout=300)
+    s = r["stats"]
+    assert s is not None
+    assert s["hier"] == 1 and s["hosts"] == 8, s
+    assert s["cache_hits"] >= 49, s
+    assert s["stale_epoch_msgs"] == 0, s
+    assert s["coordinator_cycle_ns_p99"] > 0, s
+    assert r["rendezvous_ms"] is not None
+
+
+def _run_hier_elastic_job(np_, inject, *, restarts=0, relaunch_delay=0.0,
+                          extra_env=None, timeout=360):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("HOROVOD_FAULT_INJECT", None)
+    env.update({
+        "HOROVOD_CYCLE_TIME": "2",
+        "HOROVOD_FAULT_TIMEOUT_SEC": "10",
+        "HOROVOD_ELASTIC_BACKOFF_SEC": "0.5",
+        "HOROVOD_ELASTIC_MAX_RETRIES": "4",
+        "HOROVOD_ELASTIC_GROW_TIMEOUT_SEC": "3",
+        "HOROVOD_ELASTIC_MIN_SIZE": "1",
+        "HOROVOD_SCALE_GROUPS": "4",
+        "HOROVOD_SHM_DISABLE": "1",
+        "HOROVOD_NUM_CHANNELS": "1",
+        "HOROVOD_FAULT_INJECT": inject,
+    })
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "horovod_tpu.run", "-np", str(np_),
+           "--elastic"]
+    if restarts:
+        cmd += ["--restart-on-failure", str(restarts)]
+    if relaunch_delay:
+        cmd += ["--relaunch-delay-sec", str(relaunch_delay)]
+    cmd += ["--", sys.executable, HIER_WORKER]
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          timeout=timeout)
+
+
+def _ok_lines(p):
+    return re.findall(
+        r"ELASTIC_OK id=(\d+) rank=(\d+) size=(\d+) epoch=(\d+) "
+        r"sizes=(\S+) loss=(\S+)", p.stdout.decode())
+
+
+@pytest.mark.scale
+@pytest.mark.fault
+@pytest.mark.slow
+def test_sub_coordinator_death_fails_over_at_16_ranks():
+    # Worker id 4 is the LEADER of group 1 (4 groups of 4): killing it
+    # mid-run must never hang — its members' relay waits fail over into
+    # the elastic re-rendezvous, the survivors regroup by host key (rank
+    # 5 becomes group 1's leader under the new epoch), and the 15-rank
+    # world finishes with identical loss everywhere.
+    p = _run_hier_elastic_job(16, "4:10:exit")
+    out = p.stdout.decode() + p.stderr.decode()
+    assert p.returncode == 0, out[-6000:]
+    oks = _ok_lines(p)
+    assert len(oks) == 15, out[-6000:]
+    assert {ok[2] for ok in oks} == {"15"}, oks
+    assert {ok[4] for ok in oks} == {"15,16"}, oks
+    assert all(int(ok[3]) >= 2 for ok in oks), oks
+    assert len({ok[5] for ok in oks}) == 1, oks  # identical final loss
+
+
+@pytest.mark.scale
+@pytest.mark.fault
+@pytest.mark.slow
+def test_sub_coordinator_rejoins_and_world_grows_back_at_16_ranks():
+    # The dead leader's relaunched incarnation rejoins its ORIGINAL host
+    # group (the key derives from the persistent worker id): the world
+    # shrinks to 15, then grows back to 16 under a further epoch, with
+    # hierarchical coordination active throughout.
+    p = _run_hier_elastic_job(
+        16, "4:10:exit", restarts=2, relaunch_delay=6.0,
+        extra_env={"HOROVOD_TEST_STEP_SEC": "0.3",
+                   "HOROVOD_TEST_TOTAL_STEPS": "40"},
+        timeout=420)
+    out = p.stdout.decode() + p.stderr.decode()
+    assert p.returncode == 0, out[-6000:]
+    oks = _ok_lines(p)
+    assert len(oks) == 16, out[-6000:]
+    assert {ok[2] for ok in oks} == {"16"}, oks
+    assert all(int(ok[3]) >= 3 for ok in oks), oks
+    assert len({ok[5] for ok in oks}) == 1, oks
+    survivors = [ok for ok in oks if ok[0] != "4"]
+    assert {ok[4] for ok in survivors} == {"15,16"}, oks
+    assert b"is waiting to join" in p.stdout, out[-6000:]

@@ -122,8 +122,9 @@ def test_flash_padded_tail_bidirectional_no_mask():
 def test_flash_small_head_dim_pads_to_kernel():
     """D off the MXU tiling (32) is zero-padded to 64 and sliced back —
     still the kernel with its O(S) memory contract, NOT the dense
-    fallback — with the true 1/sqrt(32) softmax scale preserved by the
-    q pre-scaling, and gradients flowing back through the pad."""
+    fallback — with the true 1/sqrt(32) softmax scale threaded through
+    as the kernel's fp32 sm_scale, and gradients flowing back through
+    the pad."""
     from horovod_tpu.ops import flash_attention as fa
 
     q, k, v = _qkv(S=128, D=32)
@@ -164,6 +165,37 @@ def test_flash_small_head_dim_masked_and_gqa():
                           key_padding_mask=jnp.asarray(mask))
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_flash_small_head_dim_bf16_scale_exact():
+    """The padded-D softmax scale stays EXACT in bf16: the true
+    1/sqrt(D) rides through as the kernel's fp32 sm_scale, never a
+    q.dtype-rounded sqrt(Dpad)/sqrt(D) multiplier baked into q (bf16's
+    8 mantissa bits round that constant, shifting every score's softmax
+    temperature relative to the dense path).  Asserted two ways: the pad
+    helper leaves q's values untouched, and the padded bf16 kernel holds
+    the SAME parity bound vs dense that the aligned-D bf16 path does —
+    plus a tighter bound vs the fp32 padded kernel, where bf16 input
+    rounding is the only remaining error source."""
+    from horovod_tpu.ops.flash_attention import _pad_head_dim
+
+    q, k, v = _qkv(S=128, D=32, dtype=jnp.bfloat16)
+    qp, kp, vp = _pad_head_dim(q, k, v)
+    assert qp.shape[-1] == 64
+    np.testing.assert_array_equal(np.asarray(qp[..., :32], np.float32),
+                                  np.asarray(q, np.float32))
+    np.testing.assert_array_equal(np.asarray(qp[..., 32:], np.float32), 0.0)
+
+    expected = causal_attention(q, k, v)
+    got = flash_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(expected, np.float32),
+        atol=3e-2, rtol=3e-2)  # same bound test_flash_bf16 holds at D=128
+    ref32 = flash_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                            v.astype(jnp.float32))
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref32), atol=1.5e-2,
+        rtol=1.5e-2)
 
 
 def test_llama_with_flash_attention():

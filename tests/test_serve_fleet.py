@@ -163,6 +163,52 @@ def test_two_replica_fleet_serves_and_balances(offline):
 
 
 @pytest.mark.fault
+def test_wedged_replica_probed_killed_and_requeued(offline):
+    """Replica 1's SCHEDULER THREAD wedges at decode step 4 (injected
+    ``hang``) while its asyncio front-end stays up — death detection
+    alone never fires, because the socket never closes.  The router's
+    liveness probe must notice the stale scheduler heartbeat behind the
+    live pongs within the bounded deadline, kill the replica, requeue
+    its in-flight requests onto the survivor (exact offline tokens, zero
+    dropped — the same contract as the death path), and relaunch it
+    under the restart budget with the fault scrubbed."""
+    fleet = _Fleet(replicas=2, restart=2,
+                   extra_env={"HOROVOD_FAULT_INJECT": "1:4:hang",
+                              "HOROVOD_SERVE_PROBE_SEC": "1",
+                              "HOROVOD_SERVE_PROBE_DEADLINE_SEC": "4"})
+    try:
+        cli = ServeClient("127.0.0.1", fleet.port, timeout=240)
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(0, 512, int(rng.integers(3, 12))).tolist()
+                   for _ in range(8)]
+        results = _run_jobs(cli, prompts, max_tokens=20)
+        requeued_streams = 0
+        for i, prompt in enumerate(prompts):
+            evs = results[f"job{i}"]
+            assert evs[-1]["event"] == "done", f"job{i} dropped: {evs[-1]}"
+            assert len(evs[-1]["tokens"]) == 20
+            np.testing.assert_array_equal(
+                np.asarray(evs[-1]["tokens"]), offline(prompt, 20))
+            if any(e["event"] == "requeued" for e in evs):
+                requeued_streams += 1
+        assert requeued_streams > 0, \
+            "hang fired but nothing was requeued:\n" + "".join(
+                fleet.log[-30:])
+        stats = cli.stats()
+        assert stats["router"]["completed"] == 8
+        assert stats["router"]["wedged_kills"] >= 1, stats["router"]
+        assert stats["router"]["replica_deaths"] >= 1, stats["router"]
+        assert stats["router"]["restarts_left"] < 2, stats["router"]
+        assert any("is wedged" in line for line in fleet.log), \
+            "".join(fleet.log[-30:])
+        rc = fleet.stop(cli)
+        assert rc == 0, "".join(fleet.log[-20:])
+        cli.close()
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.fault
 def test_replica_death_requeues_all_requests(offline):
     """Kill replica 1 after 4 decode steps (HOROVOD_FAULT_INJECT
     schedule): its in-flight requests are re-queued onto replica 0 and
