@@ -27,7 +27,7 @@ echo "== test suite (8-device virtual CPU mesh) =="
 # Caller args go BEFORE the marker filter so a user-passed -m cannot
 # override it — the fault tests must only ever run under the hard
 # timeout below (a reintroduced hang would otherwise eat the CI budget).
-PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale"
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler"
 
 echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # These tests previously WOULD HANG when a rank died mid-collective; the
@@ -56,6 +56,21 @@ echo "== elastic resize gate (3 ranks, kill rank 2, no replacement) =="
 PALLAS_AXON_POOL_IPS= timeout -k 15 300 \
     python -m pytest \
     "tests/test_fault_tolerance.py::test_shrink_to_survivors_completes_at_smaller_size" -q
+
+echo "== straggler gate (slow faults at 4 ranks, p99 + convergence, hard timeout) =="
+# Backup-worker straggler tolerance: under the seeded
+# HOROVOD_FAULT_INJECT=3:*:slow:200 schedule, HOROVOD_BACKUP_WORKERS=1
+# must cut the fast ranks' step-time p99 >= 2x vs k=0 (judged on the
+# deterministic step_time_ns counters — measured ~3.7x on this box) with
+# ZERO aborts, and the k=1 convergence worker must land inside its loss
+# bound.  Deliberately OUTSIDE the fault/soak gates (own marker): those
+# gates' budgets are sized for abort paths, and a straggler run is
+# slow-by-design, not slow-by-hang — the hard timeout here is the hang
+# detector.  The k=0 parity check carries the straggler marker too (it
+# runs HERE, not in the main sweep — no duplicate); the skip and
+# cached-partial semantics tests stay fast + unmarked in the main sweep.
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
+    python -m pytest tests/test_straggler.py -q -m "straggler"
 
 echo "== control-plane cache gate (2 ranks, 50 steps, hard timeout) =="
 # Regression gate for the negotiation response cache: a steady-state

@@ -155,6 +155,20 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_RENDEZVOUS_TIMEOUT_SEC", "120",
          lambda raw: str(max(5, _int_env(raw, 120))),
          "first-rendezvous / join-exchange deadline"),
+    Knob("HOROVOD_BACKUP_WORKERS", "0",
+         lambda raw: str(max(0, _int_env(raw, 0))),
+         "backup-worker collectives: SUM allreduces commit at size-k "
+         "voter readiness; skipped ranks get the clean StepSkipped "
+         "status and averaging divides by participants (0 = fully "
+         "synchronous; docs/elastic.md 'Straggler tolerance')"),
+    Knob("HOROVOD_BACKUP_GRACE_MS", "50",
+         lambda raw: str(max(0, _int_env(raw, 50))),
+         "minimum pending age before a partial commit may skip a rank"),
+    Knob("HOROVOD_LOCAL_SGD_STEPS", "1",
+         lambda raw: str(max(1, _int_env(raw, 1))),
+         "local-SGD periodic sync: H local steps per outer model-delta "
+         "allreduce (1 = fully synchronous, byte-identical; "
+         "DistributedOptimizer(local_sgd_steps=))"),
     Knob("HOROVOD_ELASTIC", "0", lambda raw: str(_int_env(raw, 0)),
          "in-place elastic membership"),
     Knob("HOROVOD_AUTOTUNE", "0", lambda raw: str(_int_env(raw, 0)),

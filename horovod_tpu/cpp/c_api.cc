@@ -212,6 +212,34 @@ int64_t horovod_wire_dtype() {
   return static_cast<int64_t>(Engine::Get().wire_dtype());
 }
 
+// Straggler-tolerance observability (HOROVOD_BACKUP_WORKERS / local
+// SGD): the committed over-provisioning, how many partial commits left
+// THIS rank out, outer local-SGD syncs noted by the Python policy, and
+// sliding-window percentiles of allreduce completion latency
+// (enqueue → finish) — the deterministic instrument the straggler gate
+// compares between k=0 and k=1 runs.
+int64_t horovod_backup_workers() {
+  return static_cast<int64_t>(Engine::Get().backup_workers());
+}
+int64_t horovod_backup_skips() { return Engine::Get().backup_skips(); }
+int64_t horovod_local_sgd_syncs() {
+  return Engine::Get().local_sgd_syncs();
+}
+void horovod_note_local_sgd_sync() { Engine::Get().NoteLocalSgdSync(); }
+int64_t horovod_step_time_ns_p50() {
+  return Engine::Get().step_time_ns_p50();
+}
+int64_t horovod_step_time_ns_p99() {
+  return Engine::Get().step_time_ns_p99();
+}
+// Ranks whose data a finished handle's response actually reduced (size
+// for a full commit, the participant count for a backup-worker partial
+// commit, 0 for a skipped entry): divisor-correct averaging divides by
+// this, never blindly by size.
+int64_t horovod_result_participants(int64_t handle) {
+  return static_cast<int64_t>(Engine::Get().ResultParticipants(handle));
+}
+
 // Effective (currently in-force) knob values for stats()["config"]:
 // post-autotune, not the env defaults — chunk/fusion/cycle/wave are
 // live-tunable, the rest report the committed wiring-time resolution.

@@ -509,6 +509,14 @@ static int64_t EnvInt64(const char* name, int64_t dflt) {
   return std::strtoll(v, nullptr, 10);
 }
 
+// Magic status prefix the Python layer maps to its StepSkipped
+// exception (like __sparse_retry__): a clean per-step outcome, not an
+// engine abort — the world stays healthy and the next enqueue works.
+static const char kSkippedStepError[] =
+    "__skipped_step__: a backup-worker partial commit "
+    "(HOROVOD_BACKUP_WORKERS) left this rank out of this step's "
+    "reduction — skip the local update or re-sync, then continue";
+
 // Identity used for co-location grouping at rendezvous.  HOROVOD_HOST_KEY
 // overrides (tests fake multi-host topologies on one box with it);
 // otherwise hostname#boot-id — the boot id disambiguates containers that
@@ -653,6 +661,20 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   }
   shm_ring_bytes_ = EnvInt64("HOROVOD_SHM_RING_BYTES", 2 << 20);
   if (shm_ring_bytes_ < (1 << 16)) shm_ring_bytes_ = 1 << 16;
+  // Straggler tolerance: over-provision k backup workers — the
+  // coordinator commits a SUM allreduce once nvoters-k voters are ready
+  // (after the grace window) instead of waiting for the whole world.
+  // The coordinator's resolution is committed at rendezvous (workers
+  // adopt it below, like the channel count); 0 = fully synchronous.
+  backup_workers_ =
+      static_cast<int>(EnvInt64("HOROVOD_BACKUP_WORKERS", 0));
+  if (backup_workers_ < 0) backup_workers_ = 0;
+  backup_grace_ms_ =
+      static_cast<int>(EnvInt64("HOROVOD_BACKUP_GRACE_MS", 50));
+  if (backup_grace_ms_ < 0) backup_grace_ms_ = 0;
+  // A dead incarnation's banked skip tokens are meaningless in the new
+  // world (fresh epoch, fresh commits).
+  skip_tokens_.clear();
   // HOROVOD_SHM_DISABLE=1: escape hatch back to the pure-TCP data plane
   // (bit-identical — transport never changes values).  The coordinator's
   // resolution (env AND a runtime /dev/shm probe) is committed at
@@ -763,27 +785,59 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       if (end == std::string::npos) end = all.size();
       std::string tok = all.substr(start, end - start);
       start = end + 1;
-      int frank = -1;
-      long long fstep = -1;
-      char fkind[16] = {0};
-      if (std::sscanf(tok.c_str(), "%d:%lld:%15s", &frank, &fstep, fkind)
-              != 3 || frank != worker_id_) {
+      // rank:step:kind[:arg] — split on ':' by hand: step may be '*'
+      // (every enqueue; meaningful for `slow`) and `slow` carries a
+      // 4th field (the delay in ms), neither of which sscanf's
+      // %d:%lld:%s handles.
+      std::vector<std::string> fields;
+      for (size_t p0 = 0; p0 <= tok.size();) {
+        size_t c = tok.find(':', p0);
+        if (c == std::string::npos) {
+          fields.push_back(tok.substr(p0));
+          break;
+        }
+        fields.push_back(tok.substr(p0, c - p0));
+        p0 = c + 1;
+      }
+      if (fields.size() < 3 || fields[0].empty() || fields[1].empty()) {
         continue;
       }
+      // Strictly numeric rank/step fields (end-pointer checked): a
+      // typo'd token must be IGNORED, not atoi'd to 0 — which would arm
+      // the fault on rank 0 and kill the coordinator.
+      char* endp = nullptr;
+      long frank = std::strtol(fields[0].c_str(), &endp, 10);
+      if (endp == nullptr || *endp != '\0') continue;
+      if (frank != worker_id_) continue;
+      long long fstep = -2;
+      if (fields[1] != "*") {
+        fstep = std::strtoll(fields[1].c_str(), &endp, 10);
+        if (endp == nullptr || *endp != '\0' || fstep < 0) continue;
+      }
+      const std::string& fkind = fields[2];
       fault_step_ = fstep;
-      if (std::strcmp(fkind, "exit") == 0) {
+      if (fkind == "exit") {
         fault_kind_ = FaultKind::EXIT;
-      } else if (std::strcmp(fkind, "hang") == 0) {
+      } else if (fkind == "hang") {
         fault_kind_ = FaultKind::HANG;
-      } else if (std::strcmp(fkind, "drop-conn") == 0) {
+      } else if (fkind == "drop-conn") {
         fault_kind_ = FaultKind::DROP_CONN;
-      } else if (std::strcmp(fkind, "stale-epoch") == 0) {
+      } else if (fkind == "stale-epoch") {
         fault_kind_ = FaultKind::STALE_EPOCH;
+      } else if (fkind == "slow") {
+        // rank:step:slow:ms — a deterministic per-step enqueue delay:
+        // the API thread sleeps before the enqueue while the background
+        // loop keeps heartbeating, i.e. a straggler, not a wedge.
+        fault_kind_ = FaultKind::SLOW;
+        fault_slow_ms_ = fields.size() > 3
+            ? std::strtoll(fields[3].c_str(), nullptr, 10) : 100;
+        if (fault_slow_ms_ < 0) fault_slow_ms_ = 0;
       } else {
         std::fprintf(stderr,
                      "horovod_tpu: unknown HOROVOD_FAULT_INJECT kind '%s' "
-                     "(want exit|hang|drop-conn|stale-epoch); ignored\n",
-                     fkind);
+                     "(want exit|hang|drop-conn|stale-epoch|slow); "
+                     "ignored\n",
+                     fkind.c_str());
         fault_step_ = -1;
         fault_kind_ = FaultKind::NONE;
         continue;
@@ -1246,6 +1300,7 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
   }
   rank_host_ = groups;
   shm_enabled_ = shm_commit;
+  if (backup_workers_ >= new_size) backup_workers_ = new_size - 1;
   // Control-plane hierarchy: the coordinator's env resolution is THE
   // resolution (default on; =0 restores the flat rank-0 star bit-for-
   // bit) — a per-rank split would leave leaders aggregating members
@@ -1299,6 +1354,10 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     w.i32(num_channels_);
     w.i32(wave_width_.load());
     w.i64(algo_threshold_.load());
+    // Committed backup-worker over-provisioning (clamped to the
+    // committed world): behavior is driven by the per-cycle participant
+    // bitmaps, but stats()["config"] must agree on every rank.
+    w.i32(backup_workers_);
     w.vu(uniq_hosts.size());
     for (const auto& h : uniq_hosts) w.str(h);
     for (int i = 0; i < new_size; ++i) {
@@ -1409,9 +1468,11 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     int32_t committed_channels = r.i32();
     int32_t committed_wave = r.i32();
     int64_t committed_algo = r.i64();
+    int32_t committed_backup = r.i32();
     if (!r.ok() || new_size < 1 || new_rank < 0 || new_rank >= new_size ||
         committed_channels < 1 || committed_channels > 16 ||
-        committed_wave < 1 || committed_wave > 16 || committed_algo < 0) {
+        committed_wave < 1 || committed_wave > 16 || committed_algo < 0 ||
+        committed_backup < 0 || committed_backup >= new_size) {
       lasterr = "bad membership assignment frame";
       break;
     }
@@ -1453,6 +1514,7 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     num_channels_ = committed_channels;
     wave_width_.store(committed_wave);
     algo_threshold_.store(committed_algo);
+    backup_workers_ = committed_backup;
     if (new_rank != worker_id_ || new_size != world_size_) {
       std::fprintf(stderr,
                    "horovod_tpu worker id %d: joined membership epoch %lld "
@@ -1535,6 +1597,9 @@ void Engine::ClearCacheState() {
   free_slots_.clear();
   next_slot_ = 0;
   sub_slot_bits_.clear();
+  // Backup-worker skip tokens ride along: they reference the dead (or
+  // about-to-be-recommitted) world's partial commits.
+  skip_tokens_.clear();
 }
 
 // ---------------------------------------------------------------------------
@@ -2221,6 +2286,12 @@ bool Engine::RunLoopOnce() {
     // new tensor must not inherit a stale group grant.
     if (hier) {
       for (uint32_t s : response_list.evict_slots) sub_slot_bits_.erase(s);
+      // A partially committed slot's held bits are stale: the skipped
+      // group's ready members just had their entries finished "skipped"
+      // and will re-report fresh hit bits for their NEXT step.
+      for (const auto& ps : response_list.partial_slots) {
+        sub_slot_bits_.erase(ps.slot);
+      }
     }
     Writer w;
     SerializeResponseList(response_list, &w);
@@ -2263,11 +2334,22 @@ bool Engine::RunLoopOnce() {
     // local replica from the list it just broadcast, execute the fully
     // negotiated responses, then the agreed cached slots.
     ApplyCacheUpdates(response_list);
+    // Apply a TUNE BEFORE executing this cycle's responses, not after:
+    // execution wakes API threads the moment a tensor finishes
+    // (FinishEntry), and an enqueue racing a post-execution apply could
+    // resolve its wire dtype from the not-yet-flipped knob on one rank
+    // and the flipped one on another — a clean negotiated mismatch, but
+    // a failed step (a rare-but-real flake of the live wire sweep).
+    // This point is equally atomic: every rank applies the same frame
+    // at the same cycle boundary with no response in flight, and this
+    // cycle's responses execute under the NEW knobs on every rank alike
+    // (their wire formats were committed per response at negotiation;
+    // chunk/wave/algo knobs flip identically everywhere).
+    if (response_list.tune) ApplyTune(response_list);
     bool executed_any = !response_list.responses.empty();
     ExecuteResponses(response_list.responses);
     if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
     if (executed_any) exec_cycles_.fetch_add(1);
-    if (response_list.tune) ApplyTune(response_list);
     if (!stall_check_disabled_) CheckForStalledTensors();
     if (hier) CheckForStalledSubBits();  // rank 0 leads group 0 too
     return !response_list.shutdown;
@@ -2403,6 +2485,11 @@ bool Engine::RunLoopOnce() {
     // (see AggregateGroup): pending-hit members resubmit on this very
     // frame, so nothing strands and no stale grant survives.
     for (uint32_t s : response_list.evict_slots) sub_slot_bits_.erase(s);
+    // Same for partially committed slots: held bits from the skipped
+    // step must not count toward the next step's group grant.
+    for (const auto& ps : response_list.partial_slots) {
+      sub_slot_bits_.erase(ps.slot);
+    }
   }
   if (response_list.abort) {
     // Coordinator-initiated collective abort: another rank failed.
@@ -2420,11 +2507,14 @@ bool Engine::RunLoopOnce() {
     control_round_trips_.fetch_add(1);
   }
   ApplyCacheUpdates(response_list);
+  // TUNE before execution — same reasoning (and the same ordering) as
+  // the coordinator path above: a completion-woken enqueue must never
+  // read a pre-TUNE knob after a peer already applied it.
+  if (response_list.tune) ApplyTune(response_list);
   bool executed_any = !response_list.responses.empty();
   ExecuteResponses(response_list.responses);
   if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
   if (executed_any) exec_cycles_.fetch_add(1);
-  if (response_list.tune) ApplyTune(response_list);
   if (leader) CheckForStalledSubBits();
   return !response_list.shutdown;
 }
@@ -2471,11 +2561,13 @@ bool Engine::DrainPendingTune(ResponseList* out) {
 }
 
 void Engine::ApplyTune(const ResponseList& list) {
-  // Runs between cycles on the background thread of every rank, after
-  // the carrying cycle's responses executed — no collective is in
-  // flight, so the knob flip can never split one op across configs.
-  // Clamps mirror Init exactly: every rank computes identical effective
-  // values from the identical broadcast.
+  // Runs between cycles on the background thread of every rank, BEFORE
+  // the carrying cycle's responses execute — no collective is in
+  // flight, so the knob flip can never split one op across configs,
+  // and a completion-woken enqueue can never read a pre-TUNE knob a
+  // peer already flipped (the wire-dtype race the live sweep test
+  // caught).  Clamps mirror Init exactly: every rank computes identical
+  // effective values from the identical broadcast.
   if (list.tune_chunk_bytes > 0) {
     int64_t chunk = std::max<int64_t>(4096, list.tune_chunk_bytes);
     chunk_bytes_.store(chunk & ~int64_t{7});
@@ -2549,6 +2641,31 @@ void Engine::DrainMessageQueue(RequestList* my_list) {
     pending.swap(message_queue_);
   }
   for (auto& q : pending) {
+    // Backup-worker skip token: this tensor was partially committed
+    // WITHOUT us before we enqueued it — consume the token and finish
+    // the entry with the clean skipped status; nothing goes on the wire
+    // (the coordinator already forgot the tensor).
+    if (!skip_tokens_.empty()) {
+      auto st = skip_tokens_.find(q.tensor_name);
+      if (st != skip_tokens_.end()) {
+        if (--st->second <= 0) skip_tokens_.erase(st);
+        TensorTableEntry e;
+        bool have = false;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto tit = tensor_table_.find(q.tensor_name);
+          if (tit != tensor_table_.end()) {
+            e = std::move(tit->second);
+            tensor_table_.erase(tit);
+            have = true;
+          }
+        }
+        if (have) {
+          FinishEntry(e, Status::PreconditionError(kSkippedStepError), 0);
+        }
+        continue;
+      }
+    }
     if (cache_enabled_ && !q.probe) {
       auto it = cache_by_name_.find(q.tensor_name);
       if (it != cache_by_name_.end()) {
@@ -2582,6 +2699,7 @@ static Request RequestFromEntry(const TensorTableEntry& e, int rank) {
   q.root_rank = e.root_rank;
   q.red_op = e.red_op;
   q.wire_dtype = e.wire_dtype;
+  q.wire_default = e.wire_default;
   for (int d = 0; d < e.shape.ndim(); ++d) q.shape.push_back(e.shape.dim(d));
   return q;
 }
@@ -2670,7 +2788,21 @@ bool Engine::ExecuteCachedResponses(const ResponseList& list,
     }
     pending_cache_hits_.erase(slot);
     timeline_.NegotiateCached(it->second.response.tensor_names[0]);
-    cached.push_back(it->second.response);
+    Response resp = it->second.response;
+    // Backup-worker partial commit on the cached path: graft the
+    // cycle's committed participant set onto the replayed response, and
+    // the payload geometry from the replica signature (a skipped rank
+    // holds the replica even when it holds no tensor entry).
+    for (const auto& ps : list.partial_slots) {
+      if (ps.slot != slot) continue;
+      resp.participants = ps.participants;
+      int64_t elems = 1;
+      for (auto d : it->second.sig.shape) elems *= d;
+      resp.partial_elems = elems;
+      resp.partial_dtype = static_cast<uint8_t>(it->second.sig.dtype);
+      break;
+    }
+    cached.push_back(std::move(resp));
   }
   // Deterministic across ranks: identical slot order (from the frame) and
   // identical per-tensor dtypes/sizes (signature-agreed) ⇒ identical
@@ -2803,6 +2935,12 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
     out.responses.push_back(std::move(resp));
   }
 
+  // Backup-worker straggler tolerance: commit SUM allreduces that are
+  // still short of full readiness but past the nvoters-k threshold and
+  // the grace window (full commits above always win the race — a tensor
+  // every rank reported this cycle never reaches this scan).
+  if (backup_workers_ > 0) MaybePartialCommits(&out);
+
   // Sparse-layout rendezvous: a pending entry whose received requests are
   // ALL layout probes (ranks with no local gradient), coexisting with a
   // pending sparse gather of the same tensor ("<name>.idx"), would
@@ -2862,21 +3000,31 @@ Response Engine::BuildResponse(const std::string& name) {
   Response resp;
   resp.tensor_names.push_back(name);
   std::ostringstream err;
-  // Wire-dtype reference for validation: the first NON-probe request.
-  // A layout probe (no local gradient) resolves its wire from the
-  // global knob, not the per-tensor override its peers may be using —
-  // holding it to the peers' format would fail the very step the probe
-  // machinery exists to survive.  Execution is safe either way: every
-  // rank executes the RESPONSE's committed wire, never its own
-  // request's.
+  // Wire-dtype reference for validation/commit: the first NON-probe
+  // request with an EXPLICIT per-tensor override, else the first
+  // non-probe request's knob-derived value.  A layout probe (no local
+  // gradient) resolves its wire from the global knob, not the
+  // per-tensor override its peers may be using — holding it to the
+  // peers' format would fail the very step the probe machinery exists
+  // to survive.  Knob-derived requests are advisory the same way
+  // (Request::wire_default): enqueue-time knob sampling races TUNE
+  // application across ranks, so the coordinator COMMITS one value
+  // instead of erroring.  Execution is safe in every case: every rank
+  // executes the RESPONSE's committed wire, never its own request's.
   const Request* wire_ref = nullptr;
+  const Request* knob_ref = nullptr;
   for (int r = 0; r < size_; ++r) {
-    if (!info.requests[r].probe) {
-      wire_ref = &info.requests[r];
+    const Request& q = info.requests[r];
+    if (q.probe) continue;
+    if (knob_ref == nullptr) knob_ref = &q;
+    if (!q.wire_default) {
+      wire_ref = &q;
       break;
     }
   }
-  if (wire_ref == nullptr) wire_ref = &first;  // all probes: global knob
+  if (wire_ref == nullptr) {
+    wire_ref = knob_ref != nullptr ? knob_ref : &first;
+  }
 
   for (int r = 1; r < size_; ++r) {
     const Request& q = info.requests[r];
@@ -2908,11 +3056,12 @@ Response Engine::BuildResponse(const std::string& name) {
       return resp;
     }
     // The L1 dtype validation extended to the WIRE format: the data
-    // plane quantizes on one committed format per response, so ranks
-    // disagreeing (per-tensor override drift, or a raced env change)
-    // must fail cleanly here — never garble bytes on the ring.  Probes
-    // are exempt (see wire_ref above) — they adopt the committed wire.
+    // plane quantizes on one committed format per response, so EXPLICIT
+    // overrides disagreeing must fail cleanly here — never garble bytes
+    // on the ring.  Probes and knob-derived (wire_default) requests are
+    // exempt — they adopt the committed wire (see wire_ref above).
     if (first.type == RequestType::ALLREDUCE && !q.probe &&
+        !q.wire_default && !wire_ref->wire_default &&
         q.wire_dtype != wire_ref->wire_dtype) {
       err << "Mismatched wire dtypes: rank " << wire_ref->request_rank
           << " requested " << WireDtypeName(wire_ref->wire_dtype)
@@ -3034,6 +3183,212 @@ Response Engine::BuildResponse(const std::string& name) {
   return resp;
 }
 
+// -- backup-worker partial commits (HOROVOD_BACKUP_WORKERS=k) --
+
+bool Engine::RankInParticipants(const std::vector<uint32_t>& parts) const {
+  for (uint32_t p : parts) {
+    if (static_cast<int>(p) == rank_) return true;
+  }
+  return false;
+}
+
+static std::string RankListString(const std::vector<bool>& in_set, int size,
+                                  bool invert) {
+  std::string s;
+  for (int r = 0; r < size; ++r) {
+    if (in_set[r] == invert) continue;
+    if (!s.empty()) s += ",";
+    s += std::to_string(r);
+  }
+  return s;
+}
+
+// Validate + build a single-tensor partial response over `participants`
+// (every one of them has a seen request).  Mirrors BuildResponse's
+// ALLREDUCE validation but only across the committed set; the entry is
+// consumed either way.  Partial commits are SUM-only (callers checked),
+// so red_op needs no mismatch message of its own.
+Response Engine::BuildPartialResponse(
+    const std::string& name, const std::vector<uint32_t>& participants) {
+  AssertBackgroundThread();
+  PendingInfo info;
+  {
+    auto it = message_table_.find(name);
+    info = std::move(it->second);
+    message_table_.erase(it);
+  }
+  timeline_.NegotiateEnd(name);
+  Response resp;
+  resp.tensor_names.push_back(name);
+  resp.cache_slots.assign(1, -1);
+  resp.participants = participants;
+  const Request& first = info.requests[participants[0]];
+  // Committed wire: the first participant with an EXPLICIT override
+  // wins, else the first participant's knob-derived value (same rule
+  // as BuildResponse).
+  const Request* wire_ref = &first;
+  for (uint32_t p : participants) {
+    if (!info.requests[p].wire_default) {
+      wire_ref = &info.requests[p];
+      break;
+    }
+  }
+  std::ostringstream err;
+  for (size_t i = 1; i < participants.size(); ++i) {
+    const Request& q = info.requests[participants[i]];
+    if (q.dtype != first.dtype) {
+      err << "Mismatched data types: rank " << first.request_rank << " has "
+          << DataTypeName(first.dtype) << " but rank " << q.request_rank
+          << " has " << DataTypeName(q.dtype) << " for tensor " << name
+          << ".";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+    if (q.shape != first.shape) {
+      err << "Mismatched allreduce tensor shapes for tensor " << name
+          << " (partial commit).";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+    // Same wire rule as BuildResponse: explicit overrides must agree;
+    // knob-derived wires adopt the committed one (TUNE-race immunity).
+    if (!q.wire_default && !wire_ref->wire_default &&
+        q.wire_dtype != wire_ref->wire_dtype) {
+      err << "Mismatched wire dtypes: rank " << wire_ref->request_rank
+          << " requested " << WireDtypeName(wire_ref->wire_dtype)
+          << " but rank " << q.request_rank << " requested "
+          << WireDtypeName(q.wire_dtype) << " for tensor " << name << ".";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+  }
+  resp.type = ResponseType::ALLREDUCE;
+  resp.red_op = ReduceOp::SUM;
+  resp.wire_dtype = wire_ref->wire_dtype;
+  int64_t elems = 1;
+  for (auto d : first.shape) elems *= d;
+  resp.partial_elems = elems;
+  resp.partial_dtype = static_cast<uint8_t>(first.dtype);
+  return resp;
+}
+
+// End-of-cycle scan for partially committable work.  Eligibility: SUM
+// allreduce (zero is the identity the skipped ranks' ghost buffers
+// contribute; MIN/MAX/PROD and every other collective wait for the full
+// world — which is also what makes a MAX allreduce a reliable barrier
+// under k > 0), no probes, pending longer than the grace window, and at
+// least nvoters-k ready voters.  Under hierarchical coordination a voter
+// is a HOST GROUP: a group counts only when every member reported, so a
+// whole late host is one late voter and one slow member sidelines its
+// host — exactly the sub-coordinator readiness-aggregation contract.
+void Engine::MaybePartialCommits(ResponseList* out) {
+  AssertBackgroundThread();
+  if (backup_workers_ <= 0 || size_ <= 1) return;
+  const bool hier = HierActive();
+  const int nvoters = hier ? nnodes_ : size_;
+  const int need = std::max(1, nvoters - backup_workers_);
+  if (need >= nvoters) return;  // k over-clamped on a tiny world
+  const auto now = std::chrono::steady_clock::now();
+  const auto grace = std::chrono::milliseconds(backup_grace_ms_);
+
+  // Full-request pending entries.  Names first: the commit erases them.
+  std::vector<std::string> names;
+  for (auto& kv : message_table_) {
+    const PendingInfo& info = kv.second;
+    if (info.count <= 0 || info.count >= size_) continue;
+    if (now - info.first_seen < grace) continue;
+    bool eligible = true;
+    for (int r = 0; r < size_ && eligible; ++r) {
+      if (!info.seen[r]) continue;
+      const Request& q = info.requests[r];
+      eligible = q.type == RequestType::ALLREDUCE &&
+                 q.red_op == ReduceOp::SUM && !q.probe;
+    }
+    if (eligible) names.push_back(kv.first);
+  }
+  for (const auto& name : names) {
+    const PendingInfo& info = message_table_[name];
+    std::vector<bool> rank_in(size_, false);
+    int ready = 0;
+    if (hier) {
+      std::vector<char> group_ready(nnodes_, 1);
+      for (int r = 0; r < size_; ++r) {
+        if (!info.seen[r]) group_ready[rank_host_[r]] = 0;
+      }
+      for (int g = 0; g < nnodes_; ++g) ready += group_ready[g] ? 1 : 0;
+      if (ready < need) continue;
+      for (int r = 0; r < size_; ++r) rank_in[r] = group_ready[rank_host_[r]];
+    } else {
+      ready = info.count;
+      if (ready < need) continue;
+      for (int r = 0; r < size_; ++r) rank_in[r] = info.seen[r];
+    }
+    std::vector<uint32_t> participants;
+    for (int r = 0; r < size_; ++r) {
+      if (rank_in[r]) participants.push_back(static_cast<uint32_t>(r));
+    }
+    if (participants.empty() ||
+        static_cast<int>(participants.size()) >= size_) {
+      continue;
+    }
+    timeline_.PartialCommit(name, RankListString(rank_in, size_, true));
+    out->responses.push_back(BuildPartialResponse(name, participants));
+  }
+
+  // Cached-slot readiness bits: same voter threshold, the replayed
+  // response comes from each rank's replica (the coordinator's own
+  // replica supplies the eligibility check — SUM allreduce only).
+  std::vector<uint32_t> pslots;
+  for (auto& kv : coord_slot_bits_) {
+    if (kv.second.count < need || kv.second.count >= nvoters) continue;
+    if (now - kv.second.first_seen < grace) continue;
+    auto ce = cache_entries_.find(kv.first);
+    if (ce == cache_entries_.end()) continue;  // defensive
+    if (ce->second.response.type != ResponseType::ALLREDUCE ||
+        ce->second.response.red_op != ReduceOp::SUM) {
+      continue;
+    }
+    pslots.push_back(kv.first);
+  }
+  std::sort(pslots.begin(), pslots.end());
+  for (uint32_t slot : pslots) {
+    const SlotPending& sp = coord_slot_bits_[slot];
+    std::vector<bool> rank_in(size_, false);
+    if (hier) {
+      for (int r = 0; r < size_; ++r) {
+        int g = rank_host_[r];
+        rank_in[r] = g < static_cast<int>(sp.seen.size()) && sp.seen[g];
+      }
+    } else {
+      for (int r = 0; r < size_ && r < static_cast<int>(sp.seen.size());
+           ++r) {
+        rank_in[r] = sp.seen[r];
+      }
+    }
+    std::vector<uint32_t> participants;
+    for (int r = 0; r < size_; ++r) {
+      if (rank_in[r]) participants.push_back(static_cast<uint32_t>(r));
+    }
+    if (participants.empty() ||
+        static_cast<int>(participants.size()) >= size_) {
+      continue;
+    }
+    auto nit = coord_slot_names_.find(slot);
+    timeline_.PartialCommit(nit == coord_slot_names_.end() ? "?"
+                                                           : nit->second,
+                            RankListString(rank_in, size_, true));
+    coord_slot_bits_.erase(slot);
+    out->cached_slots.push_back(slot);
+    ResponseList::PartialSlot ps;
+    ps.slot = slot;
+    ps.participants = std::move(participants);
+    out->partial_slots.push_back(std::move(ps));
+  }
+}
+
 // Consecutive same-dtype allreduces merge into one response executed as a
 // single ring collective over the fusion buffer.
 void Engine::FuseResponses(std::vector<Response>& responses) {
@@ -3060,7 +3415,12 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
     // Keep the slot-assignment vector parallel to tensor_names through
     // the merge (paths that never assign slots leave it empty).
     resp.cache_slots.resize(resp.tensor_names.size(), -1);
+    // Partial (backup-worker) responses never fuse: the participant set
+    // and ghost-buffer geometry are per-response, and fusing two
+    // different survivor sets would mix zero-contribution semantics.
     if (resp.type == ResponseType::ALLREDUCE && !fused.empty() &&
+        resp.participants.empty() &&
+        fused.back().participants.empty() &&
         fused.back().type == ResponseType::ALLREDUCE &&
         fused.back().red_op == resp.red_op &&
         fused.back().wire_dtype == resp.wire_dtype &&
@@ -3091,6 +3451,32 @@ static constexpr size_t kRelayChunk = 4u << 20;
 
 void Engine::ExecuteResponses(std::vector<Response>& responses) {
   if (responses.empty()) return;
+  // Backup-worker skip bookkeeping runs HERE, on the background thread,
+  // BEFORE any wave dispatch: skip_tokens_ and pending_cache_hits_ are
+  // background-thread-only (AssertBackgroundThread-checked), and a
+  // partial response landing at wave index >= 1 would otherwise mutate
+  // them from a pool thread.  PerformResponse then only ghost-executes
+  // (it never pops entries for a response that skipped this rank — an
+  // entry enqueued AFTER this sweep keeps its banked token and is
+  // finished by the next DrainMessageQueue, never stranded).
+  for (auto& resp : responses) {
+    if (resp.participants.empty() ||
+        RankInParticipants(resp.participants)) {
+      continue;
+    }
+    std::vector<TensorTableEntry> entries;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (const auto& name : resp.tensor_names) {
+        auto it = tensor_table_.find(name);
+        if (it != tensor_table_.end()) {
+          entries.push_back(std::move(it->second));
+          tensor_table_.erase(it);
+        }
+      }
+    }
+    NoteSkippedResponse(resp, entries);
+  }
   last_exec_time_ = std::chrono::steady_clock::now();
   // Concurrency degree: the flat ring (TCP or shm — both wire
   // num_channels_ disjoint port pairs) can run up to that many
@@ -3303,9 +3689,67 @@ bool Engine::CompressedRingAllreduce(uint8_t* base, int64_t count,
   return true;
 }
 
+void Engine::NoteSkippedResponse(const Response& response,
+                                 std::vector<TensorTableEntry>& entries) {
+  AssertBackgroundThread();  // skip_tokens_/pending_cache_hits_ owner
+  backup_skips_.fetch_add(1);
+  std::set<std::string> held;
+  for (auto& e : entries) held.insert(e.name);
+  for (const auto& name : response.tensor_names) {
+    if (held.count(name) != 0) continue;
+    // Not even enqueued yet (the straggler's API thread is behind):
+    // bank a token; the future enqueue consumes it and finishes
+    // "skipped" locally instead of shipping a request the coordinator
+    // already committed without us.
+    skip_tokens_[name] += 1;
+  }
+  if (!held.empty()) {
+    // The entry exists but its request raced this cycle's frame (it is
+    // still in message_queue_, unsent): purge it, or the next cycle
+    // would plant a stale pending entry on the coordinator.
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto it = message_queue_.begin(); it != message_queue_.end();) {
+      if (held.count(it->tensor_name) != 0) {
+        it = message_queue_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+  // A hit bit we already sent for this tensor was consumed by the
+  // partial slot commit (hier: one slow group member sidelines the
+  // whole group, ready members included) — drop the pending record so
+  // an evict can't resubmit a tensor that no longer exists.
+  for (auto it = pending_cache_hits_.begin();
+       it != pending_cache_hits_.end();) {
+    if (held.count(it->second) != 0) {
+      it = pending_cache_hits_.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  for (auto& e : entries) {
+    FinishEntry(e, Status::PreconditionError(kSkippedStepError), 0);
+  }
+}
+
 void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
+  // Backup-worker partial commit that left THIS rank out: the skip
+  // bookkeeping (finish-skipped entries, banked tokens) already ran in
+  // ExecuteResponses on the background thread — here (possibly a wave
+  // pool thread) we only ghost-drive the collective so the ring still
+  // spans the whole world (the ghost contributes zeros, the SUM
+  // identity).  A ghost never pops entries: one enqueued after the
+  // bookkeeping sweep is consumed by its banked token at the next
+  // DrainMessageQueue, never stranded here.
+  const bool ghost = !response.participants.empty() &&
+                     !RankInParticipants(response.participants);
+  if (ghost && (response.type != ResponseType::ALLREDUCE ||
+                response.partial_elems <= 0)) {
+    return;  // partial ERROR (or degenerate): nothing to ghost-run
+  }
   std::vector<TensorTableEntry> entries;
-  {
+  if (!ghost) {
     std::lock_guard<std::mutex> lk(mu_);
     for (const auto& name : response.tensor_names) {
       auto it = tensor_table_.find(name);
@@ -3332,9 +3776,11 @@ void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
     }
     return;
   }
-  if (entries.empty()) return;
-  responses_executed_.fetch_add(1);
-  tensors_executed_.fetch_add(static_cast<int64_t>(entries.size()));
+  if (entries.empty() && !ghost) return;
+  if (!ghost) {
+    responses_executed_.fetch_add(1);
+    tensors_executed_.fetch_add(static_cast<int64_t>(entries.size()));
+  }
   switch (response.type) {
     case ResponseType::ALLREDUCE:
       ExecAllreduce(response, entries, ctx);
@@ -4164,15 +4610,37 @@ bool Engine::TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
 void Engine::ExecAllreduce(const Response& response,
                            std::vector<TensorTableEntry>& entries,
                            const ExecCtx& ctx) {
-  const std::string& tname = entries[0].name;
+  // Ghost execution (backup workers): a rank OUTSIDE a partial commit's
+  // participant set holds no entries but still drives the identical
+  // full-world ring over a zeroed buffer — zero is the SUM identity, so
+  // participants' results are exactly the survivors' sum while the wire
+  // pattern (and therefore every rank's socket schedule) is unchanged.
+  const bool ghost = entries.empty();
+  const std::string tname =
+      ghost ? response.tensor_names[0] : entries[0].name;
   for (auto& e : entries) timeline_.Start(e.name);
-  DataType dtype = entries[0].dtype;
-  int64_t total = 0;
-  for (auto& e : entries) total += e.shape.num_elements();
+  DataType dtype = ghost ? static_cast<DataType>(response.partial_dtype)
+                         : entries[0].dtype;
+  int64_t total = response.partial_elems;
+  if (!ghost) {
+    total = 0;
+    for (auto& e : entries) total += e.shape.num_elements();
+  }
+  // Divisor-correct averaging: the frontends divide by the COMMITTED
+  // participant count, not blindly by size.
+  const int nparticipants = response.participants.empty()
+      ? size_ : static_cast<int>(response.participants.size());
 
   if (size_ > 1) {
-    void* buf = entries[0].data;
     const size_t esize = DataTypeSize(dtype);
+    std::vector<uint8_t> ghost_buf;
+    void* buf;
+    if (ghost) {
+      ghost_buf.assign(static_cast<size_t>(total) * esize, 0);
+      buf = ghost_buf.data();
+    } else {
+      buf = entries[0].data;
+    }
     // Per-slot fusion scratch: ctx.channel doubles as the scratch slot so
     // concurrent wave responses never share a buffer.
     std::vector<uint8_t>& fusion_buffer = fusion_buffers_[ctx.channel];
@@ -4332,7 +4800,7 @@ void Engine::ExecAllreduce(const Response& response,
   }
   for (auto& e : entries) {
     timeline_.End(e.name, e.dtype, e.shape.DebugString());
-    FinishEntry(e, Status::OK());
+    FinishEntry(e, Status::OK(), nparticipants);
   }
 }
 
@@ -4582,15 +5050,56 @@ void Engine::ExecAlltoall(const Response& response,
   FinishEntry(e, Status::OK());
 }
 
-void Engine::FinishEntry(TensorTableEntry& e, const Status& s) {
+void Engine::FinishEntry(TensorTableEntry& e, const Status& s,
+                         int participants) {
+  // Step-time sample: allreduce completion latency (enqueue → finish),
+  // successful entries only — skipped/errored entries would poison the
+  // percentiles the straggler gate compares.
+  if (s.ok() && e.type == RequestType::ALLREDUCE) {
+    RecordStepTimeNs(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                         std::chrono::steady_clock::now() - e.enqueue_time)
+                         .count());
+  }
   auto hs = GetHandle(e.handle);
   if (hs == nullptr) return;
   {
     std::lock_guard<std::mutex> lk(handle_mu_);
     hs->error = s.reason();
+    hs->participants = participants >= 0 ? participants : size_;
     hs->done.store(s.ok() ? 1 : -1);
   }
   handle_cv_.notify_all();
+}
+
+void Engine::RecordStepTimeNs(int64_t ns) {
+  std::lock_guard<std::mutex> lk(step_ns_mu_);
+  constexpr size_t kCap = 4096;
+  if (step_ns_samples_.size() < kCap) {
+    step_ns_samples_.push_back(ns);
+  } else {
+    step_ns_samples_[step_ns_next_ % kCap] = ns;
+  }
+  ++step_ns_next_;
+}
+
+int64_t Engine::StepTimeNsPercentile(double p) const {
+  std::vector<int64_t> snap;
+  {
+    std::lock_guard<std::mutex> lk(step_ns_mu_);
+    snap = step_ns_samples_;
+  }
+  if (snap.empty()) return 0;
+  size_t idx = static_cast<size_t>(p * (snap.size() - 1) + 0.5);
+  if (idx >= snap.size()) idx = snap.size() - 1;
+  std::nth_element(snap.begin(), snap.begin() + idx, snap.end());
+  return snap[idx];
+}
+
+int Engine::ResultParticipants(int64_t handle) {
+  auto hs = GetHandle(handle);
+  if (hs == nullptr) return 0;
+  std::lock_guard<std::mutex> lk(handle_mu_);
+  return hs->participants;
 }
 
 // Rank-0-only stall warnings naming the missing ranks (reference
@@ -4684,7 +5193,22 @@ void Engine::CheckForStalledTensors() {
 void Engine::MaybeInjectFault() {
   if (fault_kind_ == FaultKind::NONE) return;
   int64_t idx = enqueue_count_.fetch_add(1);
-  if (idx != fault_step_) return;
+  if (fault_step_ != -2 && idx != fault_step_) return;  // -2: every step
+  if (fault_kind_ == FaultKind::SLOW) {
+    // Straggler injection: delay THIS enqueue in the API thread (the
+    // background loop keeps cycling, so control frames keep flowing and
+    // peers see a slow rank, not a dead one).  '*' schedules recur —
+    // they never set fault_fired_, so an elastic re-Init keeps the rank
+    // slow, which is what a chaos soak wants.
+    if (fault_step_ != -2) fault_fired_ = true;
+    std::fprintf(stderr,
+                 "horovod_tpu rank %d: fault injection: delaying enqueue "
+                 "%lld by %lldms\n",
+                 rank_, static_cast<long long>(idx),
+                 static_cast<long long>(fault_slow_ms_));
+    std::this_thread::sleep_for(std::chrono::milliseconds(fault_slow_ms_));
+    return;
+  }
   fault_fired_ = true;  // once per process, not per engine incarnation
   switch (fault_kind_) {
     case FaultKind::EXIT:
@@ -4707,6 +5231,8 @@ void Engine::MaybeInjectFault() {
                    rank_, static_cast<long long>(idx));
       fault_drop_.store(true);
       break;
+    case FaultKind::SLOW:
+      break;  // handled above
     case FaultKind::STALE_EPOCH:
       // Worker-only (the coordinator sends no RequestList frames): the
       // next control frame is preceded by a duplicate stamped epoch-1,
@@ -4739,6 +5265,10 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
     int wv = wire_dtype >= 0 ? wire_dtype : wire_dtype_.load();
     if (wv >= 1 && wv <= 4) wire = static_cast<WireDtype>(wv);
   }
+  // Knob-derived resolutions are advisory (the coordinator commits one
+  // format at negotiation): sampling the live knob here inherently
+  // races a TUNE landing on peers — see Request::wire_default.
+  const bool wire_default = wire_dtype < 0;
   int64_t handle = next_handle_.fetch_add(1);
   auto hs = std::make_shared<HandleState>();
   {
@@ -4754,7 +5284,9 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   e.root_rank = root_rank;
   e.red_op = red_op;
   e.wire_dtype = wire;
+  e.wire_default = wire_default;
   e.handle = handle;
+  e.enqueue_time = std::chrono::steady_clock::now();
 
   Request q;
   q.request_rank = rank_;
@@ -4765,6 +5297,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   q.red_op = red_op;
   q.probe = probe;
   q.wire_dtype = wire;
+  q.wire_default = wire_default;
   q.shape = shape;
 
   {

@@ -28,6 +28,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
@@ -59,13 +60,24 @@ struct TensorTableEntry {
   // Resolved wire format this entry was REQUESTED with (global knob or
   // per-tensor override at enqueue time) — part of the cache signature
   // and of any resubmitted Request, so renegotiations keep the format.
+  // wire_default marks a knob-derived (advisory) resolution — see
+  // Request::wire_default.
   WireDtype wire_dtype = WireDtype::FP32;
+  bool wire_default = false;
   int64_t handle = -1;
+  // Enqueue wall-clock: FinishEntry derives the per-collective
+  // completion latency (step_time_ns percentiles) from it.
+  std::chrono::steady_clock::time_point enqueue_time;
 };
 
 struct HandleState {
   std::atomic<int> done{0};   // 0 pending, 1 ok, -1 error
   std::string error;
+  // Ranks whose data the committed response actually reduced: size for
+  // a full commit, the participant-set size for a backup-worker partial
+  // commit, 0 when this rank's entry was skipped — divisor-correct
+  // averaging in the frontends divides by THIS, never blindly by size.
+  int participants = 0;
   // Allgather result (shape negotiated at runtime, reference
   // operations.cc:796-856): buffered here, copied out by the caller.
   std::vector<uint8_t> result;
@@ -263,6 +275,27 @@ class Engine {
   // Effective default wire dtype (live-tunable knob #6).
   int wire_dtype() const { return wire_dtype_.load(); }
 
+  // Straggler-tolerance observability.  `backup_workers` is the
+  // committed HOROVOD_BACKUP_WORKERS over-provisioning (rendezvous
+  // commits the coordinator's value, like the channel count);
+  // `backup_skips` counts responses THIS rank was left out of (its
+  // entries finished with the clean "skipped this step" status);
+  // `local_sgd_syncs` counts outer local-SGD delta syncs the Python
+  // policy completed on this process (NoteLocalSgdSync);
+  // `step_time_ns_p50/p99` are percentiles of allreduce completion
+  // latency (enqueue → finish, successful entries only) over a sliding
+  // window — the deterministic per-rank instrument the straggler gate
+  // judges: one slow rank inflates every participant's p99 at k=0, and
+  // backup-worker commits pull it back down.
+  int backup_workers() const { return backup_workers_; }
+  int64_t backup_skips() const { return backup_skips_.load(); }
+  int64_t local_sgd_syncs() const { return local_sgd_syncs_.load(); }
+  void NoteLocalSgdSync() { local_sgd_syncs_.fetch_add(1); }
+  int64_t step_time_ns_p50() const { return StepTimeNsPercentile(0.50); }
+  int64_t step_time_ns_p99() const { return StepTimeNsPercentile(0.99); }
+  // Participant count recorded on a finished handle (see HandleState).
+  int ResultParticipants(int64_t handle);
+
   // Effective (currently in-force) values of the live-tunable knobs plus
   // the wiring-time ones, for stats()["config"]: post-TUNE, not the env
   // default — an operator reading stats sees what the engine is actually
@@ -281,8 +314,10 @@ class Engine {
 
   // Online autotuner entry point (coordinator only, any thread): queue a
   // knob config to broadcast in the next cycle's TUNE frame.  Every rank
-  // — the coordinator included — applies it AFTER that cycle's responses
-  // execute, i.e. atomically between negotiation cycles; the frame
+  // — the coordinator included — applies it BEFORE that cycle's
+  // responses execute, i.e. atomically between negotiation cycles (no
+  // response in flight, and no completion-woken enqueue can read a
+  // stale knob a peer already flipped); the frame
   // carries the membership epoch, so a TUNE from a dead incarnation is
   // structurally dropped.  Values <= 0 leave the knob unchanged;
   // `commit` marks the search's final config (timeline/observability).
@@ -391,6 +426,30 @@ class Engine {
   // Coordinator-side: drop a slot everywhere (idempotent within a cycle).
   void CoordinatorEvictSlot(uint32_t slot, ResponseList* out);
   void ClearCacheState();
+  // -- backup-worker straggler tolerance (HOROVOD_BACKUP_WORKERS=k) --
+  // Coordinator, end of every gather cycle under k > 0: commit any SUM
+  // allreduce (full-request pending entry or cached-slot readiness)
+  // whose ready voter count reached nvoters-k and whose first sighting
+  // is older than the grace window — the committed participant set
+  // (flat: the seen ranks; hierarchical: every rank of each FULLY-seen
+  // host group, a late host being one late voter) rides the response /
+  // partial_slots so every rank runs the same full-world ring over the
+  // same survivors' data.
+  void MaybePartialCommits(ResponseList* out);
+  // Validate + build a partially committed single-tensor response over
+  // `participants` only (all of them seen); erases the pending entry.
+  Response BuildPartialResponse(const std::string& name,
+                                const std::vector<uint32_t>& participants);
+  bool RankInParticipants(const std::vector<uint32_t>& parts) const;
+  // A committed response left THIS rank out: finish any held entries
+  // with the clean "skipped this step" status (purging their queued
+  // requests so the coordinator never sees a stale late request), bank
+  // skip tokens for tensors not yet enqueued, and drop consumed pending
+  // hit bits.  Counted once per skipped response in backup_skips.
+  void NoteSkippedResponse(const Response& response,
+                           std::vector<TensorTableEntry>& entries);
+  void RecordStepTimeNs(int64_t ns);
+  int64_t StepTimeNsPercentile(double p) const;
   // Coordinator-only: tell every still-reachable worker that `culprit`
   // failed, so survivors abort promptly instead of waiting out their own
   // transport timeouts; sets abort_reason_ to `message`.
@@ -547,7 +606,10 @@ class Engine {
   // teardown); cheap no-op when nothing is held.
   void ReleaseScratch();
   void MaybeReleaseScratch();
-  void FinishEntry(TensorTableEntry& e, const Status& s);
+  // `participants` < 0 = full world (size_); partial commits pass the
+  // committed participant count; skipped entries pass 0.
+  void FinishEntry(TensorTableEntry& e, const Status& s,
+                   int participants = -1);
   void CheckForStalledTensors();
   void CloseSockets();
   // "rank N disconnected during allreduce of 'x': detail" — maps a
@@ -637,9 +699,14 @@ class Engine {
   // stale-epoch: the worker prefixes its next control frame with a
   // duplicate stamped epoch-1 (a dead incarnation's delayed message) so
   // tests can assert the coordinator's structural rejection path.
-  enum class FaultKind { NONE, EXIT, HANG, DROP_CONN, STALE_EPOCH };
+  // slow: rank:step:slow:ms — a deterministic enqueue delay in the API
+  // thread (the background loop keeps heartbeating: a STRAGGLER, not a
+  // wedge).  step may be '*' (every enqueue, recurring) so chaos
+  // schedules can make a rank permanently slow without killing it.
+  enum class FaultKind { NONE, EXIT, HANG, DROP_CONN, STALE_EPOCH, SLOW };
   FaultKind fault_kind_ = FaultKind::NONE;
-  int64_t fault_step_ = -1;
+  int64_t fault_step_ = -1;     // -2: every step ('*')
+  int64_t fault_slow_ms_ = 0;
   // Survives re-Init: an injected fault fires once per process, so an
   // in-process elastic recovery (shutdown + init with the env var still
   // set) does not re-fire it on every incarnation.
@@ -738,6 +805,30 @@ class Engine {
   std::unordered_map<std::string, uint32_t> coord_slot_by_name_;
   std::set<uint32_t> free_slots_;
   uint32_t next_slot_ = 0;
+
+  // -- backup-worker straggler tolerance --
+  // Committed over-provisioning: the coordinator's env resolution rides
+  // the ASSIGN frame (like the channel count) so stats agree everywhere;
+  // the per-cycle participant bitmaps are what actually drive behavior.
+  // 0 = fully synchronous, bit-for-bit the pre-backup engine.
+  int backup_workers_ = 0;
+  // Minimum pending age before a partial commit may fire
+  // (HOROVOD_BACKUP_GRACE_MS): sub-cycle enqueue jitter between healthy
+  // ranks must never be mistaken for straggling — only a rank late by
+  // more than the grace gets skipped.
+  int backup_grace_ms_ = 50;
+  // name → outstanding skip tokens (background-thread-only, like
+  // message_table_): a partial commit that excluded this rank BEFORE it
+  // enqueued the tensor banks a token here; the future enqueue consumes
+  // it and finishes "skipped" locally instead of shipping a stale
+  // request the coordinator no longer expects.
+  std::unordered_map<std::string, int> skip_tokens_;
+  // Sliding window of allreduce completion latencies (enqueue→finish)
+  // for the step_time_ns percentiles; own lock — FinishEntry runs on
+  // the background thread, readers are API threads.
+  mutable std::mutex step_ns_mu_;
+  std::vector<int64_t> step_ns_samples_;
+  size_t step_ns_next_ = 0;
 
   // -- hierarchical coordination state --
   // Committed flag (coordinator env resolution broadcast in the ASSIGN
@@ -1013,6 +1104,8 @@ class Engine {
   std::atomic<int64_t> wire_bf16_count_{0};
   std::atomic<int64_t> wire_int8_count_{0};
   std::atomic<int64_t> wire_fp8_count_{0};
+  std::atomic<int64_t> backup_skips_{0};
+  std::atomic<int64_t> local_sgd_syncs_{0};
 
   // -- timeline --
   Timeline timeline_;

@@ -18,6 +18,7 @@ static void SerializeRequest(const Request& q, Writer* w) {
   w->u8(static_cast<uint8_t>(q.red_op));
   w->u8(q.probe ? 1 : 0);
   w->u8(static_cast<uint8_t>(q.wire_dtype));
+  w->u8(q.wire_default ? 1 : 0);
   w->vu(q.shape.size());
   for (auto d : q.shape) w->vi(d);
 }
@@ -31,6 +32,7 @@ static bool ParseRequest(Reader* r, Request* q) {
   q->red_op = static_cast<ReduceOp>(r->u8());
   q->probe = r->u8() != 0;
   q->wire_dtype = static_cast<WireDtype>(r->u8());
+  q->wire_default = r->u8() != 0;
   uint64_t nd = r->vu();
   if (nd > (1u << 16)) return false;  // corrupt frame guard
   q->shape.clear();
@@ -144,6 +146,14 @@ static void SerializeResponse(const Response& s, Writer* w) {
   w->u8(static_cast<uint8_t>(s.wire_dtype));
   w->vu(s.cache_slots.size());
   for (auto c : s.cache_slots) w->vi(c);
+  // Backup-worker participant set behind a flag byte: the k=0 (and every
+  // full-commit) frame grows by exactly one byte.
+  w->u8(s.participants.empty() ? 0 : 1);
+  if (!s.participants.empty()) {
+    SerializeSlotBitvector(s.participants, w);
+    w->vi(s.partial_elems);
+    w->u8(s.partial_dtype);
+  }
 }
 
 static bool ParseResponse(Reader* r, Response* s) {
@@ -171,6 +181,15 @@ static bool ParseResponse(Reader* r, Response* s) {
   // Normalize: every tensor name has a slot entry (-1 = uncached), so
   // consumers can index the two vectors in lockstep unconditionally.
   s->cache_slots.resize(s->tensor_names.size(), -1);
+  if (r->u8() != 0) {
+    if (!ParseSlotBitvector(r, &s->participants)) return false;
+    s->partial_elems = r->vi();
+    s->partial_dtype = r->u8();
+  } else {
+    s->participants.clear();
+    s->partial_elems = 0;
+    s->partial_dtype = 0;
+  }
   return r->ok();
 }
 
@@ -196,6 +215,13 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
     w->vi(list.tune_wave_width);
     w->vi(list.tune_algo_threshold);
     w->vi(list.tune_wire_dtype);
+  }
+  // Backup-worker partial commits on the cached path: slot → committed
+  // participant bitmap.  Empty on every full-commit cycle (one byte).
+  w->vu(list.partial_slots.size());
+  for (const auto& ps : list.partial_slots) {
+    w->vu(ps.slot);
+    SerializeSlotBitvector(ps.participants, w);
   }
 }
 
@@ -223,6 +249,15 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
     out->tune_wave_width = static_cast<int32_t>(r->vi());
     out->tune_algo_threshold = r->vi();
     out->tune_wire_dtype = static_cast<int32_t>(r->vi());
+  }
+  uint64_t nps = r->vu();
+  if (nps > (1u << 20)) return false;
+  out->partial_slots.resize(nps);
+  for (uint64_t i = 0; i < nps && r->ok(); ++i) {
+    out->partial_slots[i].slot = static_cast<uint32_t>(r->vu());
+    if (!ParseSlotBitvector(r, &out->partial_slots[i].participants)) {
+      return false;
+    }
   }
   return r->ok();
 }

@@ -88,11 +88,22 @@ struct Request {
   // ranks get a SPARSE_RETRY response instead of a deadlock.
   bool probe = false;
   // Requested WIRE format for this tensor's allreduce payload (see
-  // common.h WireDtype).  Validated cross-rank exactly like dtype: the
-  // coordinator commits ONE wire format per response and a mismatch is a
-  // clean negotiated error naming the ranks.  Always FP32 for non-fp32
+  // common.h WireDtype).  EXPLICIT per-tensor overrides are validated
+  // cross-rank exactly like dtype: the coordinator commits ONE wire
+  // format per response and a mismatch between overrides is a clean
+  // negotiated error naming the ranks.  Always FP32 for non-fp32
   // tensors and non-allreduce ops.
   WireDtype wire_dtype = WireDtype::FP32;
+  // Set when wire_dtype was resolved from the GLOBAL knob
+  // (HOROVOD_WIRE_DTYPE / a live TUNE) rather than a per-tensor
+  // override.  Knob-derived wires are ADVISORY: enqueue-time sampling
+  // races TUNE application across ranks (one rank's enqueue lands a
+  // cycle before a peer applied the same TUNE), so the coordinator
+  // COMMITS the first non-probe request's value instead of erroring —
+  // every rank executes the response's committed wire anyway, and the
+  // next step's signatures converge.  Only explicit overrides keep the
+  // strict mismatch error.
+  bool wire_default = false;
   std::vector<int64_t> shape;
 };
 
@@ -143,6 +154,20 @@ struct Response {
   // slot → single-tensor response) into its local cache replica on
   // receipt, so later steps negotiate via RequestList::cache_hits.
   std::vector<int32_t> cache_slots;
+  // Backup-worker PARTIAL commit (HOROVOD_BACKUP_WORKERS=k): the
+  // committed participant rank set when the coordinator fired this SUM
+  // allreduce at size-k voter readiness instead of waiting for the full
+  // world.  Empty = full commit, the default contract (k=0 frames carry
+  // one flag byte and nothing else).  Every rank executes the SAME ring
+  // over the SAME response — a rank outside the set contributes a
+  // zeroed buffer (zero is the SUM identity) so the wire pattern always
+  // spans the whole world; partial_elems/partial_dtype carry the
+  // payload geometry a skipped rank (which may hold no tensor entry at
+  // all) needs to size that buffer.  Partial responses are never fused
+  // and never assigned cache slots.
+  std::vector<uint32_t> participants;
+  int64_t partial_elems = 0;
+  uint8_t partial_dtype = 0;
 };
 
 struct ResponseList {
@@ -173,9 +198,11 @@ struct ResponseList {
   std::vector<uint32_t> evict_slots;
   // Online-autotuner TUNE broadcast (piggybacks on the regular cycle
   // frame, like `abort`): when `tune` is set, every receiver applies the
-  // carried knob values AFTER executing this cycle's responses — i.e.
-  // atomically between negotiation cycles, so no collective ever runs
-  // under a mixed config across ranks.  The frame inherits the epoch
+  // carried knob values BEFORE executing this cycle's responses — i.e.
+  // atomically between negotiation cycles (no response in flight; and a
+  // completion-woken enqueue can never read a stale knob a peer already
+  // flipped), so no collective ever runs under a mixed config across
+  // ranks.  The frame inherits the epoch
   // stamp above, so a TUNE from a dead incarnation of the world is
   // structurally dropped (and counted in stale_epoch_msgs) like any
   // other stale control frame.  A value <= 0 means "leave that knob
@@ -197,6 +224,17 @@ struct ResponseList {
   // frame lands; in-flight negotiations keep their requested format, and
   // the signature change evicts affected cache slots naturally.
   int32_t tune_wire_dtype = -1;
+  // Cached slots of this cycle's `cached_slots` that fired as
+  // backup-worker PARTIAL commits: slot → committed participant set
+  // (the replayed replica response provides the payload geometry from
+  // its signature).  Leaders also drop their held sub-table bits for
+  // these slots — the skipped group's ready members just had their
+  // entries finished "skipped" and will re-report fresh.
+  struct PartialSlot {
+    uint32_t slot = 0;
+    std::vector<uint32_t> participants;
+  };
+  std::vector<PartialSlot> partial_slots;
 };
 
 // Flat byte-buffer serialization (host byte order; in-cluster only).

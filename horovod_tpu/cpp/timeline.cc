@@ -135,6 +135,14 @@ void Timeline::Algo(const std::string& name, const char* algo) {
   WriteEvent(TensorPid(name), 'X', "ACTIVITY", algo);
 }
 
+void Timeline::PartialCommit(const std::string& name,
+                             const std::string& skipped) {
+  std::lock_guard<std::recursive_mutex> lk(mu_);
+  if (file_ == nullptr) return;
+  WriteEvent(TensorPid(name), 'X', "ACTIVITY",
+             "PARTIAL_COMMIT(skipped=" + skipped + ")");
+}
+
 void Timeline::TuneTrial(const std::string& config, bool commit) {
   std::lock_guard<std::recursive_mutex> lk(mu_);
   if (file_ == nullptr) return;

@@ -43,6 +43,10 @@ class Timeline {
   // ALGO_RING marker per allreduce response, so a trace shows which
   // responses took the latency star vs. the bandwidth ring.
   void Algo(const std::string& name, const char* algo);
+  // Backup-worker partial commit: one instantaneous
+  // PARTIAL_COMMIT(skipped=...) marker naming the ranks the coordinator
+  // left out of this response (straggler forensics on the trace).
+  void PartialCommit(const std::string& name, const std::string& skipped);
   // Online-autotuner trials live on one dedicated trace "process"
   // (pid "autotune"): each applied trial writes an instantaneous
   // TUNE_TRIAL(config...) marker plus a span that covers its scoring

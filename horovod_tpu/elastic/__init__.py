@@ -22,6 +22,8 @@ See docs/elastic.md for the failure model and semantics.
 """
 
 from horovod_tpu.elastic.driver import run_elastic
-from horovod_tpu.elastic.state import ElasticState
+from horovod_tpu.elastic.state import (ElasticState, LocalSGD,
+                                       default_local_sgd_steps)
 
-__all__ = ["ElasticState", "run_elastic"]
+__all__ = ["ElasticState", "LocalSGD", "default_local_sgd_steps",
+           "run_elastic"]

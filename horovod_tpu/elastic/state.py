@@ -15,11 +15,13 @@ rendezvous deterministically.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from horovod_tpu.runtime import engine_or_none
 
-__all__ = ["ElasticState"]
+__all__ = ["ElasticState", "LocalSGD", "default_local_sgd_steps"]
 
 
 def _host_copy(obj):
@@ -148,17 +150,10 @@ class ElasticState:
 
             for k in self._keys:
                 _walk(getattr(self, k), k, enqueue)
-            # Drain every handle even when one fails (same hygiene as
-            # grouped_allreduce: a half-drained batch would poison the
+            # Drain every handle even when one fails (eng.drain — the
+            # shared hygiene: a half-drained batch would poison the
             # retry after a mid-sync abort with duplicate-name errors).
-            outs, first_err = [], None
-            for h in handles:
-                try:
-                    outs.append(eng.synchronize(h))
-                except Exception as e:  # noqa: BLE001 — re-raised below
-                    if first_err is None:
-                        first_err = e
-                    outs.append(None)
+            outs, _infos, first_err = eng.drain(handles)
             if first_err is not None:
                 raise first_err
             results = iter(outs)
@@ -181,3 +176,184 @@ class ElasticState:
         self.last_sync_size = basics.size() if basics.is_initialized() else 1
         self.last_sync_epoch = basics.epoch()
         self.commit()
+
+
+def default_local_sgd_steps() -> int:
+    """The ``HOROVOD_LOCAL_SGD_STEPS`` env default (H local steps per
+    outer sync; 1 = fully synchronous, the pre-local-SGD contract)."""
+    raw = os.environ.get("HOROVOD_LOCAL_SGD_STEPS", "")
+    try:
+        v = int(raw) if raw else 1
+    except ValueError:
+        v = 1
+    return max(1, v)
+
+
+class LocalSGD:
+    """Communication-relaxed periodic sync (the DiLoCo / local-SGD
+    pattern): run ``H`` purely LOCAL optimizer steps, then one outer
+    allreduce of the model — the delta-average step
+    ``anchor + avg(P_r - anchor)`` shipped as each rank's summed-out
+    ``P_r`` so reconstruction is ANCHOR-FREE (see ``maybe_sync``) —
+    wire traffic drops by ``H``×, and the one sync that remains rides
+    the ordinary allreduce path, so it composes unchanged with wire
+    compression (``HOROVOD_WIRE_DTYPE``), the shm hierarchy, and
+    backup-worker partial commits (divisor-correct averaging by
+    participants).
+
+    Usage (the optimizer frontends wire this up from
+    ``DistributedOptimizer(local_sgd_steps=H)``)::
+
+        policy = LocalSGD(local_sgd_steps=8)
+        policy.begin(params)              # anchor the outer model
+        for batch in data:
+            params = local_step(params, batch)   # NO gradient allreduce
+            params = policy.maybe_sync(params)   # wire sync every H-th
+
+    Epoch stamping (the top-k error-feedback residual rule): the anchor
+    is stamped with the membership epoch it was taken under.  An elastic
+    resize (abort/shrink/rejoin) bumps the epoch, and the next
+    ``maybe_sync`` RE-ANCHORS to the current params instead of
+    allreducing a dead incarnation's delta into the new world — after
+    the resize's ``ElasticState.sync()`` restored a consistent model,
+    local counting restarts cleanly.
+
+    A :class:`~horovod_tpu.runtime.engine.StepSkipped` outer sync (this
+    rank left out of a backup-worker partial commit) keeps the local
+    params, re-anchors to them, and does NOT count as a sync — and
+    because reconstruction is anchor-free, the rank lands exactly on
+    the participants' consensus at its NEXT successful sync: the drift
+    really is bounded by one outer round, never a frozen offset.
+    """
+
+    def __init__(self, local_sgd_steps: int | None = None):
+        self.steps = int(local_sgd_steps) if local_sgd_steps is not None \
+            else default_local_sgd_steps()
+        if self.steps < 1:
+            self.steps = 1
+        self._local_steps = 0
+        # The anchor is a cadence/epoch MARKER, not a model copy:
+        # reconstruction is anchor-free (each sync averages the ranks'
+        # models), so storing the values would pin a full duplicate of
+        # the model per training run for nothing.
+        self._anchored = False
+        self._anchor_epoch: int | None = None
+        #: Completed outer syncs (process-local mirror of the engine's
+        #: cumulative ``local_sgd_syncs`` counter).
+        self.sync_count = 0
+
+    def _epoch(self) -> int:
+        from horovod_tpu.common.basics import basics
+
+        if not basics.is_initialized():
+            return 0
+        eng = engine_or_none()
+        return eng.epoch() if eng is not None else 0
+
+    def begin(self, params=None) -> None:
+        """Anchor the outer (synchronized) model — call once before the
+        first local step (``params`` is accepted for call-site clarity
+        but not stored: reconstruction is anchor-free)."""
+        self._anchored = True
+        self._anchor_epoch = self._epoch()
+        self._local_steps = 0
+
+    def reset(self) -> None:
+        """Drop the anchor (a fresh training run in the same process);
+        the next ``maybe_sync`` re-anchors without syncing."""
+        self._anchored = False
+        self._anchor_epoch = None
+        self._local_steps = 0
+
+    def maybe_sync(self, params):
+        """Count one completed local step; on the ``H``-th, allreduce the
+        model delta and return the synced params (otherwise return
+        ``params`` unchanged — the SAME object, so callers can detect
+        whether a sync happened by identity)."""
+        from horovod_tpu.runtime.engine import StepSkipped
+        from horovod_tpu.runtime.engine import note_local_sgd_sync
+
+        epoch = self._epoch()
+        if not self._anchored or self._anchor_epoch != epoch:
+            # First sighting, or the membership epoch moved under us (an
+            # elastic resize committed a new world): the pending delta
+            # belongs to a dead incarnation — drop it and re-anchor.
+            self.begin(params)
+            return params
+        self._local_steps += 1
+        if self._local_steps < self.steps:
+            return params
+
+        from horovod_tpu.common.basics import basics
+
+        eng = engine_or_none() if basics.is_initialized() else None
+        if eng is None:
+            # World of one: the sync is an arithmetic identity, but the
+            # cadence (re-anchor + count) still applies so code paths
+            # are identical at any scale.
+            self.begin(params)
+            self.sync_count += 1
+            note_local_sgd_sync()
+            return params
+
+        # One outer allreduce per leaf, batched: enqueue everything
+        # before draining anything (the engine fuses the burst),
+        # averaged divisor-correctly by participants.  The wire carries
+        # each rank's CURRENT model leaf — i.e. anchor + delta summed on
+        # the sender — which over an agreed anchor is arithmetically the
+        # delta-average outer step (avg(P_r) = S + avg(P_r - S)), but is
+        # ANCHOR-FREE on reconstruction: a rank whose anchor was
+        # perturbed (a skipped outer sync, an elastic re-anchor) lands
+        # exactly on the participants' consensus at its next successful
+        # sync instead of freezing a permanent offset.
+        paths, sends = [], []
+
+        def collect(path, leaf):
+            arr = np.asarray(leaf)
+            paths.append(path)
+            sends.append(np.ascontiguousarray(arr))
+            return leaf
+
+        _walk(params, "p", collect)
+
+        handles = [
+            eng.enqueue_allreduce(
+                np.ascontiguousarray(d.reshape(1) if d.ndim == 0 else d),
+                name=f"local_sgd.sync.{path}")
+            for path, d in zip(paths, sends)
+        ]
+        outs, infos, first_err = eng.drain(handles)
+        if first_err is not None:
+            if isinstance(first_err, StepSkipped):
+                # Left out of the outer sync (backup workers): keep the
+                # local model, restart local counting from it.  The next
+                # SUCCESSFUL sync heals this completely — reconstruction
+                # averages the participants' models, anchor-free.
+                self.begin(params)
+                return params
+            raise first_err
+
+        avg = iter([
+            eng._apply_average(o, i.get("participants") or None)
+            for o, i in zip(outs, infos)
+        ])
+
+        def adopt(path, leaf):
+            arr = np.asarray(leaf)
+            new = next(avg).reshape(arr.shape).astype(arr.dtype)
+            if arr.ndim == 0:
+                val = new.reshape(())[()]
+                if isinstance(leaf, bool):
+                    return bool(val)
+                if isinstance(leaf, int):
+                    return int(val)
+                if isinstance(leaf, float):
+                    return float(val)
+                return val
+            return new
+
+        synced = _walk(params, "p", adopt)
+        self.begin(synced)
+        self.sync_count += 1
+        note_local_sgd_sync()
+        return synced

@@ -275,11 +275,26 @@ class DistributedOptimizer:
     pmap); under plain pjit-with-sharded-batch XLA already inserts the psum,
     in which case wrap with ``reduce_gradients=False`` to keep only the
     bookkeeping.
+
+    ``local_sgd_steps=H`` (default: ``HOROVOD_LOCAL_SGD_STEPS``, 1)
+    switches the host-driven (eager/DCN) path to communication-relaxed
+    local SGD: ``update`` applies gradients purely LOCALLY (no per-step
+    allreduce), and the attached :class:`horovod_tpu.elastic.LocalSGD`
+    policy syncs the model delta every ``H`` steps — the training loop
+    calls ``params = opt.local_sgd.maybe_sync(params)`` after
+    ``optax.apply_updates``.  ``H <= 1`` is byte-identical to the plain
+    synchronous path (the policy is not even constructed).  The outer
+    delta sync is epoch-stamped: an elastic resize re-anchors instead of
+    leaking a dead incarnation's delta, and it composes unchanged with
+    wire compression and backup-worker partial commits.
     """
 
     def __init__(self, optimizer, *, axis_name=None, op=Average,
                  compression=Compression.none, fusion_threshold_bytes=None,
-                 reduce_gradients=True, name=None):
+                 reduce_gradients=True, name=None, local_sgd_steps=None):
+        from horovod_tpu.elastic.state import (LocalSGD,
+                                               default_local_sgd_steps)
+
         self._inner = optimizer
         self._axis_name = axis_name
         self._op = op
@@ -287,6 +302,13 @@ class DistributedOptimizer:
         self._fusion_threshold = fusion_threshold_bytes
         self._reduce = reduce_gradients
         self.name = name or "DistributedOptimizer"
+        self._local_sgd_steps = (default_local_sgd_steps()
+                                 if local_sgd_steps is None
+                                 else max(1, int(local_sgd_steps)))
+        #: The periodic-sync policy (None when H <= 1 — fully
+        #: synchronous, the pre-local-SGD contract, byte-identical).
+        self.local_sgd = (LocalSGD(self._local_sgd_steps)
+                          if self._local_sgd_steps > 1 else None)
 
     @property
     def inner(self):
@@ -296,18 +318,26 @@ class DistributedOptimizer:
     def with_axis_name(self, axis_name):
         """A copy bound to ``axis_name`` (used by train-step builders to pin
         reduction to the mesh they run on)."""
-        return DistributedOptimizer(
+        copy = DistributedOptimizer(
             self._inner, axis_name=axis_name, op=self._op,
             compression=self._compression,
             fusion_threshold_bytes=self._fusion_threshold,
             reduce_gradients=self._reduce, name=self.name,
+            local_sgd_steps=self._local_sgd_steps,
         )
+        # Share the policy instance: the anchor/counter live with the
+        # training run, not with any one bound copy.
+        copy.local_sgd = self.local_sgd
+        return copy
 
     def init(self, params):
         return self._inner.init(params)
 
     def update(self, grads, state, params=None, **extra):
-        if self._reduce:
+        # Local-SGD phase: gradients apply purely locally; the policy's
+        # maybe_sync (called by the training loop on the params) is the
+        # only wire traffic — H× fewer syncs by construction.
+        if self._reduce and self._local_sgd_steps <= 1:
             grads = allreduce_gradients(
                 grads,
                 axis_name=self._axis_name,

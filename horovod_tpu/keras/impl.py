@@ -85,10 +85,17 @@ def _host_average_many(arrays, name_prefix: str, compression: str = "none",
             sent.append((a.copy(), None))
     handles = [eng.enqueue_allreduce(w, name=f"{name_prefix}.{k}")
                for k, (w, _) in zip(keys, sent)]
-    n = basics.size()
+    # Drain EVERY handle before raising (eng.drain hygiene), and divide
+    # by the committed PARTICIPANT count — a backup-worker partial
+    # commit (HOROVOD_BACKUP_WORKERS) reduces fewer than size
+    # contributions, and dividing by size would silently downscale every
+    # participant's gradients.
+    results, infos, first_err = eng.drain(handles)
+    if first_err is not None:
+        raise first_err
     outs = []
-    for (w, orig), h in zip(sent, handles):
-        out = eng.synchronize(h)
+    for (w, orig), out, info in zip(sent, results, infos):
+        n = info.get("participants") or basics.size()
         out = (out / n).astype(orig if orig is not None else w.dtype,
                                copy=False)
         outs.append(out)

@@ -145,23 +145,23 @@ def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
             red_op=_WIRE_OPS[op], wire_dtype=wd)
         for i, h in enumerate(hosts)
     ]
-    # Drain EVERY handle even when one fails: abandoning the rest would
-    # leak their buffers and leave names "in flight", so a retry of the
-    # same batch after an elastic recovery would die on duplicate names.
-    outs, first_err = [], None
-    for h in handles:
-        try:
-            outs.append(eng.synchronize(h))
-        except Exception as e:  # noqa: BLE001 — re-raised below
-            if first_err is None:
-                first_err = e
-            outs.append(None)
+    # Drain EVERY handle even when one fails (eng.drain: abandoning the
+    # rest would leak their buffers and leave names "in flight", so a
+    # retry of the same batch after an elastic recovery would die on
+    # duplicate names).  A StepSkipped (backup-worker partial commit
+    # that left this rank out) counts as a failure of the batch: the
+    # whole step's gradients are dropped together, and the caller skips
+    # its local update.
+    outs, infos, first_err = eng.drain(handles)
     if first_err is not None:
         raise first_err
     results = []
-    for out, ctx in zip(outs, ctxs):
+    for out, ctx, info in zip(outs, ctxs, infos):
         if op is Average:
-            out = eng._apply_average(out)
+            # Divisor-correct averaging: a backup-worker partial commit
+            # reduced participants < size contributions.
+            out = eng._apply_average(out,
+                                     info.get("participants") or None)
         results.append(compression.decompress(jnp.asarray(out), ctx))
     return results
 

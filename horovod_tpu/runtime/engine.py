@@ -25,11 +25,21 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["NativeEngine", "get_engine", "HorovodInternalError",
-           "SparseGradRetry"]
+           "SparseGradRetry", "StepSkipped"]
 
 
 class HorovodInternalError(RuntimeError):
     """A collective failed (cross-rank mismatch, shutdown, transport)."""
+
+
+class StepSkipped(Exception):
+    """A backup-worker partial commit (``HOROVOD_BACKUP_WORKERS``) left
+    this rank out of a step's reduction: the survivors committed without
+    its gradient and this rank's entry completed with the clean
+    "skipped this step" status — NOT an abort.  The world is healthy;
+    the caller should skip (or keep local) this step's update and
+    continue, re-syncing parameters periodically (local-SGD sync,
+    ``ElasticState.sync`` or a broadcast) to bound drift."""
 
 
 class SparseGradRetry(Exception):
@@ -44,6 +54,7 @@ class SparseGradRetry(Exception):
 
 
 _SPARSE_RETRY_PREFIX = "__sparse_retry__:"
+_SKIPPED_STEP_PREFIX = "__skipped_step__"
 
 
 # DataType codes, keep in sync with cpp/common.h.
@@ -83,6 +94,19 @@ def note_sparse_allreduce() -> None:
     """Called by runtime.sparse once per completed sparse allreduce."""
     global _SPARSE_COUNT
     _SPARSE_COUNT += 1
+
+
+def note_local_sgd_sync() -> None:
+    """Called by the local-SGD policy (elastic.state.LocalSGD) once per
+    completed outer delta sync — lands in the engine's cumulative
+    ``local_sgd_syncs`` counter (no-op when no engine is loaded)."""
+    global _engine
+    eng = _engine
+    if eng is None:
+        return
+    fn = getattr(eng._lib, "horovod_note_local_sgd_sync", None)
+    if fn is not None and getattr(fn, "restype", "?") is None:
+        fn()
 
 
 def _dtype_code(dtype) -> int:
@@ -200,6 +224,11 @@ class NativeEngine:
                         "horovod_coordinator_cycle_ns_p50",
                         "horovod_coordinator_cycle_ns_p99",
                         "horovod_hier_coordinator",
+                        "horovod_backup_workers",
+                        "horovod_backup_skips",
+                        "horovod_local_sgd_syncs",
+                        "horovod_step_time_ns_p50",
+                        "horovod_step_time_ns_p99",
                         "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
@@ -213,6 +242,13 @@ class NativeEngine:
             lib.horovod_abort_reason.restype = None
         except AttributeError:
             pass  # stale .so: abort_reason() degrades to ""
+        try:
+            lib.horovod_result_participants.argtypes = [ctypes.c_int64]
+            lib.horovod_result_participants.restype = ctypes.c_int64
+            lib.horovod_note_local_sgd_sync.argtypes = []
+            lib.horovod_note_local_sgd_sync.restype = None
+        except AttributeError:
+            pass  # stale .so: participants degrade to size-based division
         try:
             lib.horovod_autotune_set.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
@@ -427,11 +463,11 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_coordinator_cycle_ns_p99",
+        if getattr(getattr(self._lib, "horovod_step_time_ns_p99",
                            None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the big-world control-plane "
+                "libhorovod_core.so predates the straggler-tolerance "
                 "counters (and possibly earlier counter families) — "
                 "rebuild it with `make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
@@ -466,6 +502,16 @@ class NativeEngine:
                 self._lib.horovod_coordinator_cycle_ns_p50(),
             "coordinator_cycle_ns_p99":
                 self._lib.horovod_coordinator_cycle_ns_p99(),
+            # Straggler tolerance: allreduce completion-latency
+            # percentiles (enqueue -> finish over a sliding window; one
+            # slow rank inflates every participant's p99 at k=0 and
+            # backup-worker commits pull it back down), partial commits
+            # that left THIS rank out, and outer local-SGD syncs the
+            # Python policy completed.
+            "step_time_ns_p50": self._lib.horovod_step_time_ns_p50(),
+            "step_time_ns_p99": self._lib.horovod_step_time_ns_p99(),
+            "backup_skips": self._lib.horovod_backup_skips(),
+            "local_sgd_syncs": self._lib.horovod_local_sgd_syncs(),
             "data_bytes_tx": self._lib.horovod_data_bytes_tx(),
             "data_bytes_rx": self._lib.horovod_data_bytes_rx(),
             "reduce_ns": self._lib.horovod_reduce_ns(),
@@ -514,6 +560,7 @@ class NativeEngine:
                     bool(self._lib.horovod_hier_coordinator()),
                 "wire_dtype": _WIRE_NAMES.get(
                     int(self._lib.horovod_wire_dtype()), "fp32"),
+                "backup_workers": self._lib.horovod_backup_workers(),
             },
         }
 
@@ -535,7 +582,9 @@ class NativeEngine:
             if k in ("config", "num_channels", "topology",
                      "allreduce_bus_bw_bytes_per_sec",
                      "coordinator_cycle_ns_p50",
-                     "coordinator_cycle_ns_p99"):
+                     "coordinator_cycle_ns_p99",
+                     "step_time_ns_p50",
+                     "step_time_ns_p99"):
                 delta[k] = v
                 continue
             delta[k] = v - since.get(k, 0)
@@ -578,17 +627,32 @@ class NativeEngine:
         """True once the collective finished (ok or error)."""
         return self._lib.horovod_poll(handle) != 0
 
-    def synchronize(self, handle: int) -> np.ndarray:
+    def synchronize(self, handle: int, info: Optional[dict] = None
+                    ) -> np.ndarray:
         """Wait; raise on error; return the result buffer.
 
         For allreduce/broadcast this is the (in-place updated) input array;
         for allgather/reducescatter/alltoall it is a fresh array with the
         negotiated (possibly empty) shape.
+
+        ``info`` (optional dict) receives ``participants``: how many
+        ranks' data the committed response actually reduced — equal to
+        size for a full commit, smaller for a backup-worker partial
+        commit.  Divisor-correct averaging divides by it.
+
+        Raises :class:`StepSkipped` when a backup-worker partial commit
+        left this rank out (clean per-step outcome; the engine stays
+        healthy).
         """
         status = self._lib.horovod_wait(handle)
         with self._inflight_lock:
             arr = self._inflight.pop(handle, None)
         try:
+            if info is not None:
+                fn = getattr(self._lib, "horovod_result_participants",
+                             None)
+                if getattr(fn, "restype", None) is ctypes.c_int64:
+                    info["participants"] = int(fn(handle))
             if status < 0:
                 buf = ctypes.create_string_buffer(4096)
                 self._lib.horovod_error_message(handle, buf, len(buf))
@@ -596,6 +660,8 @@ class NativeEngine:
                 if msg.startswith(_SPARSE_RETRY_PREFIX):
                     raise SparseGradRetry(
                         int(msg[len(_SPARSE_RETRY_PREFIX):]))
+                if msg.startswith(_SKIPPED_STEP_PREFIX):
+                    raise StepSkipped(msg)
                 raise HorovodInternalError(msg or "collective failed")
             ndim = self._lib.horovod_result_ndim(handle)
             if ndim > 0:  # a fresh out-of-place result was negotiated
@@ -611,11 +677,37 @@ class NativeEngine:
         finally:
             self._lib.horovod_release_handle(handle)
 
+    def drain(self, handles):
+        """Synchronize EVERY handle of a batch, never abandoning one
+        mid-drain (an abandoned handle leaks its kept-alive buffer and
+        leaves its name "in flight", so a retry of the same batch after
+        a recovery dies on duplicate names).  Returns
+        ``(outs, infos, first_err)``: ``outs[i]`` is the result or None,
+        ``infos[i]["participants"]`` the committed participant count,
+        and ``first_err`` the first exception (None when all succeeded)
+        — the caller re-raises or handles it AFTER the batch is clean.
+        The shared drain-hygiene helper behind eager.grouped_allreduce,
+        ElasticState.sync, LocalSGD.maybe_sync and the keras frontend."""
+        outs, infos, first_err = [], [], None
+        for h in handles:
+            info: dict = {}
+            try:
+                outs.append(self.synchronize(h, info))
+            except Exception as e:  # noqa: BLE001 — returned to caller
+                if first_err is None:
+                    first_err = e
+                outs.append(None)
+            infos.append(info)
+        return outs, infos, first_err
+
     # -- sync convenience wrappers --
 
-    def _apply_average(self, out: np.ndarray) -> np.ndarray:
-        """sum → average: floor-divide integers, true-divide floats."""
-        n = self._lib.horovod_size()
+    def _apply_average(self, out: np.ndarray,
+                       participants: Optional[int] = None) -> np.ndarray:
+        """sum → average: floor-divide integers, true-divide floats.
+        ``participants`` overrides the divisor (backup-worker partial
+        commits reduce fewer than ``size`` contributions)."""
+        n = participants or self._lib.horovod_size()
         if np.issubdtype(out.dtype, np.integer):
             return out // n
         return (out / np.asarray(n, dtype=out.dtype)).astype(out.dtype)
@@ -625,10 +717,13 @@ class NativeEngine:
                   red_op: str = "sum",
                   wire_dtype: Optional[str] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor).copy()
+        info: dict = {}
         out = self.synchronize(
             self.enqueue_allreduce(arr, name, red_op,
-                                   wire_dtype=wire_dtype))
-        return self._apply_average(out) if average else out
+                                   wire_dtype=wire_dtype), info)
+        if not average:
+            return out
+        return self._apply_average(out, info.get("participants") or None)
 
     def allgather(self, tensor, *, name: Optional[str] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)

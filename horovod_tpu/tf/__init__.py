@@ -39,8 +39,15 @@ __all__ = [
 ]
 
 
-def _avg(summed, dtype):
+def _avg(summed, dtype, parts=None):
+    """sum → average.  ``parts`` (optional int64 scalar tensor) is the
+    committed participant count from the reduction — divisor-correct
+    under backup-worker partial commits (HOROVOD_BACKUP_WORKERS), where
+    fewer than ``size`` ranks contributed; 0/None falls back to size."""
     n = tf.cast(size(), dtype)
+    if parts is not None:
+        p = tf.cast(parts, dtype)
+        n = tf.where(p > 0, p, n)
     if summed.dtype.is_floating or summed.dtype.is_complex:
         return summed / n
     return summed // n
@@ -68,9 +75,12 @@ def allreduce(tensor, average: bool = True, device_dense: str = "",
                                 dense_shape=tensor.dense_shape)
     tensor = tf.convert_to_tensor(tensor)
     compressed, ctx = compression.compress(tensor)
-    summed = _allreduce(compressed, name=name)
+    parts_out = [] if average else None
+    summed = _allreduce(compressed, name=name, parts_out=parts_out)
     summed = compression.decompress(summed, ctx)
-    return _avg(summed, tensor.dtype) if average else summed
+    if not average:
+        return summed
+    return _avg(summed, tensor.dtype, parts_out[0] if parts_out else None)
 
 
 @tf.autograph.experimental.do_not_convert
@@ -97,11 +107,16 @@ def grouped_allreduce(tensors, average: bool = True,
         c, ctx = compression.compress(t)
         compressed.append(c)
         ctxs.append(ctx)
-    summed = _grouped_allreduce(compressed, names)
+    parts_out = [] if average else None
+    summed = _grouped_allreduce(compressed, names, parts_out=parts_out)
     outs = []
-    for s, ctx, t in zip(summed, ctxs, tensors):
+    for i, (s, ctx, t) in enumerate(zip(summed, ctxs, tensors)):
         s = compression.decompress(s, ctx)
-        outs.append(_avg(s, t.dtype) if average else s)
+        if average:
+            p = parts_out[i] if parts_out and i < len(parts_out) else None
+            outs.append(_avg(s, t.dtype, p))
+        else:
+            outs.append(s)
     return outs
 
 

@@ -88,22 +88,37 @@ def _np(t: tf.Tensor) -> np.ndarray:
 # long test session (observed: `_allreduce(x, name=...)` dispatching to
 # the converted `_np`), breaking tf.function-traced training loops.
 @tf.autograph.experimental.do_not_convert
-def _allreduce(tensor, name: Optional[str] = None):
+def _allreduce(tensor, name: Optional[str] = None, parts_out=None):
     """Sum ``tensor`` over all processes (reference mpi_ops.py:77-90).
 
     Same shape/dtype on every rank for a given name; differentiable
     (gradient of a sum-allreduce is an allreduce, mpi_ops.py:93-104).
+
+    ``parts_out`` (optional list): receives one int64 scalar tensor —
+    the committed PARTICIPANT count of the reduction (0 = unknown,
+    caller falls back to size).  Divisor-correct averaging under
+    backup-worker partial commits (HOROVOD_BACKUP_WORKERS) divides by
+    it instead of blindly by size.
     """
     op_name = _auto_name("allreduce", name)
+    # Written by the host call, read by the participants py_function
+    # strictly after it (data dependency through the output): per-op
+    # cell, same trace-lifetime caveat as any py_function state.
+    parts_cell = [0]
 
     @tf.custom_gradient
     def fn(x):
         def _host(xt):
             eng = _engine()
             if eng is None:
+                parts_cell[0] = 1
                 return xt.numpy()
             arr = _np(xt)
-            return eng.synchronize(eng.enqueue_allreduce(arr, name=op_name))
+            info = {}
+            out = eng.synchronize(
+                eng.enqueue_allreduce(arr, name=op_name), info)
+            parts_cell[0] = int(info.get("participants") or 0)
+            return out
 
         out = tf.py_function(_host, [x], Tout=x.dtype)
         out.set_shape(x.shape)
@@ -113,11 +128,18 @@ def _allreduce(tensor, name: Optional[str] = None):
 
         return out, grad
 
-    return fn(tf.convert_to_tensor(tensor))
+    out = fn(tf.convert_to_tensor(tensor))
+    if parts_out is not None:
+        # tf.size(out) is a cheap scalar data-dependency on the host
+        # call's output, ordering this read after the cell write without
+        # shipping the payload through a second py_function.
+        parts_out.append(tf.py_function(
+            lambda _s: np.int64(parts_cell[0]), [tf.size(out)], tf.int64))
+    return out
 
 
 @tf.autograph.experimental.do_not_convert
-def _grouped_allreduce(tensors, names):
+def _grouped_allreduce(tensors, names, parts_out=None):
     """Sum-allreduce a batch of tensors through ONE ``py_function``.
 
     Every tensor is async-enqueued before any is synchronized, so the
@@ -137,17 +159,29 @@ def _grouped_allreduce(tensors, names):
     if not tensors:
         return []
     names = list(names)
+    # Per-tensor committed participant counts (see _allreduce.parts_out).
+    parts_cells = [0] * len(names)
 
     @tf.custom_gradient
     def fn(*xs):
         def _host(*xts):
             eng = _engine()
             if eng is None:
+                for i in range(len(parts_cells)):
+                    parts_cells[i] = 1
                 return [x.numpy() for x in xts]
             arrs = [_np(x) for x in xts]
             handles = [eng.enqueue_allreduce(a, name=n)
                        for a, n in zip(arrs, names)]
-            return [eng.synchronize(h) for h in handles]
+            # eng.drain: every handle finishes even when one fails (an
+            # abandoned handle leaks its buffer and leaves the name in
+            # flight for the next step's batch).
+            outs, infos, first_err = eng.drain(handles)
+            for i, info in enumerate(infos):
+                parts_cells[i] = int(info.get("participants") or 0)
+            if first_err is not None:
+                raise first_err
+            return outs
 
         outs = tf.py_function(_host, list(xs), Tout=[x.dtype for x in xs])
         if not isinstance(outs, (list, tuple)):
@@ -161,7 +195,13 @@ def _grouped_allreduce(tensors, names):
 
         return list(outs), grad
 
-    return fn(*[tf.convert_to_tensor(t) for t in tensors])
+    outs = fn(*[tf.convert_to_tensor(t) for t in tensors])
+    if parts_out is not None:
+        for i, o in enumerate(outs):
+            parts_out.append(tf.py_function(
+                lambda _s, i=i: np.int64(parts_cells[i]),
+                [tf.size(o)], tf.int64))
+    return outs
 
 
 @tf.autograph.experimental.do_not_convert

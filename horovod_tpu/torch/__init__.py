@@ -78,11 +78,23 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def __init__(self, params, named_parameters=None,
                  compression=Compression.none,
                  backward_passes_per_step=1,
-                 sparse_as_dense=False):
+                 sparse_as_dense=False,
+                 local_sgd_steps=None):
+        from horovod_tpu.elastic.state import (LocalSGD,
+                                               default_local_sgd_steps)
+
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self._bpps = backward_passes_per_step
         self._sparse_as_dense = sparse_as_dense
+        # Local SGD (DiLoCo-style periodic sync): H purely-local steps,
+        # then one outer allreduce of the MODEL delta in step().  H <= 1
+        # keeps the per-step gradient allreduce path byte-identical.
+        self._local_sgd_steps = (default_local_sgd_steps()
+                                 if local_sgd_steps is None
+                                 else max(1, int(local_sgd_steps)))
+        self._local_sgd = (LocalSGD(self._local_sgd_steps)
+                           if self._local_sgd_steps > 1 else None)
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -137,6 +149,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 
     def _make_hook(self):
         def hook(p):
+            if self._local_sgd_steps > 1:
+                return  # local phase: gradients stay local; step() syncs
             self._passes_left[id(p)] -= 1
             if self._passes_left[id(p)] == 0:
                 self._handles[p] = self._allreduce_grad_async(p)
@@ -239,8 +253,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                                 self._handles[p] = self._probe_grad_async(p)
                                 continue
                     self._handles[p] = self._allreduce_grad_async(p)
-        from horovod_tpu.runtime.engine import SparseGradRetry
+        from horovod_tpu.runtime.engine import SparseGradRetry, StepSkipped
 
+        # Backup-worker partial commits: a skipped gradient raises
+        # StepSkipped, but the BATCH must still drain completely (an
+        # abandoned handle leaks its kept-alive tensor and leaves
+        # _handles stale for the next step) — collect the first skip and
+        # re-raise only after every handle finished.
+        first_skip = None
         topk_params = []
         for p, entry in self._handles.items():
             if entry[0] == "sparse":
@@ -266,9 +286,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     _, h_idx, h_val = self._sparse_allgather_async(
                         p, self._param_names.get(id(p)))
                     self._finish_sparse(p, h_idx, h_val)
+                except StepSkipped as skip:
+                    if first_skip is None:
+                        first_skip = skip
             else:
                 handle, tensor_compressed, ctx = entry
-                output = synchronize(handle)
+                try:
+                    output = synchronize(handle)
+                except StepSkipped as skip:
+                    if first_skip is None:
+                        first_skip = skip
+                    continue  # .grad keeps the local gradient
                 p.grad.data.set_(
                     self._compression.decompress(output, ctx).data)
         if topk_params:
@@ -294,6 +322,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                     average=True)
                 p.grad.data.copy_(torch.from_numpy(out))
         self._handles.clear()
+        if first_skip is not None:
+            raise first_skip  # batch fully drained: clean per-step skip
 
     def _probe_grad_async(self, p):
         """Layout-probe for a param with no grad and no recorded layout:
@@ -307,7 +337,41 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                                          name)
         return ("probe", handle, tensor_compressed, ctx)
 
+    def _local_sgd_maybe_sync(self):
+        """Outer local-SGD sync (every H-th step): collect params into a
+        name-keyed numpy tree, run the policy, and copy synced values
+        back in place.  The policy re-anchors on an elastic epoch change
+        and rides out backup-worker skips (reconstruction is anchor-free
+        — see elastic.LocalSGD)."""
+        import numpy as np
+
+        named = []
+        for group in self.param_groups:
+            for p in group["params"]:
+                name = self._param_names.get(id(p))
+                if name is None:
+                    name = f"localsgd.p{len(named)}"
+                named.append((name, p))
+        tree = {n: p.data.detach().cpu().numpy() for n, p in named}
+        synced = self._local_sgd.maybe_sync(tree)
+        if synced is not tree:  # a sync happened: adopt the outer model
+            with torch.no_grad():
+                for n, p in named:
+                    p.data.copy_(torch.from_numpy(
+                        np.ascontiguousarray(synced[n])).to(p.dtype))
+
     def step(self, closure=None):
+        if self._local_sgd_steps > 1:
+            # Local-SGD phase: no gradient allreduce; apply the inner
+            # optimizer locally, then let the policy decide whether this
+            # is the H-th step (one outer sync).  Anchor the cadence
+            # BEFORE the first inner step so the first sync covers
+            # exactly H local updates.
+            if not self._local_sgd._anchored:
+                self._local_sgd.begin()
+            loss = super(self.__class__, self).step(closure)
+            self._local_sgd_maybe_sync()
+            return loss
         self.synchronize()
         return super(self.__class__, self).step(closure)
 
@@ -315,7 +379,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1,
-                         sparse_as_dense=False):
+                         sparse_as_dense=False,
+                         local_sgd_steps=None):
     """Wrap a torch optimizer so gradients are averaged across ranks during
     ``backward()`` (reference factory, torch/__init__.py:115-150).
 
@@ -324,11 +389,18 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     memory-sane path for large embedding tables (reference
     tensorflow/__init__.py:67-78) — and stay sparse in ``.grad``;
     ``sparse_as_dense=True`` densifies them before an ordinary allreduce
-    instead (reference option, tensorflow/__init__.py:189-199)."""
+    instead (reference option, tensorflow/__init__.py:189-199).
+
+    ``local_sgd_steps=H`` (default ``HOROVOD_LOCAL_SGD_STEPS``, 1)
+    switches to communication-relaxed local SGD: gradients apply purely
+    locally and ``step()`` allreduces the MODEL delta once every ``H``
+    steps (epoch-stamped — an elastic resize re-anchors instead of
+    leaking a dead incarnation's delta).  ``H <= 1`` keeps the per-step
+    gradient-allreduce path byte-identical."""
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,
-               backward_passes_per_step, sparse_as_dense)
+               backward_passes_per_step, sparse_as_dense, local_sgd_steps)
 
 
 def broadcast_parameters(params, root_rank: int = 0):

@@ -99,14 +99,15 @@ def synchronize(handle: int) -> torch.Tensor:
         with _handle_lock:
             return _local_results.pop(handle)
     eng = _engine()
+    info: dict = {}
     try:
-        out_np = eng.synchronize(handle)
+        out_np = eng.synchronize(handle, info)
     finally:
         # Release the kept-alive tensors even when the collective errored,
         # or the map entry leaks for the process lifetime.
         with _handle_lock:
             tensor, postprocess = _handle_map.pop(handle)
-    return postprocess(tensor, out_np)
+    return postprocess(tensor, out_np, info)
 
 
 # ---------------------------------------------------------------------------
@@ -133,8 +134,13 @@ def allreduce_async_(tensor: torch.Tensor, average: bool = True,
     view = _np_view(tensor)
     handle = eng.enqueue_allreduce(view, name, wire_dtype=wire_dtype)
 
-    def post(t, _out):
-        return _div_in_place(t, basics.size()) if average else t
+    def post(t, _out, info=None):
+        if not average:
+            return t
+        # Divisor-correct averaging: a backup-worker partial commit
+        # reduced participants < size contributions.
+        n = (info or {}).get("participants") or basics.size()
+        return _div_in_place(t, n)
 
     return _register(handle, tensor, post)
 
@@ -162,8 +168,9 @@ def _probe_allreduce_async_(tensor: torch.Tensor,
     view = _np_view(tensor)
     handle = eng.enqueue_probe(view, name)
 
-    def post(t, _out):
-        return _div_in_place(t, basics.size())
+    def post(t, _out, info=None):
+        n = (info or {}).get("participants") or basics.size()
+        return _div_in_place(t, n)
 
     return _register(handle, tensor, post)
 
@@ -234,7 +241,7 @@ def allgather_async(tensor: torch.Tensor,
     view = _np_view(src)
     handle = eng.enqueue_allgather(view, name)
 
-    def post(_t, out_np):
+    def post(_t, out_np, _info=None):
         return _from_np(out_np, tensor.dtype)
 
     # Keep src alive until synchronize (its memory feeds the engine).
@@ -290,7 +297,7 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
         return _local_handle(tensor)
     view = _np_view(tensor)
     handle = eng.enqueue_broadcast(view, root_rank, name)
-    return _register(handle, tensor, lambda t, _out: t)
+    return _register(handle, tensor, lambda t, _out, _info=None: t)
 
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
@@ -340,8 +347,9 @@ def reducescatter_async(tensor: torch.Tensor,
         return _local_handle(src.clone())
     view = _np_view(src)
     handle = eng.enqueue_reducescatter(view, name)
-    return _register(handle, src,
-                     lambda _t, out_np: _from_np(out_np, tensor.dtype))
+    return _register(
+        handle, src,
+        lambda _t, out_np, _info=None: _from_np(out_np, tensor.dtype))
 
 
 class _HorovodReducescatter(torch.autograd.Function):
@@ -371,8 +379,9 @@ def alltoall_async(tensor: torch.Tensor,
         return _local_handle(src.clone())
     view = _np_view(src)
     handle = eng.enqueue_alltoall(view, name)
-    return _register(handle, src,
-                     lambda _t, out_np: _from_np(out_np, tensor.dtype))
+    return _register(
+        handle, src,
+        lambda _t, out_np, _info=None: _from_np(out_np, tensor.dtype))
 
 
 class _HorovodAlltoall(torch.autograd.Function):

@@ -46,6 +46,11 @@ def pytest_configure(config):
         "scale: big-world fleet tests (64+ engine ranks / 16-rank elastic "
         "under hierarchical coordination); ci.sh runs them in the scale "
         "gate under a hard timeout")
+    config.addinivalue_line(
+        "markers",
+        "straggler: backup-worker chaos soaks (slow-fault schedules, "
+        "step-time p99 comparison); ci.sh runs them in the straggler "
+        "gate under a hard timeout, separate from the fault/soak gates")
 
 
 @pytest.fixture(scope="session")

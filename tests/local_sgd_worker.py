@@ -1,0 +1,93 @@
+"""Local-SGD (periodic delta sync) multi-process worker.
+
+H purely-local SGD steps, then one outer allreduce of the model delta
+(``elastic.LocalSGD``): on the quadratic ``mean((w - t_r)^2)`` the whole
+run has a closed form — from a common anchor each local phase contracts
+``w`` toward the rank's own target by ``a = (1-2*lr)^H`` and the outer
+average makes one linear outer step, so after ``k`` outer rounds
+``w_k = tbar * (1 - a^k)`` exactly.  Every rank simulates the whole
+world's arithmetic and asserts the synced result against it, plus that
+the ENGINE moved exactly one tensor per outer sync (the H× wire cut is
+counted, not assumed).
+
+Deliberately jax-free, like elastic_worker.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.elastic import LocalSGD  # noqa: E402
+from horovod_tpu.runtime.engine import get_engine  # noqa: E402
+
+H = 8
+OUTER_ROUNDS = 4
+LR = 0.05
+DIM = 8
+
+
+def rank_target(rank: int) -> np.ndarray:
+    return np.linspace(rank + 1.0, rank + 2.0, DIM)
+
+
+def main():
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    target = rank_target(rank)
+
+    policy = LocalSGD(local_sgd_steps=H)
+    w = np.zeros(DIM, dtype=np.float64)
+    policy.begin({"w": w})
+    # Shadow reference: simulate EVERY rank's local phase + the outer
+    # average with identical arithmetic (float64; the engine's /size is
+    # exact at a power-of-two world).
+    ref = np.zeros(DIM, dtype=np.float64)
+    synced = 0
+    for step in range(H * OUTER_ROUNDS):
+        grad = 2.0 * (w - target)
+        w = w - LR * grad          # purely local: NO gradient allreduce
+        tree = {"w": w}
+        out = policy.maybe_sync(tree)
+        if out is not tree:        # identity contract: same object = no sync
+            w = out["w"]
+            synced += 1
+            # Reference outer round: every rank's local phase from `ref`,
+            # averaged anchor-free (the sync ships each rank's model).
+            locals_ = []
+            for r in range(size):
+                t = rank_target(r)
+                v = ref.copy()
+                for _ in range(H):
+                    v = v - LR * 2.0 * (v - t)
+                locals_.append(v)
+            ref = np.sum(locals_, axis=0) / size
+            assert np.allclose(w, ref, rtol=0, atol=1e-9), (w, ref)
+
+    assert synced == OUTER_ROUNDS, synced
+    assert policy.sync_count == OUTER_ROUNDS
+    st = eng.stats()
+    assert st["local_sgd_syncs"] == OUTER_ROUNDS, st["local_sgd_syncs"]
+    # The H× wire cut, counted: one delta tensor per outer sync is ALL
+    # the engine executed (H*OUTER_ROUNDS gradient allreduces avoided).
+    assert st["tensors"] == OUTER_ROUNDS, st["tensors"]
+
+    # Closed form: w_k = tbar * (1 - a^k) — local SGD converges to the
+    # consensus optimum at rate a per outer round.
+    tbar = np.mean([rank_target(r) for r in range(size)], axis=0)
+    a = (1.0 - 2.0 * LR) ** H
+    expected = tbar * (1.0 - a ** OUTER_ROUNDS)
+    assert np.allclose(w, expected, rtol=0, atol=1e-7), (w, expected)
+    loss = float(np.mean((w - tbar) ** 2))
+    assert loss <= 0.05, loss
+    print(f"LOCAL_SGD_OK rank={rank} syncs={synced} loss={loss:.8f}",
+          flush=True)
+    basics.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,294 @@
+"""Worker body for the straggler-tolerance multi-process tests.
+
+Backup-worker collectives (``HOROVOD_BACKUP_WORKERS=k``): the
+coordinator commits a SUM allreduce once size-k voters are ready (after
+``HOROVOD_BACKUP_GRACE_MS``); the committed participant set rides the
+response, skipped ranks finish with the clean ``StepSkipped`` status and
+ghost-drive the same full-world ring with zeros, and averaging divides
+by the PARTICIPANT count.  The straggler itself is made with the new
+``slow`` fault kind (``rank:step:slow:ms`` / ``rank:*:slow:ms``) — a
+deterministic enqueue delay, not a wedge.
+
+Run as ``python straggler_worker.py <scenario>`` with identity in
+HOROVOD_RANK/HOROVOD_SIZE/HOROVOD_COORDINATOR (see test_straggler.py).
+Deliberately jax-free, like native_worker.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import StepSkipped, get_engine  # noqa: E402
+
+
+def _straggler_rank(size: int) -> int:
+    return size - 1
+
+
+def scenario_parity_k0(rank, size, eng):
+    """k=0 under an injected slow rank: nothing skips — every rank waits,
+    every result is EXACT (the pre-backup synchronous contract), and the
+    partial-commit machinery provably never engages."""
+    steps = 4
+    for s in range(steps):
+        x = np.full((64,), float(rank + 1) * (s + 1), dtype=np.float32)
+        out = eng.allreduce(x, average=True, name=f"par.{s}")
+        expect = (s + 1) * np.mean([r + 1.0 for r in range(size)])
+        assert np.array_equal(
+            out, np.full((64,), np.float32(expect))), (s, out[0], expect)
+    st = eng.stats()
+    assert st["backup_skips"] == 0, st["backup_skips"]
+    assert st["config"]["backup_workers"] == 0, st["config"]
+    # The slow rank really did straggle: everyone's completion latency
+    # (enqueue -> finish) was gated on it.
+    if rank != _straggler_rank(size):
+        assert st["step_time_ns_p99"] >= 40 * 1_000_000, st[
+            "step_time_ns_p99"]
+
+
+def scenario_backup_skip(rank, size, eng):
+    """k=1 with a permanently slow last rank: every step commits without
+    it — participants see the exact mean over the OTHER ranks
+    (divisor-correct averaging), the straggler gets the clean StepSkipped
+    status every step (no wedge, no abort), and backup_skips counts it."""
+    steps = 5
+    straggler = _straggler_rank(size)
+    expect = np.float32(np.mean([r + 1.0 for r in range(size)
+                                 if r != straggler]))
+    skipped = 0
+    for s in range(steps):
+        x = np.full((64,), float(rank + 1), dtype=np.float32)
+        try:
+            out = eng.allreduce(x, average=True, name=f"bk.{s}")
+            assert rank != straggler, f"straggler unexpectedly joined {s}"
+            assert np.array_equal(out, np.full((64,), expect)), (
+                s, out[0], expect)
+        except StepSkipped:
+            skipped += 1
+            assert rank == straggler, f"rank {rank} skipped at step {s}"
+    st = eng.stats()
+    if rank == straggler:
+        assert skipped == steps, (skipped, steps)
+        assert st["backup_skips"] == steps, st["backup_skips"]
+    else:
+        assert skipped == 0
+        assert st["backup_skips"] == 0, st["backup_skips"]
+    assert st["config"]["backup_workers"] == 1, st["config"]
+    # MAX is never partially committed -> a true full-world barrier even
+    # under k>0: waits out the straggler's banked skip tokens.
+    out = eng.allreduce(np.full((4,), float(rank + 1), dtype=np.float32),
+                        red_op="max", name="bk.done")
+    assert np.array_equal(out, np.full((4,), np.float32(size))), out[0]
+
+
+def scenario_backup_cached(rank, size, eng):
+    """Partial commit on the CACHED negotiation path: warm the response
+    cache with full steps, make the last rank slow for exactly one step
+    (one-shot slow fault), and verify the partial slot commit — then that
+    the cache keeps working at full strength afterwards."""
+    steps = 12
+    slow_step = 6
+    straggler = _straggler_rank(size)
+    full_mean = np.mean([r + 1.0 for r in range(size)])
+    part_mean = np.mean([r + 1.0 for r in range(size) if r != straggler])
+    partials, skipped = [], 0
+    for s in range(steps):
+        x = np.full((256,), float(rank + 1) * (s + 1), dtype=np.float32)
+        info = {}
+        try:
+            h = eng.enqueue_allreduce(x, "ck")
+            out = eng.synchronize(h, info)
+        except StepSkipped:
+            skipped += 1
+            assert rank == straggler and s == slow_step, (rank, s)
+            continue
+        n = info.get("participants") or size
+        out = out / np.float32(n)
+        if n < size:
+            partials.append(s)
+            assert np.array_equal(
+                out, np.full((256,), np.float32((s + 1) * part_mean))), s
+            # Give the one-shot straggler time to catch up so the NEXT
+            # step is a clean full commit again (deterministic test).
+            time.sleep(0.8)
+        else:
+            assert np.array_equal(
+                out, np.full((256,), np.float32((s + 1) * full_mean))), (
+                s, out[0], (s + 1) * full_mean)
+    st = eng.stats()
+    if rank == straggler:
+        assert skipped == 1 and st["backup_skips"] == 1, (
+            skipped, st["backup_skips"])
+    else:
+        assert partials == [slow_step], partials
+        assert st["backup_skips"] == 0
+    # The cached path (not full renegotiation) carried the steady state.
+    assert st["cache_hits"] >= steps - 3, st["cache_hits"]
+
+
+def scenario_backup_multi(rank, size, eng):
+    """SEVERAL partial commits in one cycle: three different-dtype
+    allreduces enqueued as a burst (never fused) commit together, so the
+    wave scheduler dispatches partial responses onto POOL threads — the
+    skip bookkeeping must have run on the background thread beforehand
+    (a partial response at wave index >= 1 used to hit the
+    background-thread assert and abort the whole rank)."""
+    steps = 4
+    straggler = _straggler_rank(size)
+    part = [r + 1 for r in range(size) if r != straggler]
+    skipped = 0
+    for s in range(steps):
+        bufs = [
+            ("a", np.full((2048,), float(rank + 1), dtype=np.float32)),
+            ("b", np.full((2048,), float(rank + 1) * 2, dtype=np.float64)),
+            ("c", np.full((2048,), rank + 1, dtype=np.int32)),
+        ]
+        handles = [eng.enqueue_allreduce(arr, f"bm.{k}.{s}")
+                   for k, arr in bufs]
+        outs, got_skip = [], 0
+        for h in handles:
+            try:
+                outs.append(eng.synchronize(h))
+            except StepSkipped:
+                got_skip += 1
+                outs.append(None)
+        if rank == straggler:
+            assert got_skip == len(bufs), (s, got_skip)
+            skipped += got_skip
+        else:
+            assert got_skip == 0, (s, got_skip)
+            expect = [np.float32(sum(part)), np.float64(sum(part) * 2),
+                      np.int32(sum(part))]
+            for out, e in zip(outs, expect):
+                assert np.array_equal(out, np.full((2048,), e)), (s, out[0], e)
+    st = eng.stats()
+    if rank == straggler:
+        assert st["backup_skips"] == skipped, (st["backup_skips"], skipped)
+    out = eng.allreduce(np.full((4,), float(rank + 1), dtype=np.float32),
+                        red_op="max", name="bm.done")
+    assert np.array_equal(out, np.full((4,), np.float32(size))), out[0]
+
+
+def scenario_backup_hier(rank, size, eng):
+    """Hierarchical coordination + backup workers: 4 ranks faked as 2
+    hosts (HOROVOD_HOST_KEY h0/h0/h1/h1) with the last rank slow — a
+    voter is a HOST, so one slow member sidelines its whole host: the
+    committed participants are exactly host 0's ranks, and BOTH ranks of
+    the late host get the clean StepSkipped (the healthy member too,
+    because its sub-coordinator held its grant for the group)."""
+    steps = 4
+    straggler = _straggler_rank(size)
+    st0 = eng.stats()
+    assert st0["topology"]["hosts"] == 2, st0["topology"]
+    late_host = {straggler, straggler - 1}   # h1 = ranks {2, 3}
+    expect = np.float32(np.mean([r + 1.0 for r in range(size)
+                                 if r not in late_host]))
+    skipped = 0
+    for s in range(steps):
+        x = np.full((64,), float(rank + 1), dtype=np.float32)
+        try:
+            out = eng.allreduce(x, average=True, name=f"bh.{s}")
+            assert rank not in late_host, (rank, s)
+            assert np.array_equal(out, np.full((64,), expect)), (
+                s, out[0], expect)
+        except StepSkipped:
+            skipped += 1
+            assert rank in late_host, (rank, s)
+    st = eng.stats()
+    if rank in late_host:
+        assert skipped == steps and st["backup_skips"] == steps, (
+            skipped, st["backup_skips"])
+    else:
+        assert skipped == 0 and st["backup_skips"] == 0
+    out = eng.allreduce(np.full((4,), float(rank + 1), dtype=np.float32),
+                        red_op="max", name="bh.done")
+    assert np.array_equal(out, np.full((4,), np.float32(size))), out[0]
+
+
+def scenario_soak(rank, size, eng):
+    """Chaos soak body: N steps of cached steady-state allreduce under an
+    injected permanent straggler; prints this rank's step-time
+    percentiles for the driver to compare between k=0 and k=1 runs.
+    Zero aborts required (rc 0); the MAX epilogue is the barrier that
+    lets the straggler drain its skip tokens before shutdown."""
+    steps = int(os.environ.get("HOROVOD_SOAK_STEPS", "30"))
+    skipped = 0
+    for s in range(steps):
+        x = np.full((4096,), float(rank + 1), dtype=np.float32)
+        try:
+            eng.allreduce(x, average=True, name=f"soak.{s % 4}")
+        except StepSkipped:
+            skipped += 1
+    st = eng.stats()
+    print(f"SOAK rank={rank} p50={st['step_time_ns_p50']} "
+          f"p99={st['step_time_ns_p99']} skips={st['backup_skips']} "
+          f"local_skipped={skipped}", flush=True)
+    eng.allreduce(np.ones(4, dtype=np.float32), red_op="max",
+                  name="soak.done")
+
+
+def scenario_converge(rank, size, eng):
+    """Convergence under k=1 + a permanent straggler: participants run
+    plain SGD on the quadratic (grads averaged divisor-correctly over
+    whoever committed), skip-steps drop the update, and the final loss
+    must stay within bounds — the straggler re-syncs via broadcast at
+    the end (the documented recovery pattern) and passes the same bound."""
+    steps = 40
+    lr = 0.05
+    dim = 8
+    straggler = _straggler_rank(size)
+    target = np.linspace(rank + 1.0, rank + 2.0, dim)
+    tbar_all = np.mean([np.linspace(r + 1.0, r + 2.0, dim)
+                        for r in range(size)], axis=0)
+    w = np.zeros(dim, dtype=np.float64)
+    skipped = 0
+    for s in range(steps):
+        grad = 2.0 * (w - target)
+        try:
+            g = eng.allreduce(grad, average=True, name=f"cv.{s}")
+        except StepSkipped:
+            skipped += 1
+            continue  # no committed gradient this step: skip the update
+        w = w - lr * g
+    if rank == straggler:
+        assert skipped > steps // 2, skipped
+    # Post-run re-sync (bounds the straggler's drift): adopt rank 0's
+    # weights — broadcast is never partially committed, so this is a
+    # true barrier the straggler joins late but cleanly.
+    w = eng.broadcast(w, 0, name="cv.sync")
+    loss = float(np.mean((w - tbar_all) ** 2))
+    # Pure-participant convergence sits at mse(tbar_participants,
+    # tbar_all) ~= 0.25 for this target family; an untrained w is ~7.
+    assert loss <= 0.4, (loss, w)
+    print(f"CONVERGE rank={rank} loss={loss:.6f} skipped={skipped}",
+          flush=True)
+
+
+SCENARIOS = {
+    "parity_k0": scenario_parity_k0,
+    "backup_skip": scenario_backup_skip,
+    "backup_cached": scenario_backup_cached,
+    "backup_multi": scenario_backup_multi,
+    "backup_hier": scenario_backup_hier,
+    "soak": scenario_soak,
+    "converge": scenario_converge,
+}
+
+
+def main():
+    scenario = sys.argv[1]
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    SCENARIOS[scenario](rank, size, eng)
+    basics.shutdown()
+    print(f"worker rank={rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,256 @@
+"""Straggler-tolerance tests: backup-worker collectives + local SGD.
+
+Two layers:
+
+* fast multi-process semantics tests (tier-1): k=0 parity under an
+  injected ``slow`` fault, k=1 skip semantics with divisor-correct
+  averaging, the cached-path partial commit, and the local-SGD closed
+  form at 4 ranks;
+* the chaos soak (markers ``straggler`` + ``slow``, run by ci.sh's
+  straggler gate under a hard timeout): the acceptance experiment —
+  ``HOROVOD_FAULT_INJECT=<rank>:*:slow:200`` at 4 ranks, where
+  ``HOROVOD_BACKUP_WORKERS=1`` must cut the fast ranks' step-time p99
+  >= 2x vs k=0 on the same seeded schedule with zero aborts, plus the
+  convergence worker staying inside its loss bounds.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from tests.test_native_engine import run_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "straggler_worker.py")
+LOCAL_SGD_WORKER = os.path.join(REPO, "tests", "local_sgd_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# Backup-worker collectives (multi-process, fast: tier-1)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.straggler
+def test_k0_parity_under_slow_fault():
+    """HOROVOD_BACKUP_WORKERS=0 with a slow rank: fully synchronous, every
+    result exact, zero skips — and the new `slow` fault kind measurably
+    gates everyone's completion latency (the straggler is real).
+
+    Marked ``straggler`` (but NOT ``slow``): it runs once in the ci.sh
+    straggler gate (-m straggler) and once in the plain tier-1 verify
+    (-m 'not slow'), and is excluded from ci.sh's main sweep so nothing
+    runs twice in one CI pass."""
+    run_workers(4, "parity_k0", timeout=120, worker=WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "0",
+                           "HOROVOD_FAULT_INJECT": "3:*:slow:60"})
+
+
+def test_malformed_fault_spec_never_arms_rank0():
+    """A typo'd HOROVOD_FAULT_INJECT rank/step field must be IGNORED —
+    an atoi-style parse would turn 'bogus' into rank 0 and kill the
+    coordinator.  All ranks run a clean step and exit 0."""
+    run_workers(2, "parity_k0", timeout=120, worker=WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "0",
+                           "HOROVOD_FAULT_INJECT":
+                               "bogus:5:exit,0:zz:exit,1:*:slow:60"})
+
+
+def test_backup_worker_skips_permanent_straggler():
+    """k=1 with a permanently slow last rank: participants commit with
+    the exact participant-mean every step, the straggler gets the clean
+    StepSkipped status (never a wedge/abort), and the MAX epilogue is a
+    real full-world barrier even under k>0."""
+    run_workers(4, "backup_skip", timeout=120, worker=WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1",
+                           "HOROVOD_BACKUP_GRACE_MS": "50",
+                           "HOROVOD_FAULT_INJECT": "3:*:slow:600"})
+
+
+def test_backup_worker_partial_commit_on_cached_path():
+    """One-shot slow fault against a WARM negotiation cache: the partial
+    commit rides the cached-slot path (participant set in partial_slots),
+    and the cache keeps serving full-strength steps afterwards."""
+    run_workers(4, "backup_cached", timeout=120, worker=WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1",
+                           "HOROVOD_BACKUP_GRACE_MS": "50",
+                           "HOROVOD_FAULT_INJECT": "3:6:slow:600"})
+
+
+def test_backup_worker_partial_commits_in_concurrent_wave():
+    """Several same-cycle partial commits execute as a concurrent WAVE
+    (responses dispatched onto pool threads): the skip bookkeeping must
+    run on the background thread before dispatch — this used to abort
+    the skipped rank on the background-thread assert."""
+    run_workers(4, "backup_multi", timeout=120, worker=WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1",
+                           "HOROVOD_BACKUP_GRACE_MS": "50",
+                           "HOROVOD_NUM_CHANNELS": "4",
+                           "HOROVOD_WAVE_WIDTH": "4",
+                           "HOROVOD_FAULT_INJECT": "3:*:slow:600"})
+
+
+def test_backup_worker_hier_whole_late_host_is_one_voter():
+    """Hierarchical coordination (2 fake hosts via HOROVOD_HOST_KEY):
+    the slow rank's WHOLE host is one late voter — both of its ranks get
+    skipped, and participants average over the ready host only."""
+    run_workers(4, "backup_hier", timeout=120, worker=WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1",
+                           "HOROVOD_BACKUP_GRACE_MS": "50",
+                           "HOROVOD_HIERARCHICAL_COORDINATOR": "1",
+                           "HOROVOD_FAULT_INJECT": "3:*:slow:600"},
+                per_rank_env=lambda r: {"HOROVOD_HOST_KEY": f"h{r // 2}"})
+
+
+def test_local_sgd_h8_closed_form():
+    """H=8 local SGD at 4 ranks: the synced model matches the closed form
+    w_k = tbar*(1-a^k), local_sgd_syncs counts the outer rounds, and the
+    engine moved exactly one tensor per sync (the H× wire cut)."""
+    run_workers(4, "h8", timeout=120, worker=LOCAL_SGD_WORKER)
+
+
+# ---------------------------------------------------------------------------
+# Local-SGD policy + frontend wiring (single-process: tier-1)
+# ---------------------------------------------------------------------------
+
+def test_local_sgd_epoch_stamp_drops_dead_incarnation_delta():
+    """An elastic resize bumps the membership epoch; the policy must
+    RE-ANCHOR instead of allreducing the dead incarnation's delta."""
+    from horovod_tpu.elastic import LocalSGD
+
+    policy = LocalSGD(local_sgd_steps=2)
+    w = {"w": np.ones(4)}
+    policy.begin(w)
+    w = policy.maybe_sync({"w": np.full(4, 2.0)})   # local step 1 of 2
+    # Simulate a resize committing a new epoch under the policy.
+    policy._anchor_epoch = 12345
+    stale = {"w": np.full(4, 3.0)}
+    out = policy.maybe_sync(stale)
+    assert out is stale                   # no sync fired
+    assert policy.sync_count == 0
+    assert policy._local_steps == 0       # re-anchored, counting afresh
+    assert policy._anchored and policy._anchor_epoch == 0
+    # From the fresh anchor the cadence works normally again.
+    policy.maybe_sync({"w": np.full(4, 4.0)})
+    out = policy.maybe_sync({"w": np.full(4, 5.0)})
+    assert policy.sync_count == 1         # world of one: identity sync
+
+
+def test_local_sgd_steps_default_env(monkeypatch):
+    from horovod_tpu.elastic import default_local_sgd_steps
+
+    monkeypatch.delenv("HOROVOD_LOCAL_SGD_STEPS", raising=False)
+    assert default_local_sgd_steps() == 1
+    monkeypatch.setenv("HOROVOD_LOCAL_SGD_STEPS", "8")
+    assert default_local_sgd_steps() == 8
+    monkeypatch.setenv("HOROVOD_LOCAL_SGD_STEPS", "bogus")
+    assert default_local_sgd_steps() == 1
+
+
+def test_jax_optimizer_local_sgd_h1_is_identical_to_default():
+    """local_sgd_steps=1 must be byte-identical to the plain synchronous
+    DistributedOptimizer: same code path (no policy is even built)."""
+    import optax
+
+    import horovod_tpu.jax as hvd
+
+    opt_plain = hvd.DistributedOptimizer(optax.sgd(0.125))
+    opt_h1 = hvd.DistributedOptimizer(optax.sgd(0.125), local_sgd_steps=1)
+    assert opt_h1.local_sgd is None
+    params = {"w": np.linspace(0.0, 1.0, 8, dtype=np.float32)}
+    grads = {"w": np.linspace(1.0, 2.0, 8, dtype=np.float32)}
+    s0 = opt_plain.init(params)
+    s1 = opt_h1.init(params)
+    u0, _ = opt_plain.update(grads, s0, params)
+    u1, _ = opt_h1.update(grads, s1, params)
+    assert np.array_equal(np.asarray(u0["w"]), np.asarray(u1["w"]))
+
+
+def test_jax_optimizer_local_sgd_h_gt_1_skips_gradient_reduction():
+    """H>1: update applies gradients purely locally (no per-step wire
+    traffic) and attaches the shared LocalSGD policy."""
+    import optax
+
+    import horovod_tpu.jax as hvd
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.125), local_sgd_steps=4)
+    assert opt.local_sgd is not None and opt.local_sgd.steps == 4
+    bound = opt.with_axis_name(("data",))
+    assert bound.local_sgd is opt.local_sgd   # one policy per run
+    params = {"w": np.zeros(4, dtype=np.float32)}
+    grads = {"w": np.full(4, 2.0, dtype=np.float32)}
+    state = opt.init(params)
+    updates, _ = opt.update(grads, state, params)
+    # Pure local SGD update: -lr * grad, untouched by any reduction.
+    assert np.array_equal(np.asarray(updates["w"]),
+                          np.full(4, -0.25, dtype=np.float32))
+
+
+def test_torch_optimizer_local_sgd_counts_and_syncs():
+    """Torch frontend wiring: H local steps then one outer delta sync
+    (world of one: the sync is an identity, but the cadence and the
+    anchor bookkeeping are exercised end to end)."""
+    torch = pytest.importorskip("torch")
+    import horovod_tpu.torch as hvd
+
+    w = torch.nn.Parameter(torch.zeros(4))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([w], lr=0.5), named_parameters=[("w", w)],
+        local_sgd_steps=3)
+    for step in range(6):
+        opt.zero_grad()
+        (w * 1.0).sum().backward()
+        opt.step()
+    assert opt._local_sgd.sync_count == 2
+    # Six local SGD steps of lr=0.5 against grad=1: w == -3 exactly
+    # (the identity syncs must not perturb the values).
+    assert torch.equal(w.data, torch.full((4,), -3.0))
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: the acceptance experiment (ci.sh straggler gate)
+# ---------------------------------------------------------------------------
+
+def _soak_p99s(backup_workers: int):
+    """Run the 4-rank soak under a permanent 200 ms straggler on rank 3
+    and return the FAST ranks' step-time p99s (ns)."""
+    results = run_workers(
+        4, "soak", timeout=240, worker=WORKER,
+        extra_env={"HOROVOD_BACKUP_WORKERS": str(backup_workers),
+                   "HOROVOD_BACKUP_GRACE_MS": "50",
+                   "HOROVOD_SOAK_STEPS": "30",
+                   "HOROVOD_FAULT_INJECT": "3:*:slow:200"})
+    p99s = {}
+    for rank, (out, _err) in enumerate(results):
+        m = re.search(r"SOAK rank=%d p50=(\d+) p99=(\d+)" % rank,
+                      out.decode())
+        assert m is not None, out.decode()
+        p99s[rank] = int(m.group(2))
+    return [p99s[r] for r in range(3)]  # rank 3 is the straggler
+
+
+@pytest.mark.straggler
+@pytest.mark.slow
+def test_backup_workers_cut_step_time_p99_2x():
+    """The acceptance bar: same seeded slow-fault schedule, k=1 must cut
+    the fast ranks' step-time p99 >= 2x vs k=0, with zero aborts (every
+    worker exits 0 in both runs)."""
+    p99_k0 = _soak_p99s(0)
+    p99_k1 = _soak_p99s(1)
+    worst_k1 = max(p99_k1)
+    best_k0 = min(p99_k0)
+    assert best_k0 >= 2.0 * worst_k1, (p99_k0, p99_k1)
+    # Sanity on absolute scale: k=0 is gated on the 200 ms straggler.
+    assert best_k0 >= 150 * 1_000_000, p99_k0
+
+
+@pytest.mark.straggler
+@pytest.mark.slow
+def test_convergence_within_bounds_under_straggler():
+    """k=1 training with a permanent straggler: participants converge
+    inside the loss bound, the straggler accumulates clean skips and
+    re-syncs via broadcast at the end — zero aborts."""
+    run_workers(4, "converge", timeout=240, worker=WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1",
+                           "HOROVOD_BACKUP_GRACE_MS": "40",
+                           "HOROVOD_FAULT_INJECT": "3:*:slow:150"})
