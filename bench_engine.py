@@ -183,6 +183,66 @@ def _sweep_worker() -> None:
     basics.shutdown()
 
 
+def _rs_sweep_worker() -> None:
+    """Reduce-scatter bus bandwidth ((N-1)/N · bytes / wall — half the
+    allreduce numerator, matching the RS wire pattern) from the
+    engine's deterministic reducescatter counters."""
+    import numpy as np
+
+    basics, eng = _engine_setup()
+    nbytes = int(os.environ["BENCH_SWEEP_BYTES"])
+    n = max(1, nbytes // 4)
+    iters = max(2, min(30, (32 << 20) // max(nbytes, 1)))
+    x = np.ones(n, dtype=np.float32)
+    eng.reducescatter(x, name="rs.sweep.warm")
+    before = eng.stats()
+    for _ in range(iters):
+        eng.synchronize(eng.enqueue_reducescatter(x, name="rs.sweep.t"))
+    d = eng.stats_delta(before)
+    if basics.rank() == 0:
+        print(f"RS_SWEEP_BUS_MB_S "
+              f"{d['reducescatter_bus_bw_bytes_per_sec'] / 1e6:.1f} "
+              f"FALLBACKS {d['reducescatter_fallbacks']}", flush=True)
+    basics.shutdown()
+
+
+def _sharded_bytes_worker() -> None:
+    """Per-step wire accounting of the ZeRO sharded step vs the
+    unsharded allreduce, on the deterministic byte counters: the
+    gradient reduce-scatter (the gate metric, ~0.5x by construction)
+    and the FULL step incl. the parameter allgather (~1.0x — the honest
+    ZeRO number; memory, not bytes, is the lever)."""
+    import numpy as np
+
+    from horovod_tpu.runtime.sharded import FlatSharder
+
+    basics, eng = _engine_setup()
+    n = int(os.environ.get("BENCH_SHARDED_ELEMS", str(1 << 20)))
+    sharder = FlatSharder(n, np.float32, name="bench.zero")
+    g = np.ones(n, dtype=np.float32)
+    # Warm both paths (wiring, fusion scratch).
+    eng.allreduce(g.copy(), name="zb.warm")
+    sharder.step(g, lambda s: s, average=True)
+    steps = 4
+    s0 = eng.stats()
+    for _ in range(steps):
+        eng.allreduce(g.copy(), average=True, name="zb.ar")
+    ar_tx = eng.stats_delta(s0)["data_bytes_tx"]
+    s1 = eng.stats()
+    shard = None
+    for _ in range(steps):
+        shard = sharder.reduce_grads(g, average=True)
+    rs_tx = eng.stats_delta(s1)["data_bytes_tx"]
+    s2 = eng.stats()
+    for _ in range(steps):
+        sharder.gather_updates(shard)
+    ag_tx = eng.stats_delta(s2)["data_bytes_tx"]
+    if basics.rank() == 0:
+        print(f"SHARDED_BYTES ar_tx {ar_tx} rs_tx {rs_tx} "
+              f"ag_tx {ag_tx}", flush=True)
+    basics.shutdown()
+
+
 def _latency_worker() -> None:
     import numpy as np
 
@@ -620,6 +680,36 @@ def main() -> None:
     result["allreduce_bus_bw_mb_s_1ch"] = sweep_1ch
     result["allreduce_bus_bw_mb_s_shm"] = sweep_shm
 
+    # Reduce-scatter size sweep (the ZeRO gradient half) on the default
+    # plane: RS bus bandwidth = (N-1)/N · bytes / wall — directly
+    # comparable to the allreduce busbw above because both normalize to
+    # per-link traffic.
+    rs_sweep: dict = {}
+    for n in (2, 4):
+        per_size = rs_sweep.setdefault(str(n), {})
+        for label, nbytes in sizes:
+            out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
+                                 "--rs-sweep-worker"],
+                             extra_env={"BENCH_SWEEP_BYTES": str(nbytes)})
+            m = re.search(r"RS_SWEEP_BUS_MB_S ([\d.]+)", out)
+            if m:
+                per_size[label] = float(m.group(1))
+    result["reducescatter_bus_bw_mb_s"] = rs_sweep
+
+    # ZeRO step wire accounting at 4 ranks, 4 MB flat model, on the
+    # deterministic byte counters: grads_rs ~0.5 (the gated half),
+    # full_step ~1.0 (RS + param allgather — the honest ZeRO total).
+    out = _run_ranks(4, [sys.executable, os.path.abspath(__file__),
+                         "--sharded-bytes-worker"])
+    m = re.search(r"SHARDED_BYTES ar_tx (\d+) rs_tx (\d+) ag_tx (\d+)",
+                  out)
+    if m:
+        ar_tx, rs_tx, ag_tx = (int(m.group(i)) for i in (1, 2, 3))
+        result["sharded_step_bytes_ratio"] = {
+            "grads_rs": round(rs_tx / max(1, ar_tx), 4),
+            "full_step": round((rs_tx + ag_tx) / max(1, ar_tx), 4),
+        }
+
     # Single-allreduce latency at 2 ranks: single-channel TCP (the PR 2
     # control-plane number; must not regress) and the default shm plane
     # (star path — the PR 6 gated metric).
@@ -885,6 +975,57 @@ def shm_gate() -> None:
     print("SHM GATE PASSED")
 
 
+def sharded_gate() -> None:
+    """CI sharded (ZeRO-1) gate, three legs under ci.sh's hard timeout,
+    all on DETERMINISTIC instruments (bitwise compares + byte
+    counters — never wall time):
+
+    1. bitwise sharded-vs-unsharded parity at 4 ranks: the
+       sharded_worker numpy core asserts params bit-identical to the
+       unsharded flat step after EVERY step, optimizer state ~1/N, and
+       the per-step byte bounds rank-side;
+    2. RS-vs-sliced-allreduce byte parity + the RS wire ratio at 4
+       ranks (reducescatter_worker bytes scenario: tx in [0.40, 0.55]x
+       the allreduce's);
+    3. driver-side wire-bytes ratio: grads reduce-scatter tx <= 0.55x
+       the unsharded allreduce tx on a 4 MB flat model (and the honest
+       full-step total printed for the record — ZeRO trades no bytes
+       for its 1/N memory, see docs/zero.md).
+    """
+    cap = float(os.environ.get("HOROVOD_SHARDED_GATE_RATIO", "0.55"))
+
+    print("sharded gate 1/3: bitwise sharded-vs-unsharded parity @ 4")
+    worker = os.path.join(REPO, "tests", "sharded_worker.py")
+    _run_ranks(4, [sys.executable, worker, "numpy"], timeout=300)
+    print("sharded parity OK")
+
+    print("sharded gate 2/3: RS parity + wire ratio @ 4 ranks")
+    rs_worker = os.path.join(REPO, "tests", "reducescatter_worker.py")
+    _run_ranks(4, [sys.executable, rs_worker, "bytes"], timeout=300)
+    print("RS byte ratio OK")
+
+    print("sharded gate 3/3: step wire accounting @ 4 ranks")
+    out = _run_ranks(4, [sys.executable, os.path.abspath(__file__),
+                         "--sharded-bytes-worker"], timeout=300)
+    m = re.search(r"SHARDED_BYTES ar_tx (\d+) rs_tx (\d+) ag_tx (\d+)",
+                  out)
+    if m is None:
+        print("SHARDED GATE FAILED: no byte measurements produced")
+        sys.exit(1)
+    ar_tx, rs_tx, ag_tx = (int(m.group(i)) for i in (1, 2, 3))
+    grads_ratio = rs_tx / max(1, ar_tx)
+    full_ratio = (rs_tx + ag_tx) / max(1, ar_tx)
+    print(f"data_bytes_tx: allreduce {ar_tx}, grads RS {rs_tx} "
+          f"(x{grads_ratio:.3f}, cap {cap:.2f}), full sharded step "
+          f"{rs_tx + ag_tx} (x{full_ratio:.3f} — the honest ZeRO "
+          f"total; the lever is 1/N memory)")
+    if grads_ratio > cap:
+        print("SHARDED GATE FAILED: the gradient reduce-scatter did "
+              "not halve the deterministic byte counter")
+        sys.exit(1)
+    print("SHARDED GATE PASSED")
+
+
 def compression_gate() -> None:
     """CI wire-compression gate, three legs under ci.sh's hard timeout:
 
@@ -1003,6 +1144,12 @@ if __name__ == "__main__":
         _wire_sweep_worker()
     elif "--wire-gate-worker" in sys.argv:
         _wire_gate_worker()
+    elif "--rs-sweep-worker" in sys.argv:
+        _rs_sweep_worker()
+    elif "--sharded-bytes-worker" in sys.argv:
+        _sharded_bytes_worker()
+    elif "--sharded-gate" in sys.argv:
+        sharded_gate()
     elif "--compression-gate" in sys.argv:
         compression_gate()
     elif "--shm-gate" in sys.argv:

@@ -70,7 +70,8 @@ echo "== straggler gate (slow faults at 4 ranks, p99 + convergence, hard timeout
 # runs HERE, not in the main sweep — no duplicate); the skip and
 # cached-partial semantics tests stay fast + unmarked in the main sweep.
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
-    python -m pytest tests/test_straggler.py -q -m "straggler"
+    python -m pytest tests/test_straggler.py tests/test_reducescatter.py \
+    -q -m "straggler"
 
 echo "== control-plane cache gate (2 ranks, 50 steps, hard timeout) =="
 # Regression gate for the negotiation response cache: a steady-state
@@ -111,6 +112,21 @@ PALLAS_AXON_POOL_IPS= timeout -k 15 420 \
     python -m pytest "tests/test_data_plane.py::test_shm_bitwise_parity_vs_tcp[4]" \
     "tests/test_data_plane.py::test_algo_threshold_parity[4]" -q
 PALLAS_AXON_POOL_IPS= timeout -k 15 900 python bench_engine.py --shm-gate
+
+echo "== sharded gate (ZeRO-1 bitwise parity + wire-bytes ratio, hard timeout) =="
+# Reduce-scatter + sharded optimizer: (1) DistributedOptimizer(
+# sharded=True)'s step must be BIT-IDENTICAL to the unsharded flat step
+# at 4 ranks with measured ~1/N optimizer-state bytes (sharded_worker
+# asserts after every step); (2) reducescatter must move [0.40, 0.55]x
+# the allreduce's deterministic data_bytes_tx (the RS half of the ring —
+# exactly 0.5x by construction); (3) the driver re-checks the grads-RS
+# ratio <= 0.55 on a 4 MB flat model and prints the honest full-step
+# total (~1.0x: ZeRO trades no bytes for its 1/N memory, docs/zero.md).
+# Byte counters and bitwise compares only — never wall time (the
+# loopback-ceiling lesson).  The hard timeout is the wedge detector for
+# the RS half-cascade.
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
+    python bench_engine.py --sharded-gate
 
 echo "== compression gate (wire dtypes + sparse error feedback, hard timeout) =="
 # Wire-level gradient compression: (1) the fp32-wire DEFAULT must be

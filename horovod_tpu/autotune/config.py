@@ -156,14 +156,28 @@ KNOBS: List[Knob] = [
          lambda raw: str(max(5, _int_env(raw, 120))),
          "first-rendezvous / join-exchange deadline"),
     Knob("HOROVOD_BACKUP_WORKERS", "0",
-         lambda raw: str(max(0, _int_env(raw, 0))),
+         lambda raw: raw if (raw or "").strip() == "auto"
+         else str(max(0, _int_env(raw, 0))),
          "backup-worker collectives: SUM allreduces commit at size-k "
          "voter readiness; skipped ranks get the clean StepSkipped "
          "status and averaging divides by participants (0 = fully "
-         "synchronous; docs/elastic.md 'Straggler tolerance')"),
+         "synchronous; 'auto' arms k=1 from the step-time p99/p50 "
+         "window ratio; docs/elastic.md 'Straggler tolerance')"),
+    Knob("HOROVOD_BACKUP_AUTO_RATIO", "3.0",
+         lambda raw: raw or "3.0",
+         "HOROVOD_BACKUP_WORKERS=auto arming threshold on the "
+         "step_time_ns_p99/p50 window ratio (>=64 samples; reported in "
+         "stats()['config'] as backup_auto/backup_armed)"),
     Knob("HOROVOD_BACKUP_GRACE_MS", "50",
          lambda raw: str(max(0, _int_env(raw, 50))),
          "minimum pending age before a partial commit may skip a rank"),
+    Knob("HOROVOD_SHARDED", "0",
+         lambda raw: str(1 if (raw or "").strip() not in
+                         ("", "0", "false", "False") else 0),
+         "DistributedOptimizer(sharded=) default: ZeRO-1 sharded "
+         "optimizer — reducescatter(grads), shard-local update, "
+         "allgather(params); ~1/N optimizer memory per rank "
+         "(docs/zero.md)"),
     Knob("HOROVOD_LOCAL_SGD_STEPS", "1",
          lambda raw: str(max(1, _int_env(raw, 1))),
          "local-SGD periodic sync: H local steps per outer model-delta "

@@ -154,6 +154,22 @@ int64_t horovod_allreduce_bytes() {
   return Engine::Get().allreduce_bytes();
 }
 int64_t horovod_allreduce_ns() { return Engine::Get().allreduce_ns(); }
+// Reduce-scatter observability (first-class collective + the ZeRO-style
+// sharded optimizer riding it): payload bytes / wall time of
+// REDUCESCATTER responses, responses that took the exact-parity
+// fallback (full allreduce + slice), and sharded-optimizer steps the
+// Python frontends completed (noted like local_sgd_syncs).
+int64_t horovod_reducescatter_bytes() {
+  return Engine::Get().reducescatter_bytes();
+}
+int64_t horovod_reducescatter_ns() {
+  return Engine::Get().reducescatter_ns();
+}
+int64_t horovod_reducescatter_fallbacks() {
+  return Engine::Get().reducescatter_fallback_count();
+}
+int64_t horovod_sharded_steps() { return Engine::Get().sharded_steps(); }
+void horovod_note_sharded_step() { Engine::Get().NoteShardedStep(); }
 int64_t horovod_num_channels() {
   return static_cast<int64_t>(Engine::Get().num_channels());
 }
@@ -220,6 +236,19 @@ int64_t horovod_wire_dtype() {
 // compares between k=0 and k=1 runs.
 int64_t horovod_backup_workers() {
   return static_cast<int64_t>(Engine::Get().backup_workers());
+}
+// HOROVOD_BACKUP_WORKERS=auto: whether auto mode is on, the arming
+// ratio threshold (milli-units — the C ABI stays int64-only), and
+// whether the coordinator's step-time window currently arms k=1
+// (workers report 0; commits reach them inside responses).
+int64_t horovod_backup_auto() {
+  return Engine::Get().backup_auto() ? 1 : 0;
+}
+int64_t horovod_backup_auto_ratio_milli() {
+  return Engine::Get().backup_auto_ratio_milli();
+}
+int64_t horovod_backup_armed() {
+  return Engine::Get().backup_armed() ? 1 : 0;
 }
 int64_t horovod_backup_skips() { return Engine::Get().backup_skips(); }
 int64_t horovod_local_sgd_syncs() {

@@ -666,9 +666,26 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   // (after the grace window) instead of waiting for the whole world.
   // The coordinator's resolution is committed at rendezvous (workers
   // adopt it below, like the channel count); 0 = fully synchronous.
-  backup_workers_ =
-      static_cast<int>(EnvInt64("HOROVOD_BACKUP_WORKERS", 0));
-  if (backup_workers_ < 0) backup_workers_ = 0;
+  {
+    // HOROVOD_BACKUP_WORKERS=auto: start fully synchronous (k=0) and
+    // let the coordinator arm k=1 only while its step-time window ratio
+    // p99/p50 exceeds HOROVOD_BACKUP_AUTO_RATIO (default 3.0) — the
+    // same percentile instrument the straggler gate judges with.
+    const char* braw = std::getenv("HOROVOD_BACKUP_WORKERS");
+    backup_auto_ = braw != nullptr && std::string(braw) == "auto";
+    backup_armed_.store(false);
+    backup_workers_ = backup_auto_
+        ? 0
+        : static_cast<int>(EnvInt64("HOROVOD_BACKUP_WORKERS", 0));
+    if (backup_workers_ < 0) backup_workers_ = 0;
+    backup_auto_ratio_ = 3.0;
+    const char* rraw = std::getenv("HOROVOD_BACKUP_AUTO_RATIO");
+    if (rraw != nullptr && *rraw != '\0') {
+      char* end = nullptr;
+      double v = std::strtod(rraw, &end);
+      if (end != rraw && v > 1.0) backup_auto_ratio_ = v;
+    }
+  }
   backup_grace_ms_ =
       static_cast<int>(EnvInt64("HOROVOD_BACKUP_GRACE_MS", 50));
   if (backup_grace_ms_ < 0) backup_grace_ms_ = 0;
@@ -1837,9 +1854,18 @@ bool Engine::WireShmEdges(std::string* err) {
   return true;
 }
 
+// Ring bookkeeping convention (vrank = position - 1): after a ring's
+// reduce-scatter phase, (physical) position s owns fully-reduced
+// segment s — so the RS half IS a first-class reducescatter (rank r
+// keeps exactly its committed shard r), and segment s accumulates in
+// ring order s+1, s+2, ..., s+N (mod N; outermost operand = position
+// s's raw data).  Any CONSISTENT vrank assignment yields a correct
+// allreduce — the choice only fixes the fold order — so the allgather
+// phase and every parity anchor (transport, channels, star fold,
+// two-level) follow this one convention.
 Engine::RingSpec Engine::TcpRingSpec() {
   RingSpec spec;
-  spec.vrank = rank_;
+  spec.vrank = (rank_ - 1 + size_) % size_;
   spec.rsize = size_;
   spec.span = "RING_CH";
   spec.ports.resize(num_channels_);
@@ -1852,7 +1878,7 @@ Engine::RingSpec Engine::TcpRingSpec() {
 
 Engine::RingSpec Engine::ShmRingSpec() {
   RingSpec spec;
-  spec.vrank = local_index_;
+  spec.vrank = (local_index_ - 1 + group_size_) % group_size_;
   spec.rsize = group_size_;
   spec.span = "SHM_CH";
   spec.ports.resize(num_channels_);
@@ -1865,7 +1891,7 @@ Engine::RingSpec Engine::ShmRingSpec() {
 
 Engine::RingSpec Engine::CrossRingSpec() {
   RingSpec spec;
-  spec.vrank = node_id_;
+  spec.vrank = (node_id_ - 1 + nnodes_) % nnodes_;
   spec.rsize = nnodes_;
   spec.span = "RING_CH";
   spec.ports.resize(num_channels_);
@@ -2868,12 +2894,14 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
         PendingInfo info;
         info.requests.resize(size_);
         info.seen.assign(size_, false);
+        info.seen_time.resize(size_);
         info.first_seen = std::chrono::steady_clock::now();
         it = message_table_.emplace(q.tensor_name, std::move(info)).first;
       }
       PendingInfo& info = it->second;
       if (!info.seen[r]) {
         info.seen[r] = true;
+        info.seen_time[r] = std::chrono::steady_clock::now();
         info.requests[r] = q;
         info.count++;
         timeline_.NegotiateRankReady(q.tensor_name, r);
@@ -2893,10 +2921,12 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
       SlotPending& sp = coord_slot_bits_[slot];
       if (sp.seen.empty()) {
         sp.seen.assign(nvoters, false);
+        sp.seen_time.resize(nvoters);
         sp.first_seen = std::chrono::steady_clock::now();
       }
       if (!sp.seen[v]) {
         sp.seen[v] = true;
+        sp.seen_time[v] = std::chrono::steady_clock::now();
         sp.count++;
       }
       if (sp.count == nvoters) agreed.push_back(slot);
@@ -2939,7 +2969,7 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
   // still short of full readiness but past the nvoters-k threshold and
   // the grace window (full commits above always win the race — a tensor
   // every rank reported this cycle never reaches this scan).
-  if (backup_workers_ > 0) MaybePartialCommits(&out);
+  if (backup_workers_ > 0 || backup_auto_) MaybePartialCommits(&out);
 
   // Sparse-layout rendezvous: a pending entry whose received requests are
   // ALL layout probes (ranks with no local gradient), coexisting with a
@@ -3060,8 +3090,9 @@ Response Engine::BuildResponse(const std::string& name) {
     // overrides disagreeing must fail cleanly here — never garble bytes
     // on the ring.  Probes and knob-derived (wire_default) requests are
     // exempt — they adopt the committed wire (see wire_ref above).
-    if (first.type == RequestType::ALLREDUCE && !q.probe &&
-        !q.wire_default && !wire_ref->wire_default &&
+    if ((first.type == RequestType::ALLREDUCE ||
+         first.type == RequestType::REDUCESCATTER) &&
+        !q.probe && !q.wire_default && !wire_ref->wire_default &&
         q.wire_dtype != wire_ref->wire_dtype) {
       err << "Mismatched wire dtypes: rank " << wire_ref->request_rank
           << " requested " << WireDtypeName(wire_ref->wire_dtype)
@@ -3109,9 +3140,15 @@ Response Engine::BuildResponse(const std::string& name) {
       return resp;
     }
     // Reducescatter: rows split as evenly as possible, earlier ranks get
-    // the remainder (same convention as the ring segments).
+    // the remainder (largest-first — the same convention as the ring
+    // segments, which is exactly what makes the 1-D shard geometry
+    // coincide with the allreduce's EvenSegments and the RS half
+    // bit-parity hold by construction).
     resp.type = ResponseType::REDUCESCATTER;
     resp.red_op = first.red_op;
+    // Committed wire format, negotiated + validated like the allreduce's
+    // (the RS data plane shares the codec seam).
+    resp.wire_dtype = wire_ref->wire_dtype;
     int64_t rows = first.shape[0];
     for (int r = 0; r < size_; ++r) {
       resp.tensor_sizes.push_back(rows / size_ +
@@ -3286,13 +3323,46 @@ Response Engine::BuildPartialResponse(
 // host — exactly the sub-coordinator readiness-aggregation contract.
 void Engine::MaybePartialCommits(ResponseList* out) {
   AssertBackgroundThread();
-  if (backup_workers_ <= 0 || size_ <= 1) return;
+  int k = backup_workers_;
+  if (backup_auto_) {
+    // Auto mode: evaluate the arming rule each cycle on the
+    // coordinator's own completion-latency window (a straggler anywhere
+    // inflates every participant's p99, the coordinator's included).
+    // Needs a meaningfully filled window — arming off 2 samples would
+    // mistake warmup jitter for a straggler.
+    size_t nsamp;
+    {
+      std::lock_guard<std::mutex> lk(step_ns_mu_);
+      nsamp = step_ns_samples_.size();
+    }
+    const int64_t p50 = step_time_ns_p50();
+    const int64_t p99 = step_time_ns_p99();
+    const bool armed =
+        nsamp >= 64 && p50 > 0 &&
+        static_cast<double>(p99) >
+            backup_auto_ratio_ * static_cast<double>(p50);
+    backup_armed_.store(armed);
+    k = armed ? 1 : 0;
+  }
+  if (k <= 0 || size_ <= 1) return;
   const bool hier = HierActive();
   const int nvoters = hier ? nnodes_ : size_;
-  const int need = std::max(1, nvoters - backup_workers_);
+  const int need = std::max(1, nvoters - k);
   if (need >= nvoters) return;  // k over-clamped on a tiny world
   const auto now = std::chrono::steady_clock::now();
   const auto grace = std::chrono::milliseconds(backup_grace_ms_);
+  // Grace is measured from QUORUM formation: the commit may fire only
+  // when the (nvoters-k)-th voter has been ready for >= the grace
+  // window — i.e. a rank is skipped only when it lags the QUORUM by
+  // more than the grace, never because one early-bird request (a
+  // one-shot straggler catching up ahead of peers) aged the entry.
+  auto quorum_ready =
+      [&](std::vector<std::chrono::steady_clock::time_point> times) {
+        if (static_cast<int>(times.size()) < need) return false;
+        std::nth_element(times.begin(), times.begin() + (need - 1),
+                         times.end());
+        return now - times[need - 1] >= grace;
+      };
 
   // Full-request pending entries.  Names first: the commit erases them.
   std::vector<std::string> names;
@@ -3312,20 +3382,39 @@ void Engine::MaybePartialCommits(ResponseList* out) {
   for (const auto& name : names) {
     const PendingInfo& info = message_table_[name];
     std::vector<bool> rank_in(size_, false);
+    std::vector<std::chrono::steady_clock::time_point> ready_times;
     int ready = 0;
     if (hier) {
+      // A voter is a host group, ready when EVERY member reported;
+      // its ready time is its slowest member's.
       std::vector<char> group_ready(nnodes_, 1);
+      std::vector<std::chrono::steady_clock::time_point> group_time(
+          nnodes_);
       for (int r = 0; r < size_; ++r) {
-        if (!info.seen[r]) group_ready[rank_host_[r]] = 0;
+        const int g = rank_host_[r];
+        if (!info.seen[r]) {
+          group_ready[g] = 0;
+        } else if (info.seen_time[r] > group_time[g]) {
+          group_time[g] = info.seen_time[r];
+        }
       }
-      for (int g = 0; g < nnodes_; ++g) ready += group_ready[g] ? 1 : 0;
+      for (int g = 0; g < nnodes_; ++g) {
+        if (group_ready[g]) {
+          ready++;
+          ready_times.push_back(group_time[g]);
+        }
+      }
       if (ready < need) continue;
       for (int r = 0; r < size_; ++r) rank_in[r] = group_ready[rank_host_[r]];
     } else {
       ready = info.count;
       if (ready < need) continue;
-      for (int r = 0; r < size_; ++r) rank_in[r] = info.seen[r];
+      for (int r = 0; r < size_; ++r) {
+        rank_in[r] = info.seen[r];
+        if (info.seen[r]) ready_times.push_back(info.seen_time[r]);
+      }
     }
+    if (!quorum_ready(std::move(ready_times))) continue;
     std::vector<uint32_t> participants;
     for (int r = 0; r < size_; ++r) {
       if (rank_in[r]) participants.push_back(static_cast<uint32_t>(r));
@@ -3344,7 +3433,11 @@ void Engine::MaybePartialCommits(ResponseList* out) {
   std::vector<uint32_t> pslots;
   for (auto& kv : coord_slot_bits_) {
     if (kv.second.count < need || kv.second.count >= nvoters) continue;
-    if (now - kv.second.first_seen < grace) continue;
+    std::vector<std::chrono::steady_clock::time_point> vt;
+    for (size_t v = 0; v < kv.second.seen.size(); ++v) {
+      if (kv.second.seen[v]) vt.push_back(kv.second.seen_time[v]);
+    }
+    if (!quorum_ready(std::move(vt))) continue;
     auto ce = cache_entries_.find(kv.first);
     if (ce == cache_entries_.end()) continue;  // defensive
     if (ce->second.response.type != ResponseType::ALLREDUCE ||
@@ -3804,11 +3897,12 @@ void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
 
 // Ring segment arithmetic, shared by every ring and by the star fold that
 // emulates it.  `vrank` is the rank used for segment bookkeeping: after
-// the reduce-scatter phase, (v)rank r owns the fully-reduced segment
-// (r + 1) mod size — so segment s is accumulated in ring order
-// s, s+1, ..., s+size-1 (mod size), the fold order StarFoldAllreduce
-// reproduces exactly.  ExecReducescatter passes vrank = rank - 1 so each
-// rank ends owning exactly segment `rank` (its scatter output).
+// the reduce-scatter phase, vrank v owns the fully-reduced segment
+// (v + 1) mod size.  Under the engine-wide convention vrank =
+// position - 1 (see TcpRingSpec), PHYSICAL position s therefore owns
+// segment s — the RS half terminates at each rank's own shard — and
+// segment s is accumulated in ring order s+1, s+2, ..., s+size (mod
+// size), the fold order StarFoldAllreduce reproduces exactly.
 static void EvenSegments(int64_t count, int size,
                          std::vector<int64_t>* seg_count,
                          std::vector<int64_t>* seg_off) {
@@ -3981,12 +4075,16 @@ bool Engine::RingAllgatherPhaseCh(uint8_t* base,
 bool Engine::StreamingRingChannels(uint8_t* base,
                                    const std::vector<ChannelSegs>& channels,
                                    DataType dtype, ReduceOp op,
-                                   const RingSpec& spec, std::string* err) {
+                                   const RingSpec& spec, std::string* err,
+                                   bool rs_only) {
   const size_t esize =
       spec.codec ? spec.codec->block_bytes : DataTypeSize(dtype);
   const int N = spec.rsize;
   const int vrank = spec.vrank;
-  const int nsteps = 2 * (N - 1);
+  // rs_only: the schedule simply stops after the reduce-scatter half —
+  // an identical prefix of the full cascade, so the owned segment's
+  // bits cannot differ from the full allreduce's.
+  const int nsteps = rs_only ? (N - 1) : 2 * (N - 1);
   const int last_rs = N - 2;  // steps [0, last_rs] reduce; rest allgather
   // Step schedule (segment ids, shared by every channel).  RS step s:
   // send (vrank-s), recv (vrank-s-1), reduce.  AG step s' = s-(N-1):
@@ -4283,7 +4381,7 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
                                     const RingSpec& spec,
                                     const ExecCtx& ctx,
                                     const std::string& tname,
-                                    std::string* err) {
+                                    std::string* err, bool rs_only) {
   // Under a wire codec, `count` is the number of quantized BLOCKS and
   // the element size is the block size — segment and channel-shard
   // arithmetic runs unchanged over uniform block elements.
@@ -4322,7 +4420,7 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
     timeline_.ActivityStartCh(tname, spec.span + std::to_string(ch), ch + 1);
     bool ok = RingReduceScatterPhaseCh(base, seg_count, seg_off, dtype, op,
                                        spec, ch, err);
-    if (ok) {
+    if (ok && !rs_only) {
       ok = RingAllgatherPhaseCh(base, seg_count, seg_off, esize, spec, ch,
                                 err);
     }
@@ -4347,7 +4445,8 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
       timeline_.ActivityStartCh(tname, spec.span + std::to_string(cs.ch),
                                 cs.ch + 1);
     }
-    bool ok = StreamingRingChannels(base, part, dtype, op, spec, derr);
+    bool ok = StreamingRingChannels(base, part, dtype, op, spec, derr,
+                                    rs_only);
     for (const auto& cs : part) timeline_.ActivityEndCh(tname, cs.ch + 1);
     return ok;
   };
@@ -4457,9 +4556,10 @@ bool Engine::StarFoldAllreduce(uint8_t* base, int64_t count, DataType dtype,
   }
   // Leader: gather every member's RAW buffer, then reproduce the ring
   // reduce-scatter's fold segment by segment.  Segment s accumulates
-  // contributions in group-position order s, s+1, ..., s+L-1 (mod L) —
-  // the order the ring's step schedule applies them in (see EvenSegments)
-  // — AND with the ring's exact operand roles (dst = the incoming
+  // contributions in group-position order s+1, s+2, ..., s+L (mod L) —
+  // the order the ring's step schedule applies them in under the
+  // vrank = position - 1 convention (see TcpRingSpec/EvenSegments) —
+  // AND with the ring's exact operand roles (dst = the incoming
   // position's raw data, src = the running accumulator), because
   // ReduceInto's min/max tie-breaking and NaN propagation are operand-
   // ORDER-sensitive even where the math is commutative.  Identical
@@ -4489,9 +4589,9 @@ bool Engine::StarFoldAllreduce(uint8_t* base, int64_t count, DataType dtype,
     if (seg_count[s] == 0) continue;
     const size_t sb = static_cast<size_t>(seg_count[s]) * esize;
     const size_t boff = static_cast<size_t>(seg_off[s]) * esize;
-    memcpy(acc.get(), contrib[s].get() + boff, sb);
+    memcpy(acc.get(), contrib[(s + 1) % L].get() + boff, sb);
     for (int k = 1; k < L; ++k) {
-      memcpy(nxt.get(), contrib[(s + k) % L].get() + boff, sb);
+      memcpy(nxt.get(), contrib[(s + 1 + k) % L].get() + boff, sb);
       ReduceIntoTimed(nxt.get(), acc.get(), seg_count[s], dtype, op);
       acc.swap(nxt);
     }
@@ -4510,6 +4610,73 @@ bool Engine::StarFoldAllreduce(uint8_t* base, int64_t count, DataType dtype,
 // HOROVOD_HIERARCHICAL_ALLREDUCE into the native engine.  Deterministic
 // per topology; transport, channel count, and the algo threshold never
 // change bits within one topology.
+bool Engine::TwoLevelIntraReduce(uint8_t* base, int64_t count,
+                                 DataType dtype, ReduceOp op,
+                                 const std::string& name, const ExecCtx& ctx,
+                                 bool compressed_payload, std::string* err) {
+  const size_t esize = DataTypeSize(dtype);
+  const size_t nbytes = static_cast<size_t>(count) * esize;
+  const int L = group_size_;
+  const int p = local_index_;
+  const int to_ms = socket_timeout_sec_ * 1000;
+  const int gather_ms = to_ms > 0 ? to_ms * (L + 2) : 0;
+  std::string detail;
+  if (L <= 1) return true;
+  if (UseSmallAlgo(static_cast<int64_t>(nbytes), ctx)) {
+    // Small path: 2 shm hops of latency instead of 2(L-1) ring steps;
+    // leaves the leader holding the host-reduced buffer.
+    return StarFoldAllreduce(base, count, dtype, op,
+                             /*broadcast_result=*/false, err);
+  }
+  std::vector<int64_t> seg_count, seg_off;
+  EvenSegments(count, L, &seg_count, &seg_off);
+  RingSpec shm = ShmRingSpec();
+  shm.compressed = compressed_payload;
+  timeline_.ActivityStartCh(name, "SHM_CH0", 1);
+  bool ok = RingReduceScatterPhaseCh(base, seg_count, seg_off, dtype,
+                                     op, shm, 0, &detail);
+  timeline_.ActivityEndCh(name, 1);
+  if (!ok) {
+    *err = TransportError("two-level allreduce (intra ring)", name,
+                          detail, group_members_[(p + 1) % L],
+                          group_members_[(p - 1 + L) % L]);
+    return false;
+  }
+  // Gather the host-reduced segments onto the leader: position q owns
+  // segment q after the reduce-scatter (the vrank = position - 1
+  // convention, see EvenSegments), so the leader's buffer becomes the
+  // full host sum (its own segment 0 is already in place).
+  if (p == 0) {
+    for (int q = 1; q < L; ++q) {
+      const int s = q;
+      if (seg_count[s] == 0) continue;
+      const size_t n = static_cast<size_t>(seg_count[s]) * esize;
+      if (!shm_star_[q].rx.ReadAll(base + seg_off[s] * esize, n,
+                                   gather_ms, &detail)) {
+        *err = "rank " + std::to_string(group_members_[q]) +
+               " failed during two-level allreduce of '" + name +
+               "' (segment gather): " + detail;
+        return false;
+      }
+      CountShmBytes(0, static_cast<int64_t>(n));
+    }
+  } else {
+    const int s = p;
+    if (seg_count[s] > 0) {
+      const size_t n = static_cast<size_t>(seg_count[s]) * esize;
+      if (!shm_star_[0].tx.WriteAll(base + seg_off[s] * esize, n,
+                                    gather_ms, &detail)) {
+        *err = "rank " + std::to_string(group_members_[0]) +
+               " failed during two-level allreduce of '" + name +
+               "' (segment gather): " + detail;
+        return false;
+      }
+      CountShmBytes(static_cast<int64_t>(n), 0);
+    }
+  }
+  return true;
+}
+
 bool Engine::TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
                                ReduceOp op, const std::string& name,
                                const ExecCtx& ctx, WireDtype wire,
@@ -4518,63 +4685,11 @@ bool Engine::TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
   const size_t nbytes = static_cast<size_t>(count) * esize;
   const int L = group_size_;
   const int p = local_index_;
-  const int to_ms = socket_timeout_sec_ * 1000;
-  const int gather_ms = to_ms > 0 ? to_ms * (L + 2) : 0;
   std::string detail;
   if (L > 1) {
-    if (UseSmallAlgo(static_cast<int64_t>(nbytes), ctx)) {
-      // Small path: 2 shm hops of latency instead of 2(L-1) ring steps;
-      // leaves the leader holding the host-reduced buffer.
-      if (!StarFoldAllreduce(base, count, dtype, op,
-                             /*broadcast_result=*/false, err)) {
-        return false;
-      }
-    } else {
-      std::vector<int64_t> seg_count, seg_off;
-      EvenSegments(count, L, &seg_count, &seg_off);
-      RingSpec shm = ShmRingSpec();
-      shm.compressed = compressed_payload;
-      timeline_.ActivityStartCh(name, "SHM_CH0", 1);
-      bool ok = RingReduceScatterPhaseCh(base, seg_count, seg_off, dtype,
-                                         op, shm, 0, &detail);
-      timeline_.ActivityEndCh(name, 1);
-      if (!ok) {
-        *err = TransportError("two-level allreduce (intra ring)", name,
-                              detail, group_members_[(p + 1) % L],
-                              group_members_[(p - 1 + L) % L]);
-        return false;
-      }
-      // Gather the host-reduced segments onto the leader: position q owns
-      // segment (q+1) mod L after the reduce-scatter (see EvenSegments),
-      // so the leader's buffer becomes the full host sum.
-      if (p == 0) {
-        for (int q = 1; q < L; ++q) {
-          const int s = (q + 1) % L;
-          if (seg_count[s] == 0) continue;
-          const size_t n = static_cast<size_t>(seg_count[s]) * esize;
-          if (!shm_star_[q].rx.ReadAll(base + seg_off[s] * esize, n,
-                                       gather_ms, &detail)) {
-            *err = "rank " + std::to_string(group_members_[q]) +
-                   " failed during two-level allreduce of '" + name +
-                   "' (segment gather): " + detail;
-            return false;
-          }
-          CountShmBytes(0, static_cast<int64_t>(n));
-        }
-      } else {
-        const int s = (p + 1) % L;
-        if (seg_count[s] > 0) {
-          const size_t n = static_cast<size_t>(seg_count[s]) * esize;
-          if (!shm_star_[0].tx.WriteAll(base + seg_off[s] * esize, n,
-                                        gather_ms, &detail)) {
-            *err = "rank " + std::to_string(group_members_[0]) +
-                   " failed during two-level allreduce of '" + name +
-                   "' (segment gather): " + detail;
-            return false;
-          }
-          CountShmBytes(static_cast<int64_t>(n), 0);
-        }
-      }
+    if (!TwoLevelIntraReduce(base, count, dtype, op, name, ctx,
+                             compressed_payload, err)) {
+      return false;
     }
   }
   if (p == 0 && nnodes_ > 1) {
@@ -4605,6 +4720,136 @@ bool Engine::TwoLevelAllreduce(uint8_t* base, int64_t count, DataType dtype,
     if (!StarBroadcast(base, nbytes, err)) return false;
   }
   return true;
+}
+
+bool Engine::StarScatterShards(uint8_t* base,
+                               const std::vector<int64_t>& shard_count,
+                               const std::vector<int64_t>& shard_off,
+                               size_t esize, std::string* err) {
+  const int to_ms = socket_timeout_sec_ * 1000;
+  const int L = group_size_;
+  if (L <= 1) return true;
+  if (local_index_ == 0) {
+    for (int m = 1; m < L; ++m) {
+      if (shard_count[m] <= 0) continue;
+      const size_t n = static_cast<size_t>(shard_count[m]) * esize;
+      std::string detail;
+      if (!shm_star_[m].tx.WriteAll(base + shard_off[m] * esize, n,
+                                    to_ms > 0 ? to_ms * (L + 2) : 0,
+                                    &detail)) {
+        *err = "rank " + std::to_string(group_members_[m]) +
+               " failed during star shard scatter: send to member: " +
+               detail;
+        return false;
+      }
+      CountShmBytes(static_cast<int64_t>(n), 0);
+    }
+  } else {
+    // The legitimate wait covers the leader's whole cross-host ring,
+    // like StarBroadcast's first chunk.
+    const int wait_ms =
+        to_ms > 0 ? to_ms * (2 * nnodes_ + group_size_ + 2) : 0;
+    if (shard_count[local_index_] > 0) {
+      const size_t n =
+          static_cast<size_t>(shard_count[local_index_]) * esize;
+      std::string detail;
+      if (!shm_star_[0].rx.ReadAll(base + shard_off[local_index_] * esize,
+                                   n, wait_ms, &detail)) {
+        *err = "rank " + std::to_string(group_members_[0]) +
+               " failed during star shard scatter: recv from leader: " +
+               detail;
+        return false;
+      }
+      CountShmBytes(0, static_cast<int64_t>(n));
+    }
+  }
+  return true;
+}
+
+bool Engine::TwoLevelReduceScatter(uint8_t* base, int64_t count,
+                                   DataType dtype, ReduceOp op,
+                                   const std::vector<int64_t>& shard_count,
+                                   const std::vector<int64_t>& shard_off,
+                                   const std::string& name,
+                                   const ExecCtx& ctx,
+                                   bool compressed_payload,
+                                   std::string* err) {
+  // Preconditions (checked by ExecReducescatter): count % size == 0,
+  // node-major contiguous host grouping, equal group sizes — together
+  // they make the committed per-rank shards subdivide the cross ring's
+  // EvenSegments(count, H) exactly, so every hop below slices along the
+  // fold's own geometry and the bits equal the two-level allreduce's.
+  const size_t esize = DataTypeSize(dtype);
+  if (group_size_ > 1) {
+    if (!TwoLevelIntraReduce(base, count, dtype, op, name, ctx,
+                             compressed_payload, err)) {
+      return false;
+    }
+  }
+  if (local_index_ == 0 && nnodes_ > 1) {
+    RingSpec cross = CrossRingSpec();
+    cross.compressed = compressed_payload;
+    // Engine-wide vrank convention: this leader ends the RS half owning
+    // cross segment node_id — its own hosts' shard block.
+    std::string detail;
+    if (!ChanneledRingAllreduce(base, count, dtype, op, cross, ctx, name,
+                                &detail, /*rs_only=*/true)) {
+      *err = TransportError(
+          "two-level reducescatter (cross ring)", name, detail,
+          group_leaders_[(node_id_ + 1) % nnodes_],
+          group_leaders_[(node_id_ - 1 + nnodes_) % nnodes_]);
+      return false;
+    }
+  }
+  if (group_size_ > 1) {
+    // Leader → members: each member gets exactly its own global shard
+    // (indexed by group position).
+    std::vector<int64_t> mcount(group_size_), moff(group_size_);
+    for (int m = 0; m < group_size_; ++m) {
+      const int r = group_members_[m];
+      mcount[m] = shard_count[r];
+      moff[m] = shard_off[r];
+    }
+    if (!StarScatterShards(base, mcount, moff, esize, err)) return false;
+  }
+  return true;
+}
+
+bool Engine::RunAllreduceCascade(uint8_t* exec_buf, int64_t total,
+                                 DataType exec_dtype, ReduceOp op,
+                                 WireDtype wire, bool quantized,
+                                 bool half_wire, bool small,
+                                 const char* op_label,
+                                 const std::string& tname,
+                                 const ExecCtx& ctx, std::string* msg) {
+  if (two_level_) {
+    return TwoLevelAllreduce(exec_buf, total, exec_dtype, op, tname, ctx,
+                             quantized ? wire : WireDtype::FP32,
+                             half_wire, msg);
+  }
+  if (small) {
+    // Whole-world host group: the star fold IS the collective —
+    // 2 shm hops instead of 2(N-1) ring steps, bit-equal by the fold-
+    // order emulation.
+    return StarFoldAllreduce(exec_buf, total, exec_dtype, op,
+                             /*broadcast_result=*/true, msg);
+  }
+  std::string err;
+  RingSpec spec = FlatRingSpec();
+  bool ok;
+  if (quantized) {
+    ok = CompressedRingAllreduce(exec_buf, total, wire, op, spec, ctx,
+                                 tname, &err);
+  } else {
+    spec.compressed = half_wire;
+    ok = ChanneledRingAllreduce(exec_buf, total, exec_dtype, op, spec,
+                                ctx, tname, &err);
+  }
+  if (!ok) {
+    *msg = TransportError(op_label, tname, err, (rank_ + 1) % size_,
+                          (rank_ - 1 + size_) % size_);
+  }
+  return ok;
 }
 
 void Engine::ExecAllreduce(const Response& response,
@@ -4721,39 +4966,12 @@ void Engine::ExecAllreduce(const Response& response,
     // two-level intra phase applies the same size-based selection).
     timeline_.Algo(tname, small ? "ALGO_SMALL" : "ALGO_RING");
     (small ? algo_small_count_ : algo_ring_count_).fetch_add(1);
-    if (two_level_) {
-      timeline_.ActivityStart(tname, "TWO_LEVEL_ALLREDUCE");
-      ok = TwoLevelAllreduce(exec_buf, total, exec_dtype,
-                             response.red_op, tname, ctx,
-                             quantized ? wire : WireDtype::FP32,
-                             half_wire, &msg);
-    } else if (small) {
-      // Whole-world host group: the star fold IS the collective —
-      // 2 shm hops instead of 2(N-1) ring steps, bit-equal by the fold-
-      // order emulation.
-      timeline_.ActivityStart(tname, "STAR_ALLREDUCE");
-      ok = StarFoldAllreduce(exec_buf, total, exec_dtype,
-                             response.red_op, /*broadcast_result=*/true,
-                             &msg);
-    } else {
-      timeline_.ActivityStart(tname, "RING_ALLREDUCE");
-      std::string err;
-      RingSpec spec = FlatRingSpec();
-      if (quantized) {
-        ok = CompressedRingAllreduce(exec_buf, total, wire,
-                                     response.red_op, spec, ctx, tname,
-                                     &err);
-      } else {
-        spec.compressed = half_wire;
-        ok = ChanneledRingAllreduce(exec_buf, total, exec_dtype,
-                                    response.red_op, spec, ctx, tname,
-                                    &err);
-      }
-      if (!ok) {
-        msg = TransportError("allreduce", tname, err, (rank_ + 1) % size_,
-                             (rank_ - 1 + size_) % size_);
-      }
-    }
+    timeline_.ActivityStart(tname, two_level_ ? "TWO_LEVEL_ALLREDUCE"
+                                   : small   ? "STAR_ALLREDUCE"
+                                             : "RING_ALLREDUCE");
+    ok = RunAllreduceCascade(exec_buf, total, exec_dtype,
+                             response.red_op, wire, quantized, half_wire,
+                             small, "allreduce", tname, ctx, &msg);
     if (ok && half_wire) {
       float* fp = static_cast<float*>(buf);
       auto q0 = std::chrono::steady_clock::now();
@@ -4838,7 +5056,11 @@ void Engine::ExecAllgather(const Response& response,
          static_cast<size_t>(block_bytes[rank_]));
 
   if (size_ > 1) {
-    timeline_.ActivityStart(e.name, "RING_ALLGATHER");
+    // The sharded optimizer's parameter/update allgather gets its own
+    // span so ZeRO steps are attributable in traces next to "RS".
+    timeline_.ActivityStart(e.name,
+                            e.name.rfind("sharded.ag.", 0) == 0
+                                ? "AG_PARAMS" : "RING_ALLGATHER");
     // Circulate blocks around the flat ring (shm on a whole-world host
     // group, TCP otherwise); after size-1 steps everyone has all.
     RingSpec spec = FlatRingSpec();
@@ -4928,9 +5150,23 @@ void Engine::ExecBroadcast(const Response& response,
 void Engine::ExecReducescatter(const Response& response,
                                std::vector<TensorTableEntry>& entries,
                                const ExecCtx& ctx) {
-  // Never fused; one entry.  Ring reduce-scatter phase only (the first half
-  // of the ring allreduce), on a scratch copy so the caller's input stays
-  // intact; each rank keeps its own row-aligned segment.
+  // Never fused; one entry.  First-class half of the allreduce cascade:
+  // whenever the COMMITTED shard geometry coincides with the cascade's
+  // own segment geometry (always for 1-D tensors — both use the same
+  // largest-first split — and for multi-dim tensors with dim0 % size ==
+  // 0), the data plane runs exactly the allreduce's reduce-scatter half
+  // and stops: flat ring (TCP or shm, streaming multi-channel), star
+  // fold + shard scatter under the small-tensor algo, or the two-level
+  // hierarchy with a halved cross ring.  The anchor is bit-exactness:
+  // reducescatter(x)[rank] == allreduce(x) sliced to the owned shard,
+  // per dtype/op/transport — the allgather half only ever moves bytes
+  // verbatim, so stopping after the fold cannot change them.  When the
+  // geometry does NOT line up (unaligned multi-dim rows, block-
+  // quantized int8/fp8 wire, or a hierarchy whose host blocks don't
+  // subdivide the cross segments), the exact-parity FALLBACK runs the
+  // full allreduce on a scratch buffer and slices the owned shard —
+  // same bits by construction, no wire savings (counted in
+  // reducescatter_fallback_count).
   TensorTableEntry& e = entries[0];
   timeline_.Start(e.name);
   const size_t esize = DataTypeSize(e.dtype);
@@ -4940,15 +5176,17 @@ void Engine::ExecReducescatter(const Response& response,
   auto hs = GetHandle(e.handle);
   if (hs == nullptr) return;
 
-  std::vector<int64_t> seg_count(size_), seg_off(size_);
+  // Committed per-rank shard geometry (absolute element offsets).
+  std::vector<int64_t> shard_count(size_), shard_off(size_);
   int64_t off = 0;
   for (int r = 0; r < size_; ++r) {
-    seg_count[r] = response.tensor_sizes[r] * row_elems;
-    seg_off[r] = off;
-    off += seg_count[r];
+    shard_count[r] = response.tensor_sizes[r] * row_elems;
+    shard_off[r] = off;
+    off += shard_count[r];
   }
+  const int64_t total = off;
 
-  int64_t my_rows = response.tensor_sizes[rank_];
+  const int64_t my_rows = response.tensor_sizes[rank_];
   hs->result_shape.clear();
   hs->result_shape.push_back(my_rows);
   for (int d = 1; d < e.shape.ndim(); ++d) {
@@ -4956,36 +5194,191 @@ void Engine::ExecReducescatter(const Response& response,
   }
 
   const uint8_t* input = static_cast<const uint8_t*>(e.data);
-  if (size_ == 1) {
-    hs->result.assign(input, input + static_cast<size_t>(seg_count[0]) * esize);
+  if (size_ == 1 || total == 0) {
+    hs->result.assign(
+        input, input + static_cast<size_t>(shard_count[rank_]) * esize);
     timeline_.End(e.name, e.dtype, e.shape.DebugString());
     FinishEntry(e, Status::OK());
     return;
   }
 
-  timeline_.ActivityStart(e.name, "RING_REDUCESCATTER");
-  std::vector<uint8_t> scratch(
-      input, input + static_cast<size_t>(off) * esize);
-  // vrank = rank-1 so the phase leaves THIS rank owning segment `rank`
-  // (see EvenSegments); single-channel on the ctx's channel —
-  // reducescatter payloads are small on this host plane, and the chunked
-  // phase already overlaps its recv and reduce.
-  std::string err;
-  RingSpec spec = FlatRingSpec();
-  spec.vrank = (spec.vrank - 1 + spec.rsize) % spec.rsize;
-  bool ok = RingReduceScatterPhaseCh(
-      scratch.data(), seg_count, seg_off, e.dtype, response.red_op,
-      spec, ctx.channel, &err);
+  // Committed wire format (negotiated + validated like the allreduce's;
+  // fp32 payloads only).
+  const WireDtype wire = e.dtype == DataType::FLOAT32
+                             ? response.wire_dtype : WireDtype::FP32;
+  const bool quantized = wire == WireDtype::INT8 || wire == WireDtype::FP8;
+  const bool half_wire = wire == WireDtype::FP16 || wire == WireDtype::BF16;
+
+  // Alignment: the cascade's EvenSegments vs the committed shards.
+  std::vector<int64_t> seg_count, seg_off;
+  EvenSegments(total, size_, &seg_count, &seg_off);
+  bool aligned = true;
+  for (int r = 0; r < size_; ++r) {
+    aligned = aligned && seg_count[r] == shard_count[r];
+  }
+
+  // Stage the payload: a scratch copy (the caller's input must survive —
+  // reducescatter is out-of-place), or for the half wires an RNE-
+  // converted half buffer, exactly like ExecAllreduce's staging.
+  std::vector<uint8_t> scratch;
+  std::vector<uint16_t> halfbuf;
+  uint8_t* exec_buf;
+  DataType exec_dtype = e.dtype;
+  if (half_wire) {
+    halfbuf.resize(static_cast<size_t>(total));
+    const float* fp = reinterpret_cast<const float*>(input);
+    auto q0 = std::chrono::steady_clock::now();
+    if (wire == WireDtype::FP16) {
+      for (int64_t i = 0; i < total; ++i) halfbuf[i] = FloatToHalf(fp[i]);
+    } else {
+      for (int64_t i = 0; i < total; ++i) halfbuf[i] = FloatToBF16(fp[i]);
+    }
+    quantize_ns_.fetch_add(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - q0)
+            .count());
+    wire_bytes_saved_.fetch_add(total * 2);
+    exec_buf = reinterpret_cast<uint8_t*>(halfbuf.data());
+    exec_dtype = wire == WireDtype::FP16 ? DataType::FLOAT16
+                                         : DataType::BFLOAT16;
+  } else {
+    scratch.assign(input, input + static_cast<size_t>(total) * esize);
+    exec_buf = scratch.data();
+  }
+  const size_t exec_esize = DataTypeSize(exec_dtype);
+  const int64_t exec_bytes = total * static_cast<int64_t>(exec_esize);
+  switch (wire) {
+    case WireDtype::FP16: wire_fp16_count_.fetch_add(1); break;
+    case WireDtype::BF16: wire_bf16_count_.fetch_add(1); break;
+    case WireDtype::INT8: wire_int8_count_.fetch_add(1); break;
+    case WireDtype::FP8: wire_fp8_count_.fetch_add(1); break;
+    case WireDtype::FP32: break;
+  }
+  if (wire != WireDtype::FP32) {
+    char wm[16];
+    std::snprintf(wm, sizeof(wm), "WIRE_%s", WireDtypeName(wire));
+    for (char* c = wm; *c; ++c) *c = static_cast<char>(toupper(*c));
+    timeline_.Algo(e.name, wm);
+  }
+
+  // Two-level eligibility: host blocks (node-major contiguous grouping)
+  // must equal the cross ring's EvenSegments so the leaders' RS half
+  // delivers exactly their members' shards.
+  bool two_level_ok = false;
+  if (two_level_ && aligned && !quantized) {
+    bool contiguous = true;
+    for (int r = 1; r < size_; ++r) {
+      contiguous = contiguous && rank_host_[r] >= rank_host_[r - 1];
+    }
+    if (contiguous) {
+      std::vector<int64_t> host_block(nnodes_, 0);
+      for (int r = 0; r < size_; ++r) {
+        host_block[rank_host_[r]] += shard_count[r];
+      }
+      std::vector<int64_t> cseg_count, cseg_off;
+      EvenSegments(total, nnodes_, &cseg_count, &cseg_off);
+      two_level_ok = true;
+      for (int h = 0; h < nnodes_; ++h) {
+        two_level_ok = two_level_ok && host_block[h] == cseg_count[h];
+      }
+    }
+  }
+  const bool small =
+      !two_level_ && UseSmallAlgo(exec_bytes, ctx) && !quantized;
+  const bool half_path =
+      (two_level_ ? two_level_ok : (aligned || small)) && !quantized;
+
+  bool ok;
+  std::string msg;
+  auto t0 = std::chrono::steady_clock::now();
+  timeline_.ActivityStart(e.name, "RS");
+  if (!half_path) {
+    // Exact-parity fallback: the full allreduce cascade on the staged
+    // buffer — the SAME RunAllreduceCascade selection ExecAllreduce
+    // runs, so the bitwise anchor can never drift — then slice the
+    // owned shard locally.
+    reducescatter_fallback_count_.fetch_add(1);
+    timeline_.Algo(e.name, "RS_FALLBACK");
+    ok = RunAllreduceCascade(exec_buf, total, exec_dtype,
+                             response.red_op, wire, quantized, half_wire,
+                             UseSmallAlgo(exec_bytes, ctx) && !quantized,
+                             "reducescatter", e.name, ctx, &msg);
+  } else if (two_level_) {
+    timeline_.Algo(e.name, "RS_TWO_LEVEL");
+    ok = TwoLevelReduceScatter(exec_buf, total, exec_dtype,
+                               response.red_op, shard_count, shard_off,
+                               e.name, ctx, half_wire, &msg);
+  } else if (small) {
+    // Star fold + shard scatter: the leader reproduces the ring's exact
+    // fold (bit-equal for ANY shard geometry), members get their slices.
+    timeline_.Algo(e.name, "RS_STAR");
+    ok = StarFoldAllreduce(exec_buf, total, exec_dtype, response.red_op,
+                           /*broadcast_result=*/false, &msg);
+    if (ok) {
+      // Shards by GROUP position (the whole-world host group's order,
+      // identity on a single host but mapped for safety).
+      std::vector<int64_t> mcount(group_size_), moff(group_size_);
+      for (int m = 0; m < group_size_; ++m) {
+        const int r = group_members_[m];
+        mcount[m] = shard_count[r];
+        moff[m] = shard_off[r];
+      }
+      ok = StarScatterShards(exec_buf, mcount, moff, exec_esize, &msg);
+    }
+  } else {
+    // Flat ring RS half: under the engine-wide vrank convention this
+    // rank ends owning segment `rank` — its committed shard, because
+    // aligned geometry made the two splits identical — and the fold
+    // order per segment is EXACTLY the allreduce's.
+    timeline_.Algo(e.name, "RS_HALF");
+    std::string err;
+    RingSpec spec = FlatRingSpec();
+    spec.compressed = half_wire;
+    ok = ChanneledRingAllreduce(exec_buf, total, exec_dtype,
+                                response.red_op, spec, ctx, e.name, &err,
+                                /*rs_only=*/true);
+    if (!ok) {
+      msg = TransportError("reducescatter", e.name, err,
+                           (rank_ + 1) % size_,
+                           (rank_ - 1 + size_) % size_);
+    }
+  }
   timeline_.ActivityEnd(e.name);
+  reducescatter_ns_.fetch_add(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now() - t0)
+          .count());
+  reducescatter_bytes_.fetch_add(total * static_cast<int64_t>(esize));
   if (!ok) {
-    FinishEntry(e, Status::Aborted(TransportError(
-        "reducescatter", e.name, err, (rank_ + 1) % size_,
-        (rank_ - 1 + size_) % size_)));
+    FinishEntry(e, Status::Aborted(msg));
     return;
   }
-  hs->result.assign(
-      scratch.data() + seg_off[rank_] * esize,
-      scratch.data() + (seg_off[rank_] + seg_count[rank_]) * esize);
+
+  // Extract the owned shard (converting back from the half staging
+  // buffer when the wire was fp16/bf16 — shard only: the rest of the
+  // buffer is not this rank's to report).
+  hs->result.resize(static_cast<size_t>(shard_count[rank_]) * esize);
+  if (half_wire) {
+    float* out = reinterpret_cast<float*>(hs->result.data());
+    const uint16_t* hb = halfbuf.data() + shard_off[rank_];
+    auto q0 = std::chrono::steady_clock::now();
+    if (wire == WireDtype::FP16) {
+      for (int64_t i = 0; i < shard_count[rank_]; ++i) {
+        out[i] = HalfToFloat(hb[i]);
+      }
+    } else {
+      for (int64_t i = 0; i < shard_count[rank_]; ++i) {
+        out[i] = BF16ToFloat(hb[i]);
+      }
+    }
+    quantize_ns_.fetch_add(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - q0)
+            .count());
+  } else {
+    memcpy(hs->result.data(), exec_buf + shard_off[rank_] * esize,
+           static_cast<size_t>(shard_count[rank_]) * esize);
+  }
   timeline_.End(e.name, e.dtype, e.shape.DebugString());
   FinishEntry(e, Status::OK());
 }
@@ -5259,9 +5652,14 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   }
   // Resolve the wire format at enqueue time: per-tensor override wins,
   // else the live global knob; compression only ever applies to FLOAT32
-  // allreduce payloads (probes included — they are dense allreduces).
+  // allreduce/reducescatter payloads (probes included — they are dense
+  // allreduces).  Reducescatter rides the same codec seam: fp16/bf16
+  // run the half-staged RS half, int8/fp8 take the exact-parity
+  // fallback (full quantized ring + local slice).
   WireDtype wire = WireDtype::FP32;
-  if (type == RequestType::ALLREDUCE && dtype == DataType::FLOAT32) {
+  if ((type == RequestType::ALLREDUCE ||
+       type == RequestType::REDUCESCATTER) &&
+      dtype == DataType::FLOAT32) {
     int wv = wire_dtype >= 0 ? wire_dtype : wire_dtype_.load();
     if (wv >= 1 && wv <= 4) wire = static_cast<WireDtype>(wv);
   }

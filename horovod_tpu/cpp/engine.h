@@ -237,6 +237,22 @@ class Engine {
   int64_t wire_ns() const { return wire_ns_.load(); }
   int64_t allreduce_bytes() const { return allreduce_bytes_.load(); }
   int64_t allreduce_ns() const { return allreduce_ns_.load(); }
+  // Reduce-scatter observability: payload bytes and wall time of
+  // REDUCESCATTER responses (the bus-bandwidth convention for RS is
+  // (N-1)/N · bytes / wall — half the allreduce numerator, matching its
+  // wire pattern), plus how many responses had to take the exact-parity
+  // FALLBACK (full allreduce + local slice: unaligned multi-dim shard
+  // geometry or a block-quantized wire) instead of the half-cascade.
+  int64_t reducescatter_bytes() const { return reducescatter_bytes_.load(); }
+  int64_t reducescatter_ns() const { return reducescatter_ns_.load(); }
+  int64_t reducescatter_fallback_count() const {
+    return reducescatter_fallback_count_.load();
+  }
+  // Sharded-optimizer steps (ZeRO-1: reducescatter(grads) → shard-local
+  // update → allgather) completed by the Python frontends on this
+  // process — noted like local_sgd_syncs, cumulative.
+  int64_t sharded_steps() const { return sharded_steps_.load(); }
+  void NoteShardedStep() { sharded_steps_.fetch_add(1); }
   int num_channels() const { return num_channels_; }
 
   // Shared-memory / hierarchy observability.  `shm_bytes_tx/rx` sum
@@ -288,6 +304,19 @@ class Engine {
   // judges: one slow rank inflates every participant's p99 at k=0, and
   // backup-worker commits pull it back down.
   int backup_workers() const { return backup_workers_; }
+  // HOROVOD_BACKUP_WORKERS=auto: the coordinator arms k=1 only while
+  // the step-time window ratio p99/p50 exceeds
+  // HOROVOD_BACKUP_AUTO_RATIO (default 3.0) — a cheap straggler
+  // detector on the percentile instrument the straggler gate already
+  // trusts.  `backup_auto` reports the mode, `backup_armed` whether the
+  // rule currently arms partial commits (coordinator-evaluated; workers
+  // report 0 — commits reach them in responses), and the ratio is
+  // exported in milli-units so the C ABI stays int64-only.
+  bool backup_auto() const { return backup_auto_; }
+  int64_t backup_auto_ratio_milli() const {
+    return static_cast<int64_t>(backup_auto_ratio_ * 1000.0 + 0.5);
+  }
+  bool backup_armed() const { return backup_armed_.load(); }
   int64_t backup_skips() const { return backup_skips_.load(); }
   int64_t local_sgd_syncs() const { return local_sgd_syncs_.load(); }
   void NoteLocalSgdSync() { local_sgd_syncs_.fetch_add(1); }
@@ -524,6 +553,20 @@ class Engine {
   void ExecAllreduce(const Response& response,
                      std::vector<TensorTableEntry>& entries,
                      const ExecCtx& ctx);
+  // The allreduce cascade's path selection over a staged buffer
+  // (two-level -> star fold -> quantized/channeled flat ring), shared
+  // VERBATIM by ExecAllreduce and ExecReducescatter's exact-parity
+  // fallback — one selection, so the fallback's bitwise anchor
+  // (reducescatter == allreduce sliced) can never drift from the real
+  // allreduce's path choice.  `small` is the caller-evaluated
+  // UseSmallAlgo verdict (it depends on the staged byte count);
+  // `op_label` names the collective in transport errors.
+  bool RunAllreduceCascade(uint8_t* exec_buf, int64_t total,
+                           DataType exec_dtype, ReduceOp op,
+                           WireDtype wire, bool quantized, bool half_wire,
+                           bool small, const char* op_label,
+                           const std::string& tname, const ExecCtx& ctx,
+                           std::string* msg);
   void ExecAllgather(const Response& response,
                      std::vector<TensorTableEntry>& entries,
                      const ExecCtx& ctx);
@@ -543,10 +586,15 @@ class Engine {
   // rank order its reduction applies in — is independent of the channel
   // count AND the transport: results are bit-identical for any fan-out,
   // 1..N, shm or TCP.
+  // `rs_only` stops the cascade after the reduce-scatter half: with the
+  // caller's spec.vrank pre-rotated by -1, this rank ends owning ring
+  // segment `vrank+1` fully reduced — bits identical to the full
+  // allreduce's value of that segment (the allgather half moves bytes
+  // verbatim, it never changes them).
   bool ChanneledRingAllreduce(uint8_t* base, int64_t count, DataType dtype,
                               ReduceOp op, const RingSpec& spec,
                               const ExecCtx& ctx, const std::string& tname,
-                              std::string* err);
+                              std::string* err, bool rs_only = false);
   // One channel's chunk-pipelined ring phases over explicit per-segment
   // counts/offsets (absolute element offsets into `base`).
   bool RingReduceScatterPhaseCh(uint8_t* base,
@@ -580,7 +628,18 @@ class Engine {
   bool StreamingRingChannels(uint8_t* base,
                              const std::vector<ChannelSegs>& channels,
                              DataType dtype, ReduceOp op,
-                             const RingSpec& spec, std::string* err);
+                             const RingSpec& spec, std::string* err,
+                             bool rs_only = false);
+  // Star-shaped shard delivery down the shm star: the leader (group
+  // position 0), holding the fully reduced buffer, sends each member
+  // exactly its owned slice [shard_off[m], shard_off[m]+shard_count[m])
+  // (absolute element offsets into `base`, indexed by GROUP position) —
+  // the scatter twin of StarBroadcast, and lossless by construction, so
+  // slicing preserves the fold's bits for ANY shard geometry.
+  bool StarScatterShards(uint8_t* base,
+                         const std::vector<int64_t>& shard_count,
+                         const std::vector<int64_t>& shard_off,
+                         size_t esize, std::string* err);
   // Compressed-wire allreduce over `spec`: quantize the fp32 payload
   // into the wire representation (fp16/bf16 halves, or int8/fp8 scaled
   // blocks), run the SAME channel-sharded streaming ring over the wire
@@ -741,6 +800,12 @@ class Engine {
   struct PendingInfo {
     std::vector<Request> requests;        // one per reporting rank
     std::vector<bool> seen;               // which ranks reported
+    // Per-rank arrival times: partial-commit grace is measured from
+    // QUORUM formation (the (nvoters-k)-th voter's arrival), not from
+    // the first request — an early-bird rank (e.g. a one-shot
+    // straggler catching up ahead of peers sleeping out its skip) must
+    // not burn the grace budget for everyone else.
+    std::vector<std::chrono::steady_clock::time_point> seen_time;
     int count = 0;
     std::chrono::steady_clock::time_point first_seen;
   };
@@ -797,6 +862,9 @@ class Engine {
   // smallest-first so ids stay < capacity and hit bitvectors stay tiny.
   struct SlotPending {
     std::vector<bool> seen;
+    // Per-voter arrival times (see PendingInfo::seen_time: quorum-based
+    // partial-commit grace).
+    std::vector<std::chrono::steady_clock::time_point> seen_time;
     int count = 0;
     std::chrono::steady_clock::time_point first_seen;
   };
@@ -817,6 +885,14 @@ class Engine {
   // ranks must never be mistaken for straggling — only a rank late by
   // more than the grace gets skipped.
   int backup_grace_ms_ = 50;
+  // HOROVOD_BACKUP_WORKERS=auto: k stays 0 until the coordinator's own
+  // step-time window turns pathological (p99 > ratio · p50 with enough
+  // samples), then partial commits arm at k=1 for as long as the ratio
+  // stays above threshold.  Coordinator-local: workers never need k —
+  // every commit decision reaches them inside a response.
+  bool backup_auto_ = false;
+  double backup_auto_ratio_ = 3.0;
+  std::atomic<bool> backup_armed_{false};
   // name → outstanding skip tokens (background-thread-only, like
   // message_table_): a partial commit that excluded this rank BEFORE it
   // enqueued the tensor banks a token here; the future enqueue consumes
@@ -970,6 +1046,31 @@ class Engine {
                          ReduceOp op, const std::string& name,
                          const ExecCtx& ctx, WireDtype wire,
                          bool compressed_payload, std::string* err);
+  // Two-level REDUCE-SCATTER (the RS half of the hierarchy, used only
+  // when the committed shard geometry is host-block-aligned — see
+  // ExecReducescatter): the intra-host phase runs VERBATIM from
+  // TwoLevelAllreduce (same fold, same bits, leader ends holding the
+  // full host sum), the leader cross-host ring stops after its
+  // reduce-scatter half (leader h ends owning exactly its members'
+  // shard block), and the members get their own shards via
+  // StarScatterShards instead of the full star broadcast — cross wire
+  // and down-link both halve.  shard_count/off are absolute element
+  // offsets of the committed per-RANK shards (world-indexed).
+  bool TwoLevelReduceScatter(uint8_t* base, int64_t count, DataType dtype,
+                             ReduceOp op,
+                             const std::vector<int64_t>& shard_count,
+                             const std::vector<int64_t>& shard_off,
+                             const std::string& name, const ExecCtx& ctx,
+                             bool compressed_payload, std::string* err);
+  // Shared intra-host phase of the two-level collectives: host-group
+  // reduce (star fold under the small algo, else shm ring RS + segment
+  // gather) leaving the LEADER holding the full host sum.  Members'
+  // buffers are partially clobbered — the caller owes them a broadcast
+  // (allreduce) or their shard (reduce-scatter).
+  bool TwoLevelIntraReduce(uint8_t* base, int64_t count, DataType dtype,
+                           ReduceOp op, const std::string& name,
+                           const ExecCtx& ctx, bool compressed_payload,
+                           std::string* err);
   // Star (gather→fold→broadcast) allreduce within the host group: every
   // member ships its buffer to the leader over shm, the leader reproduces
   // the ring reduce-scatter's per-segment fold ORDER exactly (same
@@ -1091,6 +1192,10 @@ class Engine {
   std::atomic<int64_t> wire_ns_{0};
   std::atomic<int64_t> allreduce_bytes_{0};
   std::atomic<int64_t> allreduce_ns_{0};
+  std::atomic<int64_t> reducescatter_bytes_{0};
+  std::atomic<int64_t> reducescatter_ns_{0};
+  std::atomic<int64_t> reducescatter_fallback_count_{0};
+  std::atomic<int64_t> sharded_steps_{0};
   std::atomic<int64_t> shm_bytes_tx_{0};
   std::atomic<int64_t> shm_bytes_rx_{0};
   std::atomic<int64_t> intra_host_bytes_{0};

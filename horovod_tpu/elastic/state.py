@@ -224,20 +224,41 @@ class LocalSGD:
     because reconstruction is anchor-free, the rank lands exactly on
     the participants' consensus at its NEXT successful sync: the drift
     really is bounded by one outer round, never a frozen offset.
+
+    ``compression=Compression.topk(ratio)`` routes the outer sync
+    through the TOP-K SPARSE path instead of the dense allreduce: the
+    policy then keeps the anchor VALUES (a host model copy) and ships
+    each float leaf's DELTA ``P_r - anchor`` as its k largest-magnitude
+    entries, with ITS OWN epoch-stamped error-feedback residuals (keyed
+    ``local_sgd.delta.*`` in runtime.sparse — unsent delta mass carries
+    into the next outer round, never lost, and an elastic resize resets
+    it with the epoch stamp).  Wire bytes drop by ~H/ratio vs per-step
+    dense sync combined.  Reconstruction is anchor-BASED in this mode
+    (``anchor + avg(topk(delta))``); non-float leaves stay local.
     """
 
-    def __init__(self, local_sgd_steps: int | None = None):
+    def __init__(self, local_sgd_steps: int | None = None,
+                 compression=None):
         self.steps = int(local_sgd_steps) if local_sgd_steps is not None \
             else default_local_sgd_steps()
         if self.steps < 1:
             self.steps = 1
         self._local_steps = 0
-        # The anchor is a cadence/epoch MARKER, not a model copy:
+        # Duck-typed (both the jax and torch frontends name their spec
+        # class TopKCompressor; importing either would drag a framework
+        # into this deliberately framework-free module).
+        self._topk = compression if (
+            type(compression).__name__ == "TopKCompressor"
+            and hasattr(compression, "ratio")) else None
+        # The anchor is a cadence/epoch MARKER, not a model copy —
         # reconstruction is anchor-free (each sync averages the ranks'
         # models), so storing the values would pin a full duplicate of
-        # the model per training run for nothing.
+        # the model per training run for nothing.  EXCEPT under top-k:
+        # the sparse path ships deltas, so the anchor values are
+        # load-bearing there (one host copy, the DiLoCo trade).
         self._anchored = False
         self._anchor_epoch: int | None = None
+        self._anchor_values = None
         #: Completed outer syncs (process-local mirror of the engine's
         #: cumulative ``local_sgd_syncs`` counter).
         self.sync_count = 0
@@ -252,17 +273,27 @@ class LocalSGD:
 
     def begin(self, params=None) -> None:
         """Anchor the outer (synchronized) model — call once before the
-        first local step (``params`` is accepted for call-site clarity
-        but not stored: reconstruction is anchor-free)."""
-        self._anchored = True
+        first local step.  In dense mode ``params`` is accepted for
+        call-site clarity but not stored (reconstruction is
+        anchor-free); in top-k mode the anchor VALUES are kept (the
+        sparse path ships deltas), and a value-less ``begin()`` defers
+        anchoring to the first ``maybe_sync`` that sees the params."""
         self._anchor_epoch = self._epoch()
         self._local_steps = 0
+        if self._topk is not None:
+            if params is None:
+                self._anchored = False
+                self._anchor_values = None
+                return
+            self._anchor_values = _host_copy(params)
+        self._anchored = True
 
     def reset(self) -> None:
         """Drop the anchor (a fresh training run in the same process);
         the next ``maybe_sync`` re-anchors without syncing."""
         self._anchored = False
         self._anchor_epoch = None
+        self._anchor_values = None
         self._local_steps = 0
 
     def maybe_sync(self, params):
@@ -283,6 +314,9 @@ class LocalSGD:
         self._local_steps += 1
         if self._local_steps < self.steps:
             return params
+
+        if self._topk is not None:
+            return self._sync_topk(params)
 
         from horovod_tpu.common.basics import basics
 
@@ -353,6 +387,48 @@ class LocalSGD:
             return new
 
         synced = _walk(params, "p", adopt)
+        self.begin(synced)
+        self.sync_count += 1
+        note_local_sgd_sync()
+        return synced
+
+    def _sync_topk(self, params):
+        """Outer sync over the top-k sparse path: per float leaf, ship
+        top-k of the delta ``P_r - anchor`` (error-feedback residuals
+        keyed ``local_sgd.delta.<path>``, epoch-stamped by
+        runtime.sparse) and reconstruct ``anchor + avg_delta``.
+        Sequential per leaf (two allgathers each) — top-k is the opt-in
+        bandwidth-starved regime where that trade is the point."""
+        from horovod_tpu.runtime.engine import note_local_sgd_sync
+        from horovod_tpu.runtime.sparse import sparse_allreduce_topk
+
+        anchors: dict = {}
+
+        def grab(path, leaf):
+            anchors[path] = np.asarray(leaf)
+            return leaf
+
+        _walk(self._anchor_values, "p", grab)
+
+        def sync_leaf(path, leaf):
+            arr = np.asarray(leaf)
+            anchor = anchors.get(path)
+            if (not np.issubdtype(arr.dtype, np.floating)
+                    or anchor is None or anchor.shape != arr.shape):
+                # Non-float slots (and structure drift, which the next
+                # re-anchor repairs) stay local: a sparse delta of a
+                # step counter is meaningless.
+                return leaf
+            delta = arr.astype(np.float32) - anchor.astype(np.float32)
+            avg = sparse_allreduce_topk(
+                delta, name=f"local_sgd.delta.{path}",
+                ratio=self._topk.ratio,
+                error_feedback=getattr(self._topk, "error_feedback",
+                                       True),
+                average=True)
+            return (anchor.astype(np.float32) + avg).astype(arr.dtype)
+
+        synced = _walk(params, "p", sync_leaf)
         self.begin(synced)
         self.sync_count += 1
         note_local_sgd_sync()

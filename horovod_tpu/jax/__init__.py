@@ -286,14 +286,30 @@ class DistributedOptimizer:
     synchronous path (the policy is not even constructed).  The outer
     delta sync is epoch-stamped: an elastic resize re-anchors instead of
     leaking a dead incarnation's delta, and it composes unchanged with
-    wire compression and backup-worker partial commits.
+    wire compression and backup-worker partial commits.  With a
+    ``Compression.topk(ratio)`` compression, the outer sync itself ships
+    the model DELTA through the top-k sparse path with its own
+    epoch-stamped error-feedback residuals (docs/elastic.md).
+
+    ``sharded=True`` (default: ``HOROVOD_SHARDED``) turns the host-driven
+    path into a ZeRO-1 sharded optimizer: gradients are flattened into
+    ONE fp32 vector, reduced by ``reducescatter`` (half an allreduce's
+    wire bytes), the inner optax transformation keeps state ONLY for this
+    rank's shard (~1/N of the optimizer memory), and the shard's updates
+    ride back on ``allgather``.  Elementwise inner optimizers (sgd,
+    momentum, adam, adamw) make the step BIT-IDENTICAL to the equivalent
+    unsharded flat step — asserted per dtype in tests.  Host path only
+    (inside jit use the fsdp mesh axis instead); fp32 params only; see
+    docs/zero.md for the memory math and resize semantics.
     """
 
     def __init__(self, optimizer, *, axis_name=None, op=Average,
                  compression=Compression.none, fusion_threshold_bytes=None,
-                 reduce_gradients=True, name=None, local_sgd_steps=None):
+                 reduce_gradients=True, name=None, local_sgd_steps=None,
+                 sharded=None):
         from horovod_tpu.elastic.state import (LocalSGD,
                                                default_local_sgd_steps)
+        from horovod_tpu.runtime.sharded import sharded_default
 
         self._inner = optimizer
         self._axis_name = axis_name
@@ -305,9 +321,29 @@ class DistributedOptimizer:
         self._local_sgd_steps = (default_local_sgd_steps()
                                  if local_sgd_steps is None
                                  else max(1, int(local_sgd_steps)))
+        self._sharded = (sharded_default() if sharded is None
+                         else bool(sharded))
+        if self._sharded and self._local_sgd_steps > 1:
+            raise ValueError(
+                "sharded=True and local_sgd_steps>1 are mutually "
+                "exclusive: local SGD skips the per-step reduction the "
+                "sharded step is built around")
+        if self._sharded and not reduce_gradients:
+            raise ValueError(
+                "sharded=True requires reduce_gradients=True: the ZeRO "
+                "step IS the reduction (reducescatter -> shard update "
+                "-> allgather); without it the shard-sized state cannot "
+                "apply and ranks would silently diverge")
+        if self._sharded and op not in (Average, Sum):
+            raise ValueError(
+                "sharded=True reduces gradients with SUM/AVERAGE only")
+        #: Lazy ZeRO state (built on first init() from the param tree).
+        self._sharder = None
+        self._tree_shapes = None
         #: The periodic-sync policy (None when H <= 1 — fully
         #: synchronous, the pre-local-SGD contract, byte-identical).
-        self.local_sgd = (LocalSGD(self._local_sgd_steps)
+        self.local_sgd = (LocalSGD(self._local_sgd_steps,
+                                   compression=compression)
                           if self._local_sgd_steps > 1 else None)
 
     @property
@@ -324,16 +360,24 @@ class DistributedOptimizer:
             fusion_threshold_bytes=self._fusion_threshold,
             reduce_gradients=self._reduce, name=self.name,
             local_sgd_steps=self._local_sgd_steps,
+            sharded=self._sharded,
         )
-        # Share the policy instance: the anchor/counter live with the
-        # training run, not with any one bound copy.
+        # Share the policy/sharder instances: anchors and counters live
+        # with the training run, not with any one bound copy.
         copy.local_sgd = self.local_sgd
+        copy._sharder = self._sharder
+        copy._tree_shapes = self._tree_shapes
         return copy
 
     def init(self, params):
-        return self._inner.init(params)
+        if not self._sharded:
+            return self._inner.init(params)
+        return self._sharded_init(params)
 
     def update(self, grads, state, params=None, **extra):
+        # ZeRO path: RS(flat grads) → shard-local inner update → AG.
+        if self._sharded and self._reduce:
+            return self._sharded_update(grads, state, params, **extra)
         # Local-SGD phase: gradients apply purely locally; the policy's
         # maybe_sync (called by the training loop on the params) is the
         # only wire traffic — H× fewer syncs by construction.
@@ -346,6 +390,82 @@ class DistributedOptimizer:
                 fusion_threshold_bytes=self._fusion_threshold,
             )
         return self._inner.update(grads, state, params, **extra)
+
+    # -- ZeRO-1 sharded path (host-driven; see docs/zero.md) --
+
+    def _sharded_init(self, params):
+        import numpy as np
+        import jax.numpy as jnp
+        from horovod_tpu.ops.compression import TopKCompressor
+        from horovod_tpu.runtime.sharded import FlatSharder
+
+        if isinstance(self._compression, TopKCompressor):
+            raise ValueError(
+                "sharded=True reduces gradients with reducescatter; the "
+                "top-k sparse path has no scatter half — use a wire "
+                "compressor (Compression.wire_bf16 etc.) instead")
+        leaves = jax.tree.leaves(params)
+        for leaf in leaves:
+            if jnp.asarray(leaf).dtype != jnp.float32:
+                raise TypeError(
+                    "sharded=True requires float32 params (the fp32-"
+                    "master-weight mixed-precision variant lives in the "
+                    "torch sharded optimizer; see docs/zero.md) — got "
+                    f"{jnp.asarray(leaf).dtype}")
+        shapes = [tuple(np.shape(leaf)) for leaf in leaves]
+        n = int(sum(int(np.prod(s)) if s else 1 for s in shapes))
+        self._tree_shapes = shapes
+        self._sharder = FlatSharder(n, np.float32, name=self.name)
+        shard = FlatSharder.slice_flat(
+            [np.asarray(leaf) for leaf in leaves],
+            self._sharder.offset, self._sharder.count, np.float32)
+        # The inner transformation sees ONLY the owned shard: its state
+        # (momenta etc.) is ~1/N of the unsharded footprint, which is
+        # the whole point.
+        return self._inner.init(jnp.asarray(shard))
+
+    def _sharded_update(self, grads, state, params=None, **extra):
+        import numpy as np
+        import jax.numpy as jnp
+        from horovod_tpu.runtime.sharded import FlatSharder
+
+        leaves, treedef = jax.tree.flatten(grads)
+        if leaves and _is_traced(leaves[0]):
+            raise RuntimeError(
+                "sharded=True is the host-driven (eager/DCN) path; "
+                "inside jit shard optimizer state with the mesh's "
+                "'fsdp' axis instead (parallel/mesh.py)")
+        if self._sharder is None:
+            raise RuntimeError(
+                "sharded DistributedOptimizer.update() before init(): "
+                "the shard layout is anchored at init(params)")
+        flat_g = FlatSharder.flatten(
+            [np.asarray(leaf) for leaf in leaves], np.float32)
+        sh = self._sharder
+        # Params: slice ONLY the owned window out of the virtual concat
+        # (a full flat copy of the model every step would reintroduce
+        # the O(N) host buffer sharding exists to avoid).
+        p_shard = None
+        if params is not None:
+            p_shard = FlatSharder.slice_flat(
+                [np.asarray(leaf) for leaf in jax.tree.leaves(params)],
+                sh.offset, sh.count, np.float32)
+        box = {}
+
+        def local_update(shard_g):
+            sp = jnp.asarray(p_shard) if p_shard is not None else None
+            upd, box["state"] = self._inner.update(
+                jnp.asarray(shard_g), state, sp, **extra)
+            return np.asarray(upd, dtype=np.float32)
+
+        wire = getattr(self._compression, "engine_wire_dtype", None)
+        wire = wire if wire in ("fp16", "bf16", "int8", "fp8") else None
+        full = sh.step(flat_g, local_update,
+                       average=(self._op is Average), wire_dtype=wire)
+        outs = FlatSharder.unflatten(full, self._tree_shapes)
+        updates = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(o) for o in outs])
+        return updates, box["state"]
 
     # Make it quack like an optax.GradientTransformation namedtuple.
     def __iter__(self):

@@ -109,6 +109,20 @@ def note_local_sgd_sync() -> None:
         fn()
 
 
+def note_sharded_step() -> None:
+    """Called by the sharded optimizers (runtime.sharded) once per
+    completed ZeRO step (reducescatter → shard update → allgather) —
+    lands in the engine's cumulative ``sharded_steps`` counter (no-op
+    when no engine is loaded or against a stale prebuilt .so)."""
+    global _engine
+    eng = _engine
+    if eng is None:
+        return
+    fn = getattr(eng._lib, "horovod_note_sharded_step", None)
+    if fn is not None and getattr(fn, "restype", "?") is None:
+        fn()
+
+
 def _dtype_code(dtype) -> int:
     name = np.dtype(dtype).name if np.dtype(dtype).name in _DTYPE_CODES \
         else str(dtype)
@@ -229,6 +243,13 @@ class NativeEngine:
                         "horovod_local_sgd_syncs",
                         "horovod_step_time_ns_p50",
                         "horovod_step_time_ns_p99",
+                        "horovod_backup_auto",
+                        "horovod_backup_auto_ratio_milli",
+                        "horovod_backup_armed",
+                        "horovod_reducescatter_bytes",
+                        "horovod_reducescatter_ns",
+                        "horovod_reducescatter_fallbacks",
+                        "horovod_sharded_steps",
                         "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
@@ -249,6 +270,11 @@ class NativeEngine:
             lib.horovod_note_local_sgd_sync.restype = None
         except AttributeError:
             pass  # stale .so: participants degrade to size-based division
+        try:
+            lib.horovod_note_sharded_step.argtypes = []
+            lib.horovod_note_sharded_step.restype = None
+        except AttributeError:
+            pass  # stale .so: the sharded_steps counter stays 0
         try:
             lib.horovod_autotune_set.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
@@ -396,13 +422,16 @@ class NativeEngine:
 
     def enqueue_reducescatter(self, arr: np.ndarray,
                               name: Optional[str] = None,
-                              red_op: str = "sum") -> int:
+                              red_op: str = "sum",
+                              wire_dtype: Optional[str] = None) -> int:
         """Reduce across ranks (``red_op``: sum/min/max/prod), keep this
         rank's dim-0 slice (rows split as evenly as possible, earlier ranks
-        take the remainder)."""
+        take the remainder).  ``wire_dtype`` rides the allreduce codec
+        seam (fp32 payloads only): fp16/bf16 run the half-staged RS half,
+        int8/fp8 take the exact-parity fallback."""
         return self._enqueue(
             _OP_REDUCESCATTER, arr, self._auto_name("reducescatter", name),
-            red_op=red_op)
+            red_op=red_op, wire_dtype=wire_dtype)
 
     def enqueue_alltoall(self, arr: np.ndarray,
                          name: Optional[str] = None) -> int:
@@ -463,19 +492,24 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_step_time_ns_p99",
+        if getattr(getattr(self._lib, "horovod_sharded_steps",
                            None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the straggler-tolerance "
-                "counters (and possibly earlier counter families) — "
-                "rebuild it with `make -C horovod_tpu/cpp`")
+                "libhorovod_core.so predates the reduce-scatter / sharded-"
+                "optimizer counters (and possibly earlier counter "
+                "families) — rebuild it with `make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
         ar_bytes = self._lib.horovod_allreduce_bytes()
         ar_ns = self._lib.horovod_allreduce_ns()
         bus_bw = 0.0
         if ar_ns > 0 and size > 1:
             bus_bw = (ar_bytes * 2.0 * (size - 1) / size) / (ar_ns / 1e9)
+        rs_bytes = self._lib.horovod_reducescatter_bytes()
+        rs_ns = self._lib.horovod_reducescatter_ns()
+        rs_bus_bw = 0.0
+        if rs_ns > 0 and size > 1:
+            rs_bus_bw = (rs_bytes * 1.0 * (size - 1) / size) / (rs_ns / 1e9)
         return {
             "cycles": self._lib.horovod_exec_cycles(),
             "responses": self._lib.horovod_responses_executed(),
@@ -519,6 +553,20 @@ class NativeEngine:
             "allreduce_bytes": ar_bytes,
             "allreduce_ns": ar_ns,
             "allreduce_bus_bw_bytes_per_sec": bus_bw,
+            # Reduce-scatter (first-class collective; the ZeRO sharded
+            # optimizer's gradient half): payload bytes / wall time of
+            # RS responses, the derived bus bandwidth (N-1)/N·bytes/wall
+            # — half the allreduce numerator, matching RS's wire
+            # pattern — responses that took the exact-parity fallback
+            # (full allreduce + local slice: unaligned multi-dim shards
+            # or a block-quantized wire), and sharded-optimizer steps
+            # completed on this process.
+            "reducescatter_bytes": rs_bytes,
+            "reducescatter_ns": rs_ns,
+            "reducescatter_bus_bw_bytes_per_sec": rs_bus_bw,
+            "reducescatter_fallbacks":
+                self._lib.horovod_reducescatter_fallbacks(),
+            "sharded_steps": self._lib.horovod_sharded_steps(),
             "num_channels": self._lib.horovod_num_channels(),
             "shm_bytes_tx": self._lib.horovod_shm_bytes_tx(),
             "shm_bytes_rx": self._lib.horovod_shm_bytes_rx(),
@@ -561,6 +609,14 @@ class NativeEngine:
                 "wire_dtype": _WIRE_NAMES.get(
                     int(self._lib.horovod_wire_dtype()), "fp32"),
                 "backup_workers": self._lib.horovod_backup_workers(),
+                # HOROVOD_BACKUP_WORKERS=auto: the coordinator arms k=1
+                # only while step_time_ns_p99/p50 exceeds
+                # HOROVOD_BACKUP_AUTO_RATIO; `backup_armed` is its live
+                # verdict (coordinator-evaluated; workers report False).
+                "backup_auto": bool(self._lib.horovod_backup_auto()),
+                "backup_auto_ratio":
+                    self._lib.horovod_backup_auto_ratio_milli() / 1000.0,
+                "backup_armed": bool(self._lib.horovod_backup_armed()),
             },
         }
 
@@ -581,6 +637,7 @@ class NativeEngine:
             # counters — carry the current value like config/topology.
             if k in ("config", "num_channels", "topology",
                      "allreduce_bus_bw_bytes_per_sec",
+                     "reducescatter_bus_bw_bytes_per_sec",
                      "coordinator_cycle_ns_p50",
                      "coordinator_cycle_ns_p99",
                      "step_time_ns_p50",
@@ -594,6 +651,11 @@ class NativeEngine:
             bus_bw = (delta["allreduce_bytes"] * 2.0 * (size - 1) / size) \
                 / (delta["allreduce_ns"] / 1e9)
         delta["allreduce_bus_bw_bytes_per_sec"] = bus_bw
+        rs_bw = 0.0
+        if delta["reducescatter_ns"] > 0 and size > 1:
+            rs_bw = (delta["reducescatter_bytes"] * 1.0 * (size - 1)
+                     / size) / (delta["reducescatter_ns"] / 1e9)
+        delta["reducescatter_bus_bw_bytes_per_sec"] = rs_bw
         return delta
 
     def autotune_set(self, *, chunk_bytes: int = 0,
@@ -738,10 +800,16 @@ class NativeEngine:
 
     def reducescatter(self, tensor, *, average: bool = False,
                       name: Optional[str] = None,
-                      red_op: str = "sum") -> np.ndarray:
+                      red_op: str = "sum",
+                      wire_dtype: Optional[str] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)
-        out = self.synchronize(self.enqueue_reducescatter(arr, name, red_op))
-        return self._apply_average(out) if average else out
+        info: dict = {}
+        out = self.synchronize(
+            self.enqueue_reducescatter(arr, name, red_op,
+                                       wire_dtype=wire_dtype), info)
+        if not average:
+            return out
+        return self._apply_average(out, info.get("participants") or None)
 
     def alltoall(self, tensor, *, name: Optional[str] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)

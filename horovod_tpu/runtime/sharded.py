@@ -1,0 +1,261 @@
+"""ZeRO-style sharded optimizer core (Rajbhandari et al., "ZeRO: Memory
+Optimizations Toward Training Trillion Parameter Models" — stage 1/2).
+
+The idea: with N data-parallel ranks, keeping N identical copies of the
+optimizer state (and fp32 master weights) wastes (N-1)/N of that memory.
+Instead, flatten every parameter into ONE flat vector, split it with the
+engine's largest-first dim-0 convention (the same split the coordinator
+commits for ``reducescatter``), and let each rank keep optimizer state
+ONLY for its owned shard.  A step becomes
+
+    reducescatter(flat grads)          # this rank's shard of the SUM
+    local update of the owned shard    # elementwise optimizer math
+    allgather(shard updates/params)    # everyone leaves with full params
+
+Bit-exactness contract: because the flat vector is 1-D, the committed
+shard geometry coincides with the ring's own segments, so
+``reducescatter(g)[rank]`` is BIT-FOR-BIT ``allreduce(g)`` sliced to the
+owned shard (asserted per dtype in tests/test_reducescatter.py).  An
+ELEMENTWISE optimizer (SGD, momentum, Adam, AdamW, ...) then computes on
+the shard exactly the bytes it would have computed on the full vector,
+and the allgather moves bytes verbatim — so a ``sharded=True`` step is
+bit-identical to the equivalent unsharded flat step.  Optimizers with
+CROSS-parameter reductions (global grad-norm clipping) break that
+equivalence; compose them outside the sharded wrapper or accept the
+shard-local norm.
+
+Wire accounting (honest — ZeRO's own Table 1 says the same): the
+gradient reduce-scatter moves HALF the bytes of an allreduce, and the
+parameter allgather moves the other half, so a sharded step's total wire
+bytes match the unsharded step while per-rank optimizer-state memory
+drops to ~1/N.  The gradient-path ratio (~0.5, gated at <= 0.55 in ci)
+is what composes with wire compression; the memory is the lever that
+lets a model grow past per-rank RAM.
+
+Resize semantics: the shard split is a pure function of (flat length,
+world size), anchored at construction with the membership epoch.  An
+elastic resize that keeps the world size re-anchors silently (the shard
+layout is unchanged).  A resize that CHANGES the world size raises
+:class:`ShardResizeError` — the optimizer state lives only on its owner,
+so silently continuing would corrupt the run; rebuild the optimizer (and
+re-broadcast params) from the last checkpoint or committed state instead
+(see docs/zero.md).
+
+Deliberately jax/torch-free (numpy + the native engine), like
+runtime.engine — both frontends drive this core.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.runtime import engine_or_none
+from horovod_tpu.runtime.engine import note_sharded_step
+
+__all__ = ["shard_bounds", "my_shard", "ShardResizeError", "FlatSharder",
+           "sharded_default"]
+
+
+class ShardResizeError(RuntimeError):
+    """The world size changed under a sharded optimizer: the shard
+    layout (and with it the ownership of optimizer state) is no longer
+    the one this state was built for.  Rebuild the optimizer from a
+    checkpoint / committed ElasticState for the new world — continuing
+    would silently corrupt the update."""
+
+
+def sharded_default() -> bool:
+    """The ``HOROVOD_SHARDED`` env default for
+    ``DistributedOptimizer(sharded=None)`` (0/off unless set)."""
+    import os
+
+    raw = os.environ.get("HOROVOD_SHARDED", "")
+    return raw.strip() not in ("", "0", "false", "False")
+
+
+def shard_bounds(n: int, size: int) -> List[Tuple[int, int]]:
+    """Per-rank ``(offset, count)`` of the flat length-``n`` vector under
+    the engine's committed largest-first split: ``n // size`` each, the
+    first ``n % size`` ranks take one extra.  MUST stay in lockstep with
+    the coordinator's reducescatter geometry (cpp/engine.cc
+    BuildResponse) — for a 1-D tensor the two are the same formula,
+    which is exactly what makes the RS half bit-exact."""
+    bounds = []
+    off = 0
+    for r in range(size):
+        cnt = n // size + (1 if r < n % size else 0)
+        bounds.append((off, cnt))
+        off += cnt
+    return bounds
+
+
+def my_shard(n: int, rank: int, size: int) -> Tuple[int, int]:
+    """This rank's ``(offset, count)`` of the flat vector."""
+    return shard_bounds(n, size)[rank]
+
+
+class FlatSharder:
+    """Flat-vector partitioner + the RS/AG step plumbing both frontends
+    share.
+
+    Owns: the world anchor (epoch, size, flat length, shard bounds) and
+    the wire ops.  Does NOT own optimizer math — the caller passes a
+    ``local_update(shard_grads) -> shard_updates`` callback (jax: optax
+    on the shard; torch: the shard optimizer's step), keeping this core
+    dependency-free.
+
+    ``name`` namespaces the collective names (``<name>.rs.grads``,
+    ``sharded.ag.<name>``); instantiate one sharder per optimizer.
+    """
+
+    #: Per-process construction counter: two sharded optimizers in one
+    #: process get distinct collective names, and the names still agree
+    #: across ranks because construction follows program order — the
+    #: same contract as the engine's auto-naming.
+    _instances = 0
+
+    def __init__(self, n: int, dtype, *, name: str = "zero"):
+        self.n = int(n)
+        self.dtype = np.dtype(dtype)
+        self.name = f"{name}.{FlatSharder._instances}"
+        FlatSharder._instances += 1
+        eng = engine_or_none()
+        from horovod_tpu.common.basics import basics
+
+        self.size = basics.size() if basics.is_initialized() else 1
+        self.rank = basics.rank() if basics.is_initialized() else 0
+        self.epoch = eng.epoch() if eng is not None else 0
+        self.offset, self.count = my_shard(self.n, self.rank, self.size)
+        self._steps = 0
+
+    # -- anchors --
+
+    def check_world(self) -> None:
+        """Re-anchor on a same-size epoch bump (shard layout unchanged);
+        raise :class:`ShardResizeError` when the world size moved."""
+        eng = engine_or_none()
+        from horovod_tpu.common.basics import basics
+
+        size = basics.size() if basics.is_initialized() else 1
+        epoch = eng.epoch() if eng is not None else 0
+        if size != self.size:
+            raise ShardResizeError(
+                f"sharded optimizer '{self.name}' was built for world "
+                f"size {self.size} (epoch {self.epoch}) but the committed "
+                f"world is now size {size} (epoch {epoch}); the shard "
+                "layout changed, so per-rank optimizer state no longer "
+                "matches its owner. Rebuild the optimizer from a "
+                "checkpoint/ElasticState for the new world (docs/zero.md)."
+            )
+        self.epoch = epoch
+
+    # -- the step halves --
+
+    def reduce_grads(self, flat_grads: np.ndarray, *, average: bool = True,
+                     wire_dtype: Optional[str] = None) -> np.ndarray:
+        """This rank's shard of the gradient reduction: ONE engine
+        reducescatter of the flat vector (half an allreduce's wire
+        bytes), divisor-correct by the committed participant count.
+        Returns the shard (length ``self.count``)."""
+        self.check_world()
+        flat = np.ascontiguousarray(flat_grads, dtype=self.dtype)
+        if flat.size != self.n:
+            raise ValueError(
+                f"flat gradient length {flat.size} != sharder length "
+                f"{self.n}")
+        eng = engine_or_none()
+        if eng is None:
+            shard = flat[self.offset:self.offset + self.count].copy()
+            return shard
+        # Stable name: the response cache negotiates steady-state steps
+        # via a slot bit (a per-step suffix would miss every cycle).
+        info: dict = {}
+        out = eng.synchronize(
+            eng.enqueue_reducescatter(
+                flat, name=f"{self.name}.rs.grads",
+                wire_dtype=wire_dtype),
+            info)
+        if average:
+            out = eng._apply_average(out,
+                                     info.get("participants") or None)
+        return out
+
+    def gather_updates(self, shard_updates: np.ndarray) -> np.ndarray:
+        """The inverse half: allgather every rank's shard back into the
+        full flat vector (named ``sharded.ag.*`` so the engine's
+        AG_PARAMS timeline span attributes it)."""
+        upd = np.ascontiguousarray(shard_updates)
+        if upd.size != self.count:
+            raise ValueError(
+                f"shard update length {upd.size} != owned shard "
+                f"{self.count}")
+        eng = engine_or_none()
+        if eng is None:
+            return upd
+        out = eng.allgather(upd, name=f"sharded.ag.{self.name}")
+        return np.asarray(out)
+
+    def step(self, flat_grads: np.ndarray,
+             local_update: Callable[[np.ndarray], np.ndarray], *,
+             average: bool = True,
+             wire_dtype: Optional[str] = None) -> np.ndarray:
+        """One full ZeRO step over the flat vector: RS → ``local_update``
+        on the owned shard → AG.  Returns the FULL flat update vector
+        (what the frontends unflatten back into the param pytree)."""
+        shard_g = self.reduce_grads(flat_grads, average=average,
+                                    wire_dtype=wire_dtype)
+        shard_u = local_update(shard_g)
+        full = self.gather_updates(np.asarray(shard_u, dtype=self.dtype))
+        self._steps += 1
+        note_sharded_step()
+        return full
+
+    # -- flat <-> pytree-of-arrays helpers (numpy level; the frontends
+    #    handle their own tree flattening and just hand lists here) --
+
+    @staticmethod
+    def flatten(arrays: List[np.ndarray], dtype) -> np.ndarray:
+        """Concatenate arrays (C-order raveled) into one flat vector."""
+        if not arrays:
+            return np.zeros((0,), dtype=dtype)
+        return np.concatenate(
+            [np.ascontiguousarray(a, dtype=dtype).ravel() for a in arrays])
+
+    @staticmethod
+    def slice_flat(arrays: List[np.ndarray], offset: int, count: int,
+                   dtype) -> np.ndarray:
+        """The ``[offset, offset+count)`` window of the VIRTUAL
+        concatenation of ``arrays`` without materializing it: only
+        leaves overlapping the window are raveled/converted.  This is
+        how the frontends fetch the shard of the PARAMS each step — a
+        full flat copy of the model would reintroduce the O(N) host
+        buffer the 1/N-memory design exists to avoid (gradients are
+        different: the reduce-scatter wire genuinely needs the full
+        flat vector once per step)."""
+        parts, pos = [], 0
+        end = offset + count
+        for a in arrays:
+            arr = np.asarray(a)
+            n = int(arr.size)
+            lo, hi = max(offset, pos), min(end, pos + n)
+            if lo < hi:
+                flat = np.ascontiguousarray(arr, dtype=dtype).ravel()
+                parts.append(flat[lo - pos:hi - pos])
+            pos += n
+        if not parts:
+            return np.zeros(0, dtype=dtype)
+        if len(parts) == 1:
+            return np.ascontiguousarray(parts[0], dtype=dtype)
+        return np.concatenate(parts)
+
+    @staticmethod
+    def unflatten(flat: np.ndarray, shapes: List[tuple]) -> List[np.ndarray]:
+        """Split the flat vector back into arrays of ``shapes``."""
+        outs, off = [], 0
+        for shp in shapes:
+            cnt = int(np.prod(shp)) if shp else 1
+            outs.append(flat[off:off + cnt].reshape(shp))
+            off += cnt
+        return outs

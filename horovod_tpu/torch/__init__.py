@@ -93,7 +93,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._local_sgd_steps = (default_local_sgd_steps()
                                  if local_sgd_steps is None
                                  else max(1, int(local_sgd_steps)))
-        self._local_sgd = (LocalSGD(self._local_sgd_steps)
+        # With Compression.topk the policy ships the outer MODEL delta
+        # through the sparse path (its own epoch-stamped residuals).
+        self._local_sgd = (LocalSGD(self._local_sgd_steps,
+                                    compression=compression)
                            if self._local_sgd_steps > 1 else None)
 
         if named_parameters is not None:
@@ -337,6 +340,18 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                                          name)
         return ("probe", handle, tensor_compressed, ctx)
 
+    def _named_param_tree(self):
+        """Name-keyed host tree of the current params (the local-SGD
+        policy's unit of anchoring and syncing)."""
+        named = []
+        for group in self.param_groups:
+            for p in group["params"]:
+                name = self._param_names.get(id(p))
+                if name is None:
+                    name = f"localsgd.p{len(named)}"
+                named.append((name, p))
+        return named, {n: p.data.detach().cpu().numpy() for n, p in named}
+
     def _local_sgd_maybe_sync(self):
         """Outer local-SGD sync (every H-th step): collect params into a
         name-keyed numpy tree, run the policy, and copy synced values
@@ -345,14 +360,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         — see elastic.LocalSGD)."""
         import numpy as np
 
-        named = []
-        for group in self.param_groups:
-            for p in group["params"]:
-                name = self._param_names.get(id(p))
-                if name is None:
-                    name = f"localsgd.p{len(named)}"
-                named.append((name, p))
-        tree = {n: p.data.detach().cpu().numpy() for n, p in named}
+        named, tree = self._named_param_tree()
         synced = self._local_sgd.maybe_sync(tree)
         if synced is not tree:  # a sync happened: adopt the outer model
             with torch.no_grad():
@@ -365,10 +373,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # Local-SGD phase: no gradient allreduce; apply the inner
             # optimizer locally, then let the policy decide whether this
             # is the H-th step (one outer sync).  Anchor the cadence
-            # BEFORE the first inner step so the first sync covers
-            # exactly H local updates.
+            # WITH THE PRE-STEP PARAMS before the first inner step: under
+            # top-k the anchor VALUES are load-bearing (reconstruction is
+            # anchor + avg(delta)), and the pre-training params are the
+            # last cross-rank-identical state — anchoring after the first
+            # purely-local step would bake each rank's own offset into
+            # every future sync and the models would never reconverge.
             if not self._local_sgd._anchored:
-                self._local_sgd.begin()
+                self._local_sgd.begin(self._named_param_tree()[1])
             loss = super(self.__class__, self).step(closure)
             self._local_sgd_maybe_sync()
             return loss
@@ -376,11 +388,187 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return super(self.__class__, self).step(closure)
 
 
+class _ShardedOptimizer:
+    """ZeRO-1 sharded optimizer (``DistributedOptimizer(sharded=True)``).
+
+    Flattens the (single) param group into one fp32 master vector, keeps
+    THIS rank's shard of it (and an inner optimizer instance of the
+    user's class over just that shard — ~1/N of the optimizer-state and
+    master-weight memory), and steps via the engine's collective halves:
+
+        reducescatter(flat fp32 grads)   # half an allreduce's bytes
+        inner.step() on the owned shard  # elementwise optimizer math
+        allgather(updated master shard)  # full params back everywhere
+
+    Mixed precision falls out naturally: model params may be fp16/bf16 —
+    gradients are cast up to fp32 for the reduction, the update runs on
+    the fp32 MASTER shard, and the gathered master is cast back into the
+    model params.  For fp32 models with an elementwise inner optimizer
+    the step is bit-identical to the equivalent unsharded flat step
+    (asserted in tests/sharded_worker.py).
+
+    Not the hook-mixin: gradients must all exist before the flat
+    reduce-scatter, so the single collective fires in ``step()`` (the
+    ZeRO trade: one flat RS instead of per-tensor overlap).  For LR
+    schedulers, build them on :attr:`shard_optimizer` (the real
+    ``torch.optim.Optimizer`` over the master shard — torch schedulers
+    type-check their argument, and this wrapper is not an Optimizer
+    subclass); ``param_groups`` aliases its groups, so manual
+    ``param_groups[0]["lr"] = ...`` updates work on either handle.
+    """
+
+    def __init__(self, optimizer, compression=Compression.none):
+        import numpy as np
+
+        from horovod_tpu.runtime.sharded import FlatSharder
+
+        if len(optimizer.param_groups) != 1:
+            raise ValueError(
+                "sharded=True supports a single param group (shards are "
+                "slices of ONE flat vector; per-group hyperparameters "
+                "would cross shard boundaries) — merge groups or keep "
+                "the unsharded optimizer")
+        wire = getattr(compression, "engine_wire_dtype", None)
+        self._wire = wire if wire in ("fp16", "bf16", "int8", "fp8") \
+            else None
+        from horovod_tpu.torch.compression import TopKCompressor
+        if isinstance(compression, TopKCompressor):
+            raise ValueError(
+                "sharded=True reduces gradients with reducescatter; the "
+                "top-k sparse path has no scatter half — use a wire "
+                "compressor (Compression.wire_bf16 etc.) instead")
+        self._params = list(optimizer.param_groups[0]["params"])
+        self._shapes = [tuple(p.shape) for p in self._params]
+        self._numels = [p.numel() for p in self._params]
+        n = int(sum(self._numels))
+        self._sharder = FlatSharder(n, np.float32, name="zero.torch")
+        # fp32 master shard: the ONLY full-precision copy of this slice
+        # in the world (ZeRO's master-weight sharding).
+        with torch.no_grad():
+            flat = torch.cat([
+                p.detach().to(torch.float32).reshape(-1)
+                for p in self._params
+            ]) if self._params else torch.zeros(0)
+            self._master = flat[
+                self._sharder.offset:
+                self._sharder.offset + self._sharder.count].clone()
+        defaults = {k: v for k, v in optimizer.param_groups[0].items()
+                    if k != "params"}
+        self._shard_opt = type(optimizer)([self._master], **defaults)
+        #: The shard optimizer's groups — LR schedulers mutate the
+        #: hyperparameters that actually drive the update.
+        self.param_groups = self._shard_opt.param_groups
+
+    @property
+    def sharder(self):
+        """The flat partitioner (shard offset/count, world anchor)."""
+        return self._sharder
+
+    @property
+    def shard_optimizer(self):
+        """The inner ``torch.optim.Optimizer`` instance over the fp32
+        master shard — the handle to give LR schedulers (its
+        hyperparameters are the ones that drive the update;
+        ``param_groups`` is the same object)."""
+        return self._shard_opt
+
+    def state_bytes(self) -> int:
+        """Bytes of per-rank optimizer state + master weights (the ~1/N
+        memory claim, measured: tests assert it)."""
+        total = self._master.numel() * self._master.element_size()
+        for st in self._shard_opt.state.values():
+            for v in st.values():
+                if torch.is_tensor(v):
+                    total += v.numel() * v.element_size()
+        return total
+
+    def zero_grad(self, set_to_none: bool = True):
+        for p in self._params:
+            if set_to_none:
+                p.grad = None
+            elif p.grad is not None:
+                p.grad.detach_()
+                p.grad.zero_()
+
+    def step(self, closure=None):
+        import numpy as np
+
+        loss = closure() if closure is not None else None
+
+        def flat_grad(p, numel):
+            if p.grad is None:
+                return np.zeros(numel, dtype=np.float32)
+            g = p.grad
+            if g.is_sparse:
+                g = g.to_dense()  # flat RS has no sparse path
+            return np.ascontiguousarray(
+                g.detach().to(torch.float32).reshape(-1).numpy())
+
+        flat_g = np.concatenate([
+            flat_grad(p, numel)
+            for p, numel in zip(self._params, self._numels)
+        ]) if self._params else np.zeros(0, dtype=np.float32)
+
+        def local_update(shard_g):
+            self._master.grad = torch.from_numpy(
+                np.ascontiguousarray(shard_g))
+            self._shard_opt.step()
+            self._master.grad = None
+            # Ship the UPDATED master shard itself (not a delta): the
+            # allgather is lossless, so every rank reconstructs the
+            # identical new flat master.
+            return self._master.detach().numpy()
+
+        full = self._sharder.step(flat_g, local_update, average=True,
+                                  wire_dtype=self._wire)
+        with torch.no_grad():
+            off = 0
+            for p, numel, shape in zip(self._params, self._numels,
+                                       self._shapes):
+                chunk = torch.from_numpy(
+                    np.ascontiguousarray(full[off:off + numel]))
+                p.data.copy_(chunk.reshape(shape).to(p.dtype))
+                off += numel
+        return loss
+
+    def state_dict(self):
+        """Shard-LOCAL state (each rank saves its own shard — see
+        docs/checkpointing.md for the sharded save/restore recipe)."""
+        return {
+            "shard_opt": self._shard_opt.state_dict(),
+            "master": self._master.detach().cpu(),
+            "shard": {"offset": self._sharder.offset,
+                      "count": self._sharder.count,
+                      "n": self._sharder.n,
+                      "size": self._sharder.size},
+        }
+
+    def load_state_dict(self, sd):
+        from horovod_tpu.runtime.sharded import ShardResizeError
+
+        meta = sd.get("shard", {})
+        if (meta.get("n") != self._sharder.n or
+                meta.get("size") != self._sharder.size or
+                meta.get("offset") != self._sharder.offset):
+            raise ShardResizeError(
+                "sharded checkpoint was written for shard "
+                f"{meta.get('offset')}+{meta.get('count')} of "
+                f"{meta.get('n')} at world size {meta.get('size')}, but "
+                f"this optimizer owns {self._sharder.offset}+"
+                f"{self._sharder.count} of {self._sharder.n} at size "
+                f"{self._sharder.size}; restore at the original world "
+                "size or rebuild from a full checkpoint (docs/zero.md)")
+        self._shard_opt.load_state_dict(sd["shard_opt"])
+        with torch.no_grad():
+            self._master.copy_(sd["master"].to(torch.float32))
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1,
                          sparse_as_dense=False,
-                         local_sgd_steps=None):
+                         local_sgd_steps=None,
+                         sharded=None):
     """Wrap a torch optimizer so gradients are averaged across ranks during
     ``backward()`` (reference factory, torch/__init__.py:115-150).
 
@@ -396,7 +584,44 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     locally and ``step()`` allreduces the MODEL delta once every ``H``
     steps (epoch-stamped — an elastic resize re-anchors instead of
     leaking a dead incarnation's delta).  ``H <= 1`` keeps the per-step
-    gradient-allreduce path byte-identical."""
+    gradient-allreduce path byte-identical.  With
+    ``compression=Compression.topk(ratio)`` the outer sync ships the
+    model delta through the top-k sparse path (its own epoch-stamped
+    error-feedback residuals).
+
+    ``sharded=True`` (default ``HOROVOD_SHARDED``) returns the ZeRO-1
+    :class:`_ShardedOptimizer` instead of the hook mixin: fp32 master
+    weights and optimizer state live only on each shard's owner (~1/N
+    memory), gradients reduce by ``reducescatter`` and params return by
+    ``allgather`` — see docs/zero.md."""
+    from horovod_tpu.runtime.sharded import sharded_default
+
+    if sharded is None:
+        sharded = sharded_default()
+    if sharded:
+        from horovod_tpu.elastic.state import default_local_sgd_steps
+
+        # Resolve the env default too (HOROVOD_LOCAL_SGD_STEPS) so the
+        # exclusivity contract matches the jax frontend's: a requested
+        # local-SGD cadence must never be silently dropped.
+        resolved_h = (default_local_sgd_steps() if local_sgd_steps is None
+                      else max(1, int(local_sgd_steps)))
+        if resolved_h > 1:
+            raise ValueError(
+                "sharded=True and local_sgd_steps>1 are mutually "
+                "exclusive: local SGD skips the per-step reduction the "
+                "sharded step is built around")
+        if int(backward_passes_per_step) != 1:
+            # Never silently change gradient-accumulation semantics: the
+            # sharded step reduces+applies on EVERY step().
+            raise ValueError(
+                "sharded=True does not support backward_passes_per_step"
+                f"={backward_passes_per_step}: the flat reduce-scatter "
+                "fires on every step(). Accumulate gradients in the "
+                "training loop (call step() every Nth backward) instead")
+        # named_parameters is accepted and unused (the flat RS needs no
+        # per-tensor names); sparse grads are densified in step().
+        return _ShardedOptimizer(optimizer, compression=compression)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,

@@ -34,10 +34,66 @@ def rank_target(rank: int) -> np.ndarray:
     return np.linspace(rank + 1.0, rank + 2.0, DIM)
 
 
+def scenario_topk(rank, size, eng):
+    """Outer sync over the TOP-K SPARSE path: LocalSGD(compression=
+    topk) ships each sync's model DELTA as its k largest entries with
+    error feedback.  On the same quadratic the truncated outer steps
+    still converge to the consensus optimum (the residuals carry the
+    unsent delta mass into later rounds — never lost), and the wire is
+    the sparse allgather path, counted."""
+    from horovod_tpu.runtime.sparse import residual_norm
+
+    class TopKCompressor:
+        """Duck-typed top-k spec (LocalSGD detects by class name +
+        ratio attr) — keeps this worker jax/torch-free; the frontends
+        pass their own Compression.topk(...) instances."""
+
+        def __init__(self, ratio, error_feedback=True):
+            self.ratio = ratio
+            self.error_feedback = error_feedback
+
+    target = rank_target(rank)
+    policy = LocalSGD(local_sgd_steps=H,
+                      compression=TopKCompressor(0.5))
+    w = np.zeros(DIM, dtype=np.float32)
+    policy.begin({"w": w})
+    rounds = 10
+    saw_residual = False
+    for step in range(H * rounds):
+        grad = 2.0 * (w - target)
+        w = (w - LR * grad).astype(np.float32)
+        tree = {"w": w}
+        out = policy.maybe_sync(tree)
+        if out is not tree:
+            w = out["w"]
+            saw_residual = (saw_residual or
+                            residual_norm("local_sgd.delta.p.w") > 0)
+    assert policy.sync_count == rounds, policy.sync_count
+    st = eng.stats()
+    assert st["local_sgd_syncs"] == rounds
+    # The sync rode the SPARSE path: top-k allreduces were counted and
+    # the engine only ever executed allgathers for them (2 per sync).
+    assert st["sparse_count"] == rounds, st["sparse_count"]
+    # Error feedback is load-bearing: with ratio 0.5 the unsent half
+    # accumulates in the residual between rounds.
+    assert saw_residual
+    tbar = np.mean([rank_target(r) for r in range(size)], axis=0)
+    loss = float(np.mean((w - tbar) ** 2))
+    # Convergence bound: the dense run lands near the closed form
+    # (loss <= 0.05 after 4 rounds); the truncated-but-fed-back run gets
+    # more rounds and must land inside a modestly looser bound.
+    assert loss <= 0.10, loss
+    print(f"LOCAL_SGD_TOPK_OK rank={rank} loss={loss:.6f}", flush=True)
+
+
 def main():
     basics.init()
     rank, size = basics.rank(), basics.size()
     eng = get_engine()
+    if len(sys.argv) > 1 and sys.argv[1] == "topk":
+        scenario_topk(rank, size, eng)
+        basics.shutdown()
+        return
     target = rank_target(rank)
 
     policy = LocalSGD(local_sgd_steps=H)

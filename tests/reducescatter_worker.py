@@ -1,0 +1,238 @@
+"""Worker body for multi-process reduce-scatter tests.
+
+The plane's anchor, asserted at the BYTE level: for every dtype, reduce
+op, transport, shape, and wire format,
+
+    reducescatter(x)[rank]  ==  allreduce(x) sliced to the owned shard
+
+bit-for-bit.  Aligned geometries (1-D always; multi-dim with
+rows % size == 0) take the true RS half of the cascade — half an
+allreduce's wire bytes — and the parity holds because the allgather
+half of a ring allreduce only ever moves bytes verbatim.  Unaligned
+geometries and block-quantized wires take the exact-parity fallback
+(the full allreduce on a scratch buffer + a local slice), so the
+equality is UNIVERSAL and the corpus below asserts it everywhere.
+
+Run as ``python reducescatter_worker.py <scenario>`` with identity in
+HOROVOD_RANK/HOROVOD_SIZE/HOROVOD_COORDINATOR env vars (the
+test_native_engine.run_workers idiom).  Deliberately jax-free.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import get_engine  # noqa: E402
+
+
+def shard_rows(rows: int, rank: int, size: int):
+    """The committed largest-first dim-0 split (engine BuildResponse)."""
+    off = 0
+    for r in range(size):
+        cnt = rows // size + (1 if r < rows % size else 0)
+        if r == rank:
+            return off, cnt
+        off += cnt
+    return off, 0
+
+
+def _mk(shape, dtype, rank, seed):
+    rng = np.random.default_rng(seed * 1000 + rank)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return rng.integers(1, 7, size=shape).astype(dtype)
+    # Keep PROD magnitudes tame; nonzero so min/max ties are rare but
+    # bit-compare doesn't care either way.
+    return (rng.standard_normal(shape) * 0.5 + 1.5).astype(dtype)
+
+
+def _assert_parity(eng, rank, size, shape, dtype, red_op, seed,
+                   name, wire=None):
+    x = _mk(shape, dtype, rank, seed)
+    ar = eng.allreduce(x.copy(), red_op=red_op, name=f"{name}.ar",
+                       wire_dtype=wire)
+    rs = eng.reducescatter(x.copy(), red_op=red_op, name=f"{name}.rs",
+                           wire_dtype=wire)
+    off, cnt = shard_rows(shape[0], rank, size)
+    want = np.ascontiguousarray(np.asarray(ar)[off:off + cnt])
+    got = np.asarray(rs)
+    assert got.shape == want.shape, (name, got.shape, want.shape)
+    assert got.tobytes() == want.tobytes(), (
+        f"{name}: reducescatter != sliced allreduce "
+        f"(dtype={dtype}, op={red_op}, shape={shape}, wire={wire}, "
+        f"maxdiff={np.max(np.abs(got.astype(np.float64) - want.astype(np.float64))) if cnt else 0})"
+    )
+
+
+# The corpus: 1-D prime counts (uneven shards, aligned geometry — the
+# true RS half), multi-dim even rows (aligned), multi-dim uneven rows
+# (fallback), tiny tensors (the star path when shm + threshold engage),
+# and rows < size (empty shards).
+SHAPES = [
+    (101,),          # prime, uneven 1-D — RS half
+    (1031,),         # prime, larger
+    (64, 9),         # rows % size == 0 at 2 and 4 ranks — RS half
+    (7, 5),          # uneven multi-dim — exact-parity fallback
+    (3,),            # rows < size at 4 ranks: empty shards
+    (2048,),         # big enough to stay on the ring path
+]
+DTYPES_ALL_OPS = [np.float32, np.float64, np.int32, np.int64]
+OPS = ["sum", "min", "max", "prod"]
+
+
+def scenario_parity(rank, size, eng):
+    seed = 7
+    for shape in SHAPES:
+        for dtype in DTYPES_ALL_OPS:
+            for op in OPS:
+                name = f"rs.{len(shape)}d{shape[0]}.{np.dtype(dtype).name}.{op}"
+                _assert_parity(eng, rank, size, shape, dtype, op, seed,
+                               name)
+                seed += 1
+    # Reduced-precision dtypes (sum/max — the combos ReduceInto serves).
+    try:
+        import ml_dtypes
+
+        for dtype in (np.float16, ml_dtypes.bfloat16):
+            for op in ("sum", "max"):
+                name = f"rs.half.{np.dtype(dtype).name}.{op}"
+                _assert_parity(eng, rank, size, (257,), dtype, op, seed,
+                               name)
+                seed += 1
+    except ImportError:
+        pass
+    print(f"RS_PARITY_OK rank={rank}", flush=True)
+
+
+def scenario_cached(rank, size, eng):
+    # The cached negotiation path: the SAME names re-enqueued settle via
+    # cache-slot bits; parity must hold on the replayed responses too.
+    s0 = eng.stats()
+    for round_ in range(3):
+        for shape in ((101,), (64, 9), (7, 5)):
+            _assert_parity(eng, rank, size, shape, np.float32, "sum",
+                           11 + round_, f"rsc.{shape[0]}x{len(shape)}")
+    st = eng.stats_delta(s0)
+    assert st["cache_hits"] > 0, st["cache_hits"]
+    print(f"RS_CACHED_OK rank={rank} hits={st['cache_hits']}", flush=True)
+
+
+def scenario_wire(rank, size, eng):
+    # The codec seam: half wires ride the RS half (no fallback),
+    # int8/fp8 take the exact-parity fallback — parity is bitwise vs the
+    # SAME-wire allreduce in every case.
+    seed = 31
+    s0 = eng.stats()
+    for wire in ("fp16", "bf16"):
+        _assert_parity(eng, rank, size, (1023,), np.float32, "sum", seed,
+                       f"rsw.{wire}", wire=wire)
+        seed += 1
+    halfway = eng.stats_delta(s0)
+    assert halfway["reducescatter_fallbacks"] == 0, halfway
+    for wire in ("int8", "fp8"):
+        _assert_parity(eng, rank, size, (1024,), np.float32, "sum", seed,
+                       f"rsw.{wire}", wire=wire)
+        seed += 1
+    st = eng.stats_delta(s0)
+    assert st["reducescatter_fallbacks"] == 2, st["reducescatter_fallbacks"]
+    assert st["wire_int8_count"] >= 2, st  # allreduce + RS fallback
+    print(f"RS_WIRE_OK rank={rank}", flush=True)
+
+
+def scenario_bytes(rank, size, eng):
+    # The wire-bytes claim on the deterministic byte counters: an
+    # aligned flat-ring reducescatter moves (N-1)/N * S bytes per rank —
+    # HALF the allreduce's 2(N-1)/N * S.  Gate at <= 0.55 with honest
+    # headroom; also sanity-check RS actually moved > 0.4x (it really
+    # ran a ring, not a shortcut).
+    n = 1 << 20  # 4 MB fp32, well above any small-tensor threshold
+    x = _mk((n,), np.float32, rank, 99)
+    s0 = eng.stats()
+    eng.allreduce(x.copy(), name="bytes.ar")
+    mid = eng.stats_delta(s0)
+    eng.reducescatter(x.copy(), name="bytes.rs")
+    end = eng.stats_delta(s0)
+    ar_tx = mid["data_bytes_tx"]
+    rs_tx = end["data_bytes_tx"] - ar_tx
+    assert ar_tx > 0 and rs_tx > 0, (ar_tx, rs_tx)
+    ratio = rs_tx / ar_tx
+    assert 0.40 <= ratio <= 0.55, (
+        f"reducescatter wire bytes ratio {ratio:.3f} outside [0.40,0.55] "
+        f"(rs_tx={rs_tx}, ar_tx={ar_tx})")
+    st = eng.stats_delta(s0)
+    assert st["reducescatter_bytes"] == n * 4, st["reducescatter_bytes"]
+    assert st["reducescatter_fallbacks"] == 0, st
+    print(f"RS_BYTES_OK rank={rank} ratio={ratio:.3f}", flush=True)
+
+
+def scenario_backup_auto(rank, size, eng):
+    # HOROVOD_BACKUP_WORKERS=auto on a HEALTHY world: mode reported,
+    # k committed 0, never armed (the 64-sample floor alone guarantees
+    # it over this short run), and zero skips.
+    for i in range(8):
+        eng.allreduce(np.ones(32, np.float32), name=f"ba.{i}")
+    st = eng.stats()
+    assert st["config"]["backup_auto"] is True, st["config"]
+    assert st["config"]["backup_workers"] == 0, st["config"]
+    assert abs(st["config"]["backup_auto_ratio"] - 2.5) < 1e-9, \
+        st["config"]
+    assert st["config"]["backup_armed"] is False, st["config"]
+    assert st["backup_skips"] == 0, st["backup_skips"]
+    print(f"BACKUP_AUTO_OK rank={rank}", flush=True)
+
+
+def scenario_backup_auto_arms(rank, size, eng):
+    # Deterministic straggler: rank (size-1) stalls 120 ms before every
+    # 12th enqueue, the rest are ~cycle-time fast — so the coordinator's
+    # window shows p99 >> 3 * p50 once >= 64 samples land, the auto rule
+    # arms k=1, and the straggler starts getting skipped (partial
+    # commits) while the fast ranks keep stepping.
+    import time
+
+    from horovod_tpu.runtime.engine import StepSkipped
+
+    skips = 0
+    for i in range(140):
+        if rank == size - 1 and i % 12 == 11 and i > 70:
+            time.sleep(0.12)
+        try:
+            eng.allreduce(np.full(64, 1.0, np.float32), name=f"baa.{i}")
+        except StepSkipped:
+            skips += 1
+    st = eng.stats()
+    if rank == 0:
+        # The coordinator evaluated the rule and armed at least once by
+        # the end of the stall schedule (armed is the LIVE verdict, so
+        # don't over-assert the final sample; skips prove it fired).
+        assert st["config"]["backup_auto"] is True, st["config"]
+    if rank == size - 1:
+        assert skips > 0 or st["backup_skips"] > 0, (
+            "auto mode never armed: the stalled rank was never skipped",
+            st["step_time_ns_p50"], st["step_time_ns_p99"])
+    print(f"BACKUP_AUTO_ARMS_OK rank={rank} skips={skips}", flush=True)
+
+
+SCENARIOS = {
+    "parity": scenario_parity,
+    "cached": scenario_cached,
+    "wire": scenario_wire,
+    "bytes": scenario_bytes,
+    "backup_auto": scenario_backup_auto,
+    "backup_auto_arms": scenario_backup_auto_arms,
+}
+
+
+def main():
+    scenario = sys.argv[1] if len(sys.argv) > 1 else "parity"
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    SCENARIOS[scenario](rank, size, eng)
+    basics.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,286 @@
+"""Worker body for the ZeRO-style sharded-optimizer tests.
+
+The acceptance anchors, measured (never assumed):
+
+* BIT parity: a ``sharded=True`` step — reducescatter(flat grads) →
+  shard-local elementwise update → allgather — produces params
+  bit-identical to the equivalent UNSHARDED flat step (allreduce(flat
+  grads) → full-vector update) after every step, per framework.  The
+  chain: RS ≡ sliced allreduce (1-D aligned geometry), elementwise
+  optimizers commute with slicing, allgather moves bytes verbatim.
+* MEMORY: per-rank optimizer-state bytes ~1/N of the unsharded
+  footprint (the ZeRO lever), measured on the actual state.
+* WIRE (honest, ZeRO paper Table 1): the gradient reduce-scatter moves
+  <= 0.55x the allreduce's data_bytes_tx (construction: exactly
+  (N-1)/N vs 2(N-1)/N), and the FULL step (RS + param allgather) lands
+  at ~1.0x — sharding trades no extra bytes for the 1/N memory.
+
+Run as ``python sharded_worker.py <scenario>`` with the usual
+HOROVOD_RANK/SIZE/COORDINATOR identity env.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import get_engine  # noqa: E402
+from horovod_tpu.runtime.sharded import FlatSharder, my_shard  # noqa: E402
+
+N_ELEMS = 65537         # prime: uneven shards on every world size; and
+                        # > HOROVOD_ALGO_THRESHOLD (32 KB), so the ring
+                        # path runs — the wire-halving claim is a RING
+                        # property (the latency star's member tx is the
+                        # full buffer either way)
+N_STEPS = 6
+LR = np.float32(0.05)
+MOM = np.float32(0.9)
+
+
+def _grads(step, rank, n):
+    rng = np.random.default_rng(100 * step + rank)
+    return rng.standard_normal(n).astype(np.float32)
+
+
+def _sgd_momentum(params, grads, vel):
+    """Elementwise SGD+momentum in fp32 — the shared update kernel both
+    the sharded and unsharded runs use, so any bit difference comes from
+    the WIRE, not the math."""
+    vel2 = MOM * vel + grads
+    return params - LR * vel2, vel2
+
+
+def scenario_numpy(rank, size, eng):
+    # Core parity + counters, framework-free.
+    sharder = FlatSharder(N_ELEMS, np.float32, name="w.numpy")
+    off, cnt = sharder.offset, sharder.count
+    assert (off, cnt) == my_shard(N_ELEMS, rank, size)
+
+    rng = np.random.default_rng(42)
+    p_sharded = rng.standard_normal(N_ELEMS).astype(np.float32)
+    p_ref = p_sharded.copy()
+    vel_shard = np.zeros(cnt, np.float32)      # state: OWNED SHARD only
+    vel_full = np.zeros(N_ELEMS, np.float32)   # unsharded reference
+
+    s0 = eng.stats()
+    rs_tx_total = 0
+    step_tx_total = 0
+    for step in range(N_STEPS):
+        g = _grads(step, rank, N_ELEMS)
+
+        # Unsharded flat baseline: allreduce + full-vector update.
+        before = eng.stats_delta(s0)["data_bytes_tx"]
+        g_ref = np.asarray(eng.allreduce(g.copy(), average=True,
+                                         name="w.ref.ar"))
+        ar_tx = eng.stats_delta(s0)["data_bytes_tx"] - before
+        p_ref, vel_full = _sgd_momentum(p_ref, g_ref, vel_full)
+
+        # Sharded step through the same update kernel on the shard.
+        before = eng.stats_delta(s0)["data_bytes_tx"]
+        shard_g = sharder.reduce_grads(g, average=True)
+        rs_tx = eng.stats_delta(s0)["data_bytes_tx"] - before
+        new_shard, vel_shard = _sgd_momentum(
+            p_sharded[off:off + cnt], shard_g, vel_shard)
+        p_sharded = sharder.gather_updates(new_shard)
+        step_tx = eng.stats_delta(s0)["data_bytes_tx"] - before
+        rs_tx_total += rs_tx
+        step_tx_total += step_tx
+
+        assert p_sharded.tobytes() == p_ref.tobytes(), (
+            f"step {step}: sharded params != unsharded flat params "
+            f"(maxdiff={np.max(np.abs(p_sharded - p_ref))})")
+
+        if size > 1:
+            # Gradient-path wire: RS <= 0.55x the allreduce (the
+            # construction is exactly 0.5x; headroom for chunk padding).
+            assert rs_tx <= 0.55 * ar_tx, (step, rs_tx, ar_tx)
+            assert rs_tx >= 0.40 * ar_tx, (step, rs_tx, ar_tx)
+            # Honest full-step accounting: RS + AG ~ one allreduce.
+            assert step_tx <= 1.15 * ar_tx, (step, step_tx, ar_tx)
+
+    # Memory: the sharded velocity state is ~1/N of the reference's.
+    state_ratio = vel_shard.nbytes / vel_full.nbytes
+    assert state_ratio <= 1.0 / size + 0.01, (state_ratio, size)
+
+    st = eng.stats_delta(s0)
+    assert st["reducescatter_fallbacks"] == 0, st
+    assert st["reducescatter_bytes"] == N_STEPS * N_ELEMS * 4, st
+    # note_sharded_step rides FlatSharder.step(); reduce_grads/gather
+    # were driven manually here, so count them via one step() call.
+    full = sharder.step(_grads(99, rank, N_ELEMS),
+                        lambda sg: sg, average=True)
+    assert full.shape == (N_ELEMS,)
+    assert eng.stats_delta(s0)["sharded_steps"] == 1
+    print(f"SHARDED_NUMPY_OK rank={rank} rs_ratio="
+          f"{rs_tx_total / max(1, step_tx_total):.3f}", flush=True)
+
+
+def scenario_jax(rank, size, eng):
+    # The jax frontend: DistributedOptimizer(optax.adam, sharded=True)
+    # vs the unsharded flat equivalent — bit parity after every step.
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu.jax as hvd
+
+    opt = hvd.DistributedOptimizer(optax.adam(1e-2), sharded=True,
+                                   name="zj")
+    params = {
+        "w": jnp.asarray(np.linspace(-1, 1, 257, dtype=np.float32)),
+        "b": jnp.asarray(np.linspace(0, 1, 31, dtype=np.float32)),
+    }
+    state = opt.init(params)
+
+    # Unsharded flat reference: the same adam on the FULL flat vector.
+    ref_flat = np.concatenate([np.asarray(params["b"]).ravel(),
+                               np.asarray(params["w"]).ravel()])
+    # NOTE: jax.tree flattens dicts in sorted-key order ("b" then "w").
+    ref_opt = optax.adam(1e-2)
+    ref_state = ref_opt.init(jnp.asarray(ref_flat))
+
+    for step in range(4):
+        gb = _grads(step, rank, 31)
+        gw = _grads(1000 + step, rank, 257)
+        grads = {"w": jnp.asarray(gw), "b": jnp.asarray(gb)}
+
+        updates, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+
+        flat_g = np.concatenate([gb, gw])
+        red = np.asarray(eng.allreduce(flat_g, average=True,
+                                       name="zj.ref"))
+        ref_updates, ref_state = ref_opt.update(
+            jnp.asarray(red), ref_state, jnp.asarray(ref_flat))
+        ref_flat = np.asarray(optax.apply_updates(
+            jnp.asarray(ref_flat), ref_updates))
+
+        got = np.concatenate([np.asarray(params["b"]).ravel(),
+                              np.asarray(params["w"]).ravel()])
+        assert got.tobytes() == ref_flat.tobytes(), (
+            f"jax sharded step {step} diverged: "
+            f"maxdiff={np.max(np.abs(got - ref_flat))}")
+
+    # The inner state really is shard-sized.
+    mu = np.asarray(jax.tree.leaves(state)[-1])  # a moment buffer leaf
+    o, c = my_shard(288, rank, size)
+    assert mu.size == c, (mu.size, c)
+    assert eng.stats()["sharded_steps"] >= 4
+    print(f"SHARDED_JAX_OK rank={rank}", flush=True)
+
+
+def scenario_torch(rank, size, eng):
+    # The torch frontend, fp32: sharded vs unsharded flat — bit parity;
+    # plus the measured per-rank optimizer-state ratio.
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    torch.manual_seed(3)
+    w = torch.nn.Parameter(torch.randn(137, 3))
+    b = torch.nn.Parameter(torch.randn(19))
+    base = torch.optim.SGD([w, b], lr=float(LR), momentum=float(MOM))
+    opt = hvd.DistributedOptimizer(base, sharded=True)
+    n = w.numel() + b.numel()
+
+    # Unsharded flat reference: a REAL torch SGD over the full flat
+    # vector (torch's kernels may fuse multiply-adds; a hand-rolled
+    # numpy kernel would differ by an ulp and blame the wire unfairly).
+    ref_p = torch.nn.Parameter(torch.from_numpy(np.concatenate([
+        w.detach().numpy().ravel(), b.detach().numpy().ravel()
+    ]).astype(np.float32)))
+    ref_opt = torch.optim.SGD([ref_p], lr=float(LR), momentum=float(MOM))
+
+    for step in range(N_STEPS):
+        g = _grads(step, rank, n)
+        w.grad = torch.from_numpy(g[:w.numel()].reshape(w.shape).copy())
+        b.grad = torch.from_numpy(g[w.numel():].copy())
+        opt.step()
+
+        g_ref = np.asarray(eng.allreduce(g.copy(), average=True,
+                                         name="zt.ref"))
+        ref_p.grad = torch.from_numpy(g_ref.copy())
+        ref_opt.step()
+        got = np.concatenate([
+            w.detach().numpy().ravel(), b.detach().numpy().ravel()
+        ]).astype(np.float32)
+        ref = ref_p.detach().numpy()
+        assert got.tobytes() == ref.tobytes(), (
+            f"torch sharded step {step} diverged: "
+            f"maxdiff={np.max(np.abs(got - ref))}")
+
+    # Measured ~1/N optimizer-state + master bytes: master shard (4B) +
+    # momentum buffer shard (4B) vs an unsharded momentum (4B/elem) +
+    # nothing (fp32 keeps no master) — so compare against 2x flat as the
+    # sharded-at-size-1 footprint.
+    mine = opt.state_bytes()
+    full_equiv = 2 * n * 4
+    assert mine <= full_equiv / size + 64, (mine, full_equiv, size)
+    print(f"SHARDED_TORCH_OK rank={rank} state_bytes={mine}", flush=True)
+
+
+def scenario_torch_mixed(rank, size, eng):
+    # bf16 params with fp32 master shards: every rank must land on the
+    # IDENTICAL bf16 params (allgather of the master is lossless and the
+    # cast is deterministic), and track an fp32 shadow within bf16
+    # resolution.
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    torch.manual_seed(5)
+    p = torch.nn.Parameter(torch.randn(211).to(torch.bfloat16))
+    base = torch.optim.SGD([p], lr=0.05)
+    opt = hvd.DistributedOptimizer(base, sharded=True)
+
+    shadow = p.detach().to(torch.float32).numpy().copy()
+    for step in range(4):
+        g = _grads(step, rank, 211)
+        p.grad = torch.from_numpy(g).to(torch.bfloat16)
+        opt.step()
+        g_ref = np.asarray(eng.allreduce(
+            p_grad_fp32(g), average=True, name="ztm.ref"))
+        shadow = shadow - 0.05 * g_ref
+
+    got = p.detach().to(torch.float32).numpy()
+    assert np.allclose(got, shadow, atol=0.04, rtol=0.02), (
+        np.max(np.abs(got - shadow)))
+    # Cross-rank identity: all ranks hold the same bf16 bytes.
+    mine = p.detach().to(torch.float32).numpy()
+    avg = np.asarray(eng.allreduce(mine.copy(), average=True,
+                                   name="ztm.identity"))
+    assert avg.tobytes() == mine.tobytes(), "ranks hold different params"
+    print(f"SHARDED_TORCH_MIXED_OK rank={rank}", flush=True)
+
+
+def p_grad_fp32(g):
+    # The sharded optimizer reduces bf16 grads AFTER casting up to fp32;
+    # mirror that cast for the shadow reference.
+    import torch
+
+    return torch.from_numpy(g).to(torch.bfloat16).to(
+        torch.float32).numpy()
+
+
+SCENARIOS = {
+    "numpy": scenario_numpy,
+    "jax": scenario_jax,
+    "torch": scenario_torch,
+    "torch_mixed": scenario_torch_mixed,
+}
+
+
+def main():
+    scenario = sys.argv[1] if len(sys.argv) > 1 else "numpy"
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    SCENARIOS[scenario](rank, size, eng)
+    basics.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -13,39 +13,74 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "jaxdist_worker.py")
 
 
-def _run_jaxdist(scenario, timeout=240):
-    port = _free_port()
-    jax_port = _free_port()  # explicit: the derived port+64 may be taken
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)  # worker sets its own 2-device flag
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": "2",
-            "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
-            "HOROVOD_JAX_COORDINATOR": f"127.0.0.1:{jax_port}",
-            "JAX_PLATFORMS": "cpu",
-            "PALLAS_AXON_POOL_IPS": "",
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER, scenario],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        ))
-    try:
-        results = [p.communicate(timeout=timeout) for p in procs]
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
-    for rank, (p, (out, err)) in enumerate(zip(procs, results)):
-        assert p.returncode == 0, (
-            f"rank {rank} failed (rc={p.returncode}):\n"
-            f"stdout: {out.decode()}\nstderr: {err.decode()}"
-        )
-        assert b"OK" in out
-    return results
+#: Infra-flake signatures from JAX's multi-process runtime on a loaded
+#: box: a missed coordination-service heartbeat / shutdown barrier
+#: (one process tearing down slowly), or gloo's CPU-collective
+#: transport aborting on a stale TCP pair ("op.preamble.length <=
+#: op.nbytes" — a connection from a previous incarnation reaching a
+#: reused port).  Both are runtime plumbing, not product failures, so
+#: those exact signatures (and only those) are retried with fresh
+#: ports.  Assertion failures never retry.
+_COORD_FLAKE = (b"heartbeat timeout", b"Shutdown barrier has failed",
+                b"Barrier failed because", b"gloo::EnforceNotMet",
+                b"op.preamble.length",
+                # Collateral on the surviving rank when its peer's
+                # runtime died: the distributed client terminates the
+                # process itself (a real product failure reproduces on
+                # every attempt and still fails the test).
+                b"JAX distributed service detected fatal errors",
+                b"Failed to send RPC to coordination service",
+                b"lost connection to the coordinator")
+
+
+def _run_jaxdist(scenario, timeout=240, attempts=3):
+    last = None
+    for attempt in range(attempts):
+        port = _free_port()
+        jax_port = _free_port()  # explicit: the derived port+64 may be taken
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)  # worker sets its own 2-device flag
+            env.update({
+                "HOROVOD_RANK": str(rank),
+                "HOROVOD_SIZE": "2",
+                "HOROVOD_COORDINATOR": f"127.0.0.1:{port}",
+                "HOROVOD_JAX_COORDINATOR": f"127.0.0.1:{jax_port}",
+                "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": "",
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, scenario],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            ))
+        try:
+            results = [p.communicate(timeout=timeout) for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        failed = [(rank, p.returncode, out, err)
+                  for rank, (p, (out, err)) in enumerate(zip(procs, results))
+                  if p.returncode != 0 or b"OK" not in out]
+        if not failed:
+            return results
+        last = failed
+        coord_flake = all(
+            any(sig in err or sig in out for sig in _COORD_FLAKE)
+            or b"OK" in out  # this rank finished; a peer's teardown died
+            for _, _, out, err in failed)
+        if not (coord_flake and attempt + 1 < attempts):
+            break
+        print(f"[jaxdist] runtime-plumbing flake on attempt "
+              f"{attempt + 1}/{attempts} "
+              f"(ranks {[r for r, _, _, _ in failed]}) — retrying with "
+              f"fresh ports", flush=True)
+    raise AssertionError("\n".join(
+        f"rank {rank} failed (rc={rc}):\n"
+        f"stdout: {out.decode()}\nstderr: {err.decode()}"
+        for rank, rc, out, err in last))
 
 
 def test_jax_distributed_bootstrap_two_processes():

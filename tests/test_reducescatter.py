@@ -1,0 +1,272 @@
+"""Reduce-scatter as a first-class collective + the ZeRO sharded
+optimizer riding it.
+
+The plane's anchor (like every prior data-plane PR): BITWISE equalities
+on real multi-process worlds, judged on deterministic byte counters —
+never wall time.
+
+* ``reducescatter(x)[rank] == allreduce(x)`` sliced to the owned shard,
+  per dtype/op/shape/wire, at 2 AND 4 ranks, over shm and TCP, through
+  the cached negotiation path.
+* ``DistributedOptimizer(sharded=True)`` step == the unsharded flat
+  step, bit-for-bit, with per-rank optimizer state ~1/N and the
+  gradient reduce-scatter at <= 0.55x the allreduce's data_bytes_tx.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.test_native_engine import run_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RS_WORKER = os.path.join(REPO, "tests", "reducescatter_worker.py")
+SHARDED_WORKER = os.path.join(REPO, "tests", "sharded_worker.py")
+
+
+# ---------------------------------------------------------------------------
+# RS-vs-sliced-allreduce bitwise parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_rs_parity_shm(n):
+    """Full dtype/op corpus over the default (shm on one host) plane:
+    prime 1-D counts (uneven shards, the true RS half), even and uneven
+    multi-dim rows, empty shards."""
+    run_workers(n, "parity", timeout=180, worker=RS_WORKER)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_rs_parity_tcp(n):
+    """The same corpus forced onto pure TCP (HOROVOD_SHM_DISABLE=1):
+    transport must never change a bit."""
+    run_workers(n, "parity", timeout=180, worker=RS_WORKER,
+                extra_env={"HOROVOD_SHM_DISABLE": "1"})
+
+
+def test_rs_parity_multichannel_tiny_chunks():
+    """Streaming multi-channel RS half with adversarially small chunks:
+    chunk edges change WHEN reductions run, never what they compute."""
+    run_workers(4, "parity", timeout=240, worker=RS_WORKER,
+                extra_env={"HOROVOD_NUM_CHANNELS": "3",
+                           "HOROVOD_CHUNK_BYTES": "64"})
+
+
+def test_rs_parity_star_small_path():
+    """With the algo threshold cranked up every eligible tensor takes the
+    star fold + shard scatter; parity must hold there too (the fold
+    emulates the ring's exact per-segment order)."""
+    run_workers(4, "parity", timeout=240, worker=RS_WORKER,
+                extra_env={"HOROVOD_ALGO_THRESHOLD": str(1 << 20)})
+
+
+def test_rs_parity_two_level_hierarchy():
+    """2 hosts x 2 ranks (synthetic HOST_KEY grouping): aligned shapes
+    take the hierarchical RS (intra fold -> cross RS half -> member
+    shard scatter), unaligned ones the fallback — parity is bitwise vs
+    the two-level allreduce either way."""
+    run_workers(4, "parity", timeout=240, worker=RS_WORKER,
+                per_rank_env=lambda r: {"HOROVOD_HOST_KEY": f"h{r // 2}"})
+
+
+def test_rs_parity_two_level_interleaved_groups():
+    """Interleaved host grouping (ranks 0,2 on one host): host blocks
+    cannot subdivide the cross segments, so EVERY shape must take the
+    exact-parity fallback — bits still equal the sliced allreduce."""
+    run_workers(4, "parity", timeout=240, worker=RS_WORKER,
+                per_rank_env=lambda r: {"HOROVOD_HOST_KEY": f"h{r % 2}"})
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_rs_cached_negotiation_parity(n):
+    """Steady-state re-enqueues settle via cache-slot bits; the replayed
+    responses must execute with identical bits (and actually hit)."""
+    run_workers(n, "cached", timeout=180, worker=RS_WORKER)
+
+
+def test_rs_wire_dtypes_parity_and_fallback_accounting():
+    """The codec seam: fp16/bf16 ride the RS half (no fallback);
+    int8/fp8 take the exact-parity fallback — bitwise vs the SAME-wire
+    allreduce either way, with the fallback counter proving which path
+    ran."""
+    run_workers(4, "wire", timeout=240, worker=RS_WORKER)
+
+
+def test_rs_wire_bytes_half_of_allreduce():
+    """The deterministic byte counters: a 4 MB aligned reducescatter
+    moves (N-1)/N bytes per rank vs the allreduce's 2(N-1)/N — gated at
+    [0.40, 0.55]x."""
+    run_workers(4, "bytes", timeout=240, worker=RS_WORKER)
+
+
+# ---------------------------------------------------------------------------
+# Sharded (ZeRO-1) optimizer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_sharded_numpy_core_parity_memory_bytes(n):
+    """FlatSharder core at 2 and 4 ranks: bit parity vs the unsharded
+    flat step after every step, state ~1/N, RS <= 0.55x allreduce tx,
+    full step ~1.0x (the honest ZeRO accounting)."""
+    run_workers(n, "numpy", timeout=180, worker=SHARDED_WORKER)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_sharded_jax_optax_bitwise(n):
+    """DistributedOptimizer(optax.adam, sharded=True) == unsharded flat
+    adam, bit-for-bit, with shard-sized inner state."""
+    run_workers(n, "jax", timeout=240, worker=SHARDED_WORKER,
+                extra_env={"JAX_PLATFORMS": "cpu"})
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_sharded_torch_bitwise(n):
+    """torch DistributedOptimizer(sharded=True) == unsharded flat
+    SGD+momentum, bit-for-bit, with measured ~1/N optimizer-state
+    bytes."""
+    run_workers(n, "torch", timeout=240, worker=SHARDED_WORKER)
+
+
+def test_sharded_torch_mixed_precision_master_weights():
+    """bf16 params with fp32 master shards: ranks land on identical
+    bf16 bytes and track the fp32 shadow within bf16 resolution."""
+    run_workers(2, "torch_mixed", timeout=240, worker=SHARDED_WORKER)
+
+
+# ---------------------------------------------------------------------------
+# Backup-worker auto mode (HOROVOD_BACKUP_WORKERS=auto)
+# ---------------------------------------------------------------------------
+
+def test_backup_auto_reported_and_unarmed_when_healthy():
+    run_workers(2, "backup_auto", timeout=120, worker=RS_WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "auto",
+                           "HOROVOD_BACKUP_AUTO_RATIO": "2.5"})
+
+
+@pytest.mark.straggler
+@pytest.mark.slow
+def test_backup_auto_arms_under_straggler():
+    """A rank stalling 120 ms on every 12th step inflates p99 >> 3*p50;
+    the coordinator must arm k=1 and the straggler must start seeing
+    clean StepSkipped outcomes (runs in the ci straggler gate)."""
+    run_workers(4, "backup_auto_arms", timeout=300, worker=RS_WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "auto"})
+
+
+# ---------------------------------------------------------------------------
+# Single-process semantics (tier-1, no subprocesses)
+# ---------------------------------------------------------------------------
+
+def test_shard_bounds_match_engine_convention():
+    from horovod_tpu.runtime.sharded import shard_bounds
+
+    assert shard_bounds(7, 4) == [(0, 2), (2, 2), (4, 2), (6, 1)]
+    assert shard_bounds(8, 4) == [(0, 2), (2, 2), (4, 2), (6, 2)]
+    assert shard_bounds(3, 4) == [(0, 1), (1, 1), (2, 1), (3, 0)]
+
+
+def test_resize_raises_clean_error():
+    from horovod_tpu.runtime.sharded import (FlatSharder,
+                                             ShardResizeError)
+
+    sh = FlatSharder(100, np.float32, name="t")
+    sh.size += 1  # simulate a committed world-size change under us
+    with pytest.raises(ShardResizeError) as ei:
+        sh.check_world()
+    assert "Rebuild the optimizer" in str(ei.value)
+
+
+def test_sharded_world_of_one_is_identity_plumbing():
+    from horovod_tpu.runtime.sharded import FlatSharder
+
+    sh = FlatSharder(11, np.float32, name="t1")
+    g = np.arange(11, dtype=np.float32)
+    out = sh.step(g, lambda sg: sg * 2.0, average=True)
+    assert np.array_equal(out, g * 2.0)
+
+
+def test_jax_sharded_requires_fp32_and_rejects_topk():
+    import optax
+
+    import horovod_tpu.jax as hvd
+    from horovod_tpu.ops.compression import Compression
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), sharded=True)
+    import jax.numpy as jnp
+
+    with pytest.raises(TypeError, match="float32"):
+        opt.init({"w": jnp.zeros(4, dtype=jnp.bfloat16)})
+
+    opt2 = hvd.DistributedOptimizer(
+        optax.sgd(0.1), sharded=True, compression=Compression.topk(0.1))
+    with pytest.raises(ValueError, match="top-k"):
+        opt2.init({"w": jnp.zeros(4, dtype=jnp.float32)})
+
+
+def test_sharded_and_local_sgd_mutually_exclusive():
+    import optax
+
+    import horovod_tpu.jax as hvd
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), sharded=True,
+                                 local_sgd_steps=4)
+
+
+def test_sharded_rejects_reduce_gradients_false():
+    import optax
+
+    import horovod_tpu.jax as hvd
+
+    with pytest.raises(ValueError, match="reduce_gradients=True"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), sharded=True,
+                                 reduce_gradients=False)
+
+
+def test_torch_sharded_env_local_sgd_default_still_exclusive(monkeypatch):
+    """The HOROVOD_LOCAL_SGD_STEPS env default must hit the same
+    exclusivity wall as an explicit kwarg — a requested local-SGD
+    cadence is never silently dropped (jax parity)."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    monkeypatch.setenv("HOROVOD_LOCAL_SGD_STEPS", "8")
+    w = torch.nn.Parameter(torch.zeros(4))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        hvd.DistributedOptimizer(torch.optim.SGD([w], lr=0.1),
+                                 sharded=True)
+
+
+def test_torch_sharded_lr_scheduler_via_shard_optimizer():
+    """torch LR schedulers type-check their argument; the supported
+    handle is opt.shard_optimizer (the real Optimizer driving the
+    update), and stepping it moves the lr the update actually uses."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    w = torch.nn.Parameter(torch.zeros(8))
+    opt = hvd.DistributedOptimizer(torch.optim.SGD([w], lr=0.1),
+                                   sharded=True)
+    sched = torch.optim.lr_scheduler.StepLR(opt.shard_optimizer,
+                                            step_size=1, gamma=0.5)
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.1)
+    w.grad = torch.ones(8)
+    opt.step()
+    sched.step()
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.05)
+
+
+def test_torch_sharded_requires_single_param_group():
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    w = torch.nn.Parameter(torch.zeros(4))
+    b = torch.nn.Parameter(torch.zeros(2))
+    base = torch.optim.SGD([{"params": [w]},
+                            {"params": [b], "lr": 0.5}], lr=0.1)
+    with pytest.raises(ValueError, match="single param group"):
+        hvd.DistributedOptimizer(base, sharded=True)

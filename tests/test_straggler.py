@@ -109,6 +109,38 @@ def test_local_sgd_h8_closed_form():
     run_workers(4, "h8", timeout=120, worker=LOCAL_SGD_WORKER)
 
 
+def test_torch_local_sgd_topk_anchors_pre_step_params():
+    """Under top-k the anchor VALUES are load-bearing (reconstruction is
+    anchor + avg(delta)): torch's step() must anchor the PRE-step params
+    — the last cross-rank-identical state — never the post-local-step
+    ones (whose per-rank offsets would bake into every future sync)."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.torch.compression import Compression
+
+    w = torch.nn.Parameter(torch.full((4,), 5.0))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD([w], lr=1.0), local_sgd_steps=3,
+        compression=Compression.topk(0.5))
+    w.grad = torch.ones(4)
+    opt.step()  # local step: w becomes 4.0; the anchor must hold 5.0
+    anchors = opt._local_sgd._anchor_values
+    assert anchors is not None
+    (anchor,) = anchors.values()
+    assert np.allclose(np.asarray(anchor), 5.0), anchor
+    assert np.allclose(w.detach().numpy(), 4.0)
+
+
+def test_local_sgd_topk_outer_sync_converges():
+    """Local-SGD outer sync over the TOP-K SPARSE path at 4 ranks:
+    the model delta ships as its k largest entries with its own
+    epoch-stamped error-feedback residuals (local_sgd.delta.*), the
+    wire is the sparse allgather path (sparse_count counts it), and the
+    run converges to the consensus optimum within the pinned bound."""
+    run_workers(4, "topk", timeout=180, worker=LOCAL_SGD_WORKER)
+
+
 # ---------------------------------------------------------------------------
 # Local-SGD policy + frontend wiring (single-process: tier-1)
 # ---------------------------------------------------------------------------
