@@ -183,6 +183,31 @@ def _sweep_worker() -> None:
     basics.shutdown()
 
 
+def _fleet_worker() -> None:
+    """Fleet-telemetry snapshot source for the BENCH json: a short
+    4-rank workload with per-cycle TELEM, quiesced so the fleet table
+    converges, then rank 0 prints the table (the soak trend artifacts
+    of ROADMAP item 5 ride these `fleet_` keys)."""
+    import json as _json
+    import time as _time
+
+    import numpy as np
+
+    basics, eng = _engine_setup()
+    x = np.ones(1 << 16, dtype=np.float32)
+    for i in range(12):
+        eng.allreduce(x.copy(), name=f"fleet.t{i % 3}")
+    eng.allreduce(np.ones(4, dtype=np.float32), name="fleet.barrier")
+    _time.sleep(1.0)  # idle cycles flush the final TELEM deltas
+    if basics.rank() == 0:
+        _time.sleep(0.3)
+        print("FLEET_SNAPSHOT " + _json.dumps(basics.fleet_stats()),
+              flush=True)
+    else:
+        _time.sleep(0.5)
+    basics.shutdown()
+
+
 def _rs_sweep_worker() -> None:
     """Reduce-scatter bus bandwidth ((N-1)/N · bytes / wall — half the
     allreduce numerator, matching the RS wire pattern) from the
@@ -788,6 +813,30 @@ def main() -> None:
     result["allreduce_bus_bw_mb_s_autotuned"] = autotuned
     result["autotune_committed_config"] = autotune_cfg
 
+    # Fleet-telemetry snapshot (docs/observability.md): the per-rank
+    # counter table rank 0 aggregated over a short 4-rank run, flattened
+    # under the `fleet_` prefix so nightly soak artifacts can trend the
+    # fleet view next to the per-process numbers.
+    try:
+        out = _run_ranks(4, [sys.executable, os.path.abspath(__file__),
+                             "--fleet-worker"],
+                         extra_env={"HOROVOD_TELEMETRY_CYCLES": "1",
+                                    "HOROVOD_CYCLE_TIME": "2"})
+        m = re.search(r"FLEET_SNAPSHOT (.*)", out)
+        if m:
+            fleet = json.loads(m.group(1))
+            result["fleet_ranks_reporting"] = fleet.get("ranks_reporting")
+            result["fleet_quorum_lag_ns_p50"] = fleet.get(
+                "quorum_lag_ns_p50")
+            result["fleet_quorum_lag_ns_p99"] = fleet.get(
+                "quorum_lag_ns_p99")
+            result["fleet_slowest_rank"] = fleet.get("slowest", {}).get(
+                "rank")
+            for key, v in fleet.get("totals", {}).items():
+                result[f"fleet_{key}"] = v
+    except RuntimeError as exc:
+        print(f"fleet snapshot skipped: {exc}", file=sys.stderr)
+
     # Big-world control-plane sweep (tests/scale harness): cycle latency,
     # coordinator control-cycle percentiles, rendezvous time and
     # steady-state negotiation bytes/cycle vs world size, hierarchical
@@ -1144,6 +1193,8 @@ if __name__ == "__main__":
         _wire_sweep_worker()
     elif "--wire-gate-worker" in sys.argv:
         _wire_gate_worker()
+    elif "--fleet-worker" in sys.argv:
+        _fleet_worker()
     elif "--rs-sweep-worker" in sys.argv:
         _rs_sweep_worker()
     elif "--sharded-bytes-worker" in sys.argv:

@@ -27,7 +27,7 @@ echo "== test suite (8-device virtual CPU mesh) =="
 # Caller args go BEFORE the marker filter so a user-passed -m cannot
 # override it — the fault tests must only ever run under the hard
 # timeout below (a reintroduced hang would otherwise eat the CI budget).
-PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler"
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability"
 
 echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # These tests previously WOULD HANG when a rank died mid-collective; the
@@ -36,7 +36,7 @@ echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # AND slow) get their own budget below, and the shrink test runs in its
 # dedicated gate — not twice.
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
-    python -m pytest tests/ -q -m "fault and not slow and not scale" \
+    python -m pytest tests/ -q -m "fault and not slow and not scale and not observability" \
     --deselect tests/test_fault_tolerance.py::test_shrink_to_survivors_completes_at_smaller_size
 
 echo "== chaos membership soak (seeded multi-failure, hard timeout) =="
@@ -71,7 +71,24 @@ echo "== straggler gate (slow faults at 4 ranks, p99 + convergence, hard timeout
 # cached-partial semantics tests stay fast + unmarked in the main sweep.
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
     python -m pytest tests/test_straggler.py tests/test_reducescatter.py \
+    tests/test_observability.py \
     -q -m "straggler"
+
+echo "== observability gate (fleet telemetry + abort forensics, hard timeout) =="
+# Fleet observability plane (docs/observability.md): (1) with telemetry
+# on at 4 ranks — flat AND hierarchical — the fleet table (and a LIVE
+# mid-job HTTP scrape of rank 0) must equal the sum of per-rank stats()
+# on the deterministic byte counters; (2) an injected worker death must
+# leave parseable flight-recorder dumps on every survivor whose
+# post-mortem CLI names the culprit rank and its last committed cycle;
+# (3) HOROVOD_TELEMETRY_CYCLES=0 must move ZERO telemetry bytes and
+# compute bit-identical collectives (the wire-parity contract), with
+# the telemetry-on steady-state negotiation bytes/cycle within 10% of
+# off.  The straggler-marked backup=auto quorum-rule tests run in the
+# straggler gate above, not here; the hard timeout is the hang detector
+# for the endpoint/scrape plumbing.
+PALLAS_AXON_POOL_IPS= timeout -k 15 700 \
+    python -m pytest tests/test_observability.py -q -m "not straggler"
 
 echo "== control-plane cache gate (2 ranks, 50 steps, hard timeout) =="
 # Regression gate for the negotiation response cache: a steady-state

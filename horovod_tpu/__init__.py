@@ -18,6 +18,7 @@ SURVEY.md §7 steps 5-6.)
 from horovod_tpu import elastic
 from horovod_tpu.common import (
     epoch,
+    fleet_stats,
     init,
     is_initialized,
     local_rank,
@@ -40,5 +41,6 @@ __all__ = [
     "local_rank",
     "local_size",
     "epoch",
+    "fleet_stats",
     "mpi_threads_supported",
 ]

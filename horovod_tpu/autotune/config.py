@@ -165,9 +165,15 @@ KNOBS: List[Knob] = [
          "window ratio; docs/elastic.md 'Straggler tolerance')"),
     Knob("HOROVOD_BACKUP_AUTO_RATIO", "3.0",
          lambda raw: raw or "3.0",
-         "HOROVOD_BACKUP_WORKERS=auto arming threshold on the "
-         "step_time_ns_p99/p50 window ratio (>=64 samples; reported in "
-         "stats()['config'] as backup_auto/backup_armed)"),
+         "steptime-rule arming threshold on the step_time_ns_p99/p50 "
+         "window ratio (>=64 samples; reported in stats()['config'] as "
+         "backup_auto/backup_armed)"),
+    Knob("HOROVOD_BACKUP_AUTO_RULE", "quorum",
+         lambda raw: raw if raw in ("quorum", "steptime") else "quorum",
+         "backup=auto arming instrument: 'quorum' arms k=1 while the "
+         "per-entry quorum-lag p50 exceeds the grace window (sees a "
+         "straggling rank 0 too); 'steptime' keeps the legacy rank-0 "
+         "completion-latency rule (docs/observability.md)"),
     Knob("HOROVOD_BACKUP_GRACE_MS", "50",
          lambda raw: str(max(0, _int_env(raw, 50))),
          "minimum pending age before a partial commit may skip a rank"),
@@ -183,6 +189,36 @@ KNOBS: List[Knob] = [
          "local-SGD periodic sync: H local steps per outer model-delta "
          "allreduce (1 = fully synchronous, byte-identical; "
          "DistributedOptimizer(local_sgd_steps=))"),
+    Knob("HOROVOD_TELEMETRY_CYCLES", "50",
+         lambda raw: str(max(0, _int_env(raw, 50))),
+         "fleet telemetry cadence: every N negotiation cycles each rank "
+         "piggybacks counter deltas on its control frame; rank 0 keeps "
+         "the fleet table (hvd.fleet_stats(); 0 disables — frames are "
+         "then byte-identical to the pre-telemetry wire)"),
+    Knob("HOROVOD_METRICS_PORT", "(unset: off)",
+         lambda raw: raw or "(unset: off)",
+         "rank 0 serves Prometheus text on /metrics and JSON on /json "
+         "over HTTP at this port; query live with `python -m "
+         "horovod_tpu.run --status host:port` (docs/observability.md)"),
+    Knob("HOROVOD_FLIGHT_RECORDER_EVENTS", "256",
+         lambda raw: str(max(0, min(1 << 16, _int_env(raw, 256)))),
+         "in-memory ring of the last N control-plane events per rank "
+         "(0 disables recording)"),
+    Knob("HOROVOD_FLIGHT_RECORDER_DIR", "(unset: no dumps)",
+         lambda raw: raw or "(unset: no dumps)",
+         "flight-recorder dump sink: flightrec.rank<r>.json written on "
+         "abort, stall-warning escalation and fatal signals; post-mortem "
+         "via `python -m horovod_tpu.monitor.postmortem <dir>`"),
+    Knob("HOROVOD_TIMELINE_ALL_RANKS", "0",
+         lambda raw: str(_int_env(raw, 0)),
+         "1 = every rank writes HOROVOD_TIMELINE + '.rank<r>'; merge "
+         "into one clock-aligned Chrome trace with `python -m "
+         "horovod_tpu.timeline merge` (docs/timeline.md)"),
+    Knob("HOROVOD_TIMELINE_MAX_MB", "0 (unbounded)",
+         lambda raw: str(max(0, _int_env(raw, 0))),
+         "timeline rotation: past this size the file is terminated as "
+         "valid JSON, kept as '<path>.old', and the newest events "
+         "continue at the configured path"),
     Knob("HOROVOD_ELASTIC", "0", lambda raw: str(_int_env(raw, 0)),
          "in-place elastic membership"),
     Knob("HOROVOD_AUTOTUNE", "0", lambda raw: str(_int_env(raw, 0)),

@@ -13,6 +13,7 @@ size = basics.size
 local_rank = basics.local_rank
 local_size = basics.local_size
 epoch = basics.epoch
+fleet_stats = basics.fleet_stats
 mpi_threads_supported = basics.mpi_threads_supported
 
 __all__ = [
@@ -26,5 +27,6 @@ __all__ = [
     "local_rank",
     "local_size",
     "epoch",
+    "fleet_stats",
     "mpi_threads_supported",
 ]

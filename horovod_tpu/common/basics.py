@@ -308,6 +308,7 @@ class HorovodBasics:
                     self._size = int(self._lib.horovod_size())
             self._initialized = True
             self._maybe_start_autotuner()
+            self._maybe_start_monitor()
             if not self._atexit_registered:
                 # Reference registers shutdown via atexit (common/__init__.py:69).
                 atexit.register(self.shutdown)
@@ -330,7 +331,58 @@ class HorovodBasics:
 
         start_autotuner(get_engine())
 
+    def _maybe_start_monitor(self) -> None:
+        """Start the live metrics endpoint on rank 0 when
+        HOROVOD_METRICS_PORT is set (default unset: no thread, no
+        socket — provably off).  Serves Prometheus text on /metrics and
+        JSON on /json from the engine's stats() + fleet table; see
+        docs/observability.md."""
+        port_raw = os.environ.get("HOROVOD_METRICS_PORT", "")
+        if self._lib is None or self._rank != 0 or port_raw in ("", "0"):
+            return
+        try:
+            port = int(port_raw)
+        except ValueError:
+            import sys
+
+            print(f"horovod_tpu: bad HOROVOD_METRICS_PORT={port_raw!r}; "
+                  "metrics endpoint disabled", file=sys.stderr)
+            return
+        from horovod_tpu.monitor.server import start_metrics_server
+        from horovod_tpu.runtime.engine import get_engine
+
+        import sys
+
+        eng = get_engine()
+        try:
+            bound = start_metrics_server(port, eng.stats, eng.fleet_stats)
+        except (OSError, RuntimeError) as exc:
+            # Monitoring must degrade, never fail init: a busy port
+            # (stale job, two jobs on one box) costs the endpoint, not
+            # the training run.
+            print(f"horovod_tpu: metrics endpoint disabled: {exc}",
+                  file=sys.stderr)
+            return
+        print(f"horovod_tpu: metrics endpoint on :{bound} "
+              "(/metrics /json /fleet)", file=sys.stderr)
+
+    def fleet_stats(self) -> dict:
+        """Rank 0's fleet telemetry table (``{}`` on workers, with
+        telemetry off, or before the first TELEM frame) — see
+        :meth:`horovod_tpu.runtime.engine.NativeEngine.fleet_stats`."""
+        if self._lib is None:
+            return {}
+        from horovod_tpu.runtime.engine import get_engine
+
+        return get_engine().fleet_stats()
+
     def shutdown(self) -> None:
+        # Stop the monitor first: it only reads counters, but its
+        # providers must not race the native teardown's state swaps.
+        if os.environ.get("HOROVOD_METRICS_PORT", "") not in ("", "0"):
+            from horovod_tpu.monitor.server import stop_metrics_server
+
+            stop_metrics_server()
         # Stop the tuner BEFORE taking the lock and the engine down: its
         # thread only reads counters/queues frames, but it must not race
         # the native shutdown with a TUNE proposal.

@@ -309,6 +309,68 @@ int horovod_autotune_set(int64_t chunk_bytes, int64_t fusion_threshold,
                                  wire_dtype, commit != 0);
 }
 
+// -- fleet observability plane (HOROVOD_TELEMETRY_CYCLES /
+//    HOROVOD_FLIGHT_RECORDER_*) --
+
+// Telemetry cadence in force (0 = off: frames byte-identical to the
+// pre-telemetry wire), bytes the TELEM piggyback added to this rank's
+// control frames, and stalled-tensor warnings emitted by this process
+// (the horovod_stall_warnings_total metric's source).
+int64_t horovod_telemetry_cycles() {
+  return Engine::Get().telemetry_cycles();
+}
+int64_t horovod_telem_bytes_tx() { return Engine::Get().telem_bytes_tx(); }
+int64_t horovod_stall_warnings() { return Engine::Get().stall_warnings(); }
+
+// Rendezvous-estimated monotonic clock offset to rank 0 (rank0_now ≈
+// my_now + offset; 0 on rank 0) — the merged timeline's alignment term.
+int64_t horovod_clock_offset_ns() {
+  return Engine::Get().clock_offset_ns();
+}
+
+// Coordinator quorum-lag percentiles: per committed negotiation, how
+// long the LAST voter trailed the second-to-last.  The default
+// HOROVOD_BACKUP_WORKERS=auto rule arms from these (rule: 0 = quorum,
+// 1 = steptime via HOROVOD_BACKUP_AUTO_RULE).
+int64_t horovod_quorum_lag_ns_p50() {
+  return Engine::Get().quorum_lag_ns_p50();
+}
+int64_t horovod_quorum_lag_ns_p99() {
+  return Engine::Get().quorum_lag_ns_p99();
+}
+int64_t horovod_backup_auto_rule() {
+  return static_cast<int64_t>(Engine::Get().backup_auto_rule());
+}
+
+// Rank 0's fleet table as JSON (per-rank/per-host rows of telemetry
+// counter sums, step-time gauges, slowest-rank attribution, quorum-lag
+// percentiles).  Fills buf when it fits; ALWAYS returns the required
+// byte length (excluding the NUL) so callers can retry with a bigger
+// buffer.  Number of rows via horovod_fleet_rows.
+int64_t horovod_fleet_json(char* buf, int64_t buflen) {
+  std::string json = Engine::Get().FleetJson();
+  if (buf != nullptr && buflen > 0) {
+    size_t n = std::min(json.size(), static_cast<size_t>(buflen - 1));
+    memcpy(buf, json.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int64_t>(json.size());
+}
+int64_t horovod_fleet_rows() { return Engine::Get().fleet_rows(); }
+
+// Flight recorder: events recorded / dumps written so far, and a manual
+// dump trigger (tests, operator tooling).  Dumps land in
+// HOROVOD_FLIGHT_RECORDER_DIR as flightrec.rank<r>.json.
+int64_t horovod_flight_events() {
+  return hvd::GlobalFlightRecorder().events_recorded();
+}
+int64_t horovod_flight_dumps() {
+  return hvd::GlobalFlightRecorder().dumps_written();
+}
+int horovod_flight_dump(const char* reason) {
+  return Engine::Get().FlightDump(reason ? reason : "manual dump");
+}
+
 // Why the engine aborted, copied into buf (truncated to buflen-1); empty
 // while the engine is healthy or after a clean shutdown.  Lets callers
 // attach the culprit rank to enqueues attempted AFTER the abort, whose

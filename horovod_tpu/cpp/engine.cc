@@ -689,6 +689,35 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   backup_grace_ms_ =
       static_cast<int>(EnvInt64("HOROVOD_BACKUP_GRACE_MS", 50));
   if (backup_grace_ms_ < 0) backup_grace_ms_ = 0;
+  // HOROVOD_BACKUP_AUTO_RULE: which instrument arms backup=auto —
+  // "quorum" (default: per-entry quorum-lag percentiles, sees every
+  // rank including a straggling coordinator) or "steptime" (the PR 12
+  // rule on rank 0's own completion-latency window, kept for
+  // comparability).
+  backup_auto_rule_ = 0;
+  if (const char* rule = std::getenv("HOROVOD_BACKUP_AUTO_RULE");
+      rule != nullptr && std::strcmp(rule, "steptime") == 0) {
+    backup_auto_rule_ = 1;
+  }
+  // Fleet telemetry cadence: every N negotiation cycles each rank
+  // piggybacks counter deltas on its control frame (0 disables —
+  // provably zero wire bytes: the TELEM section is simply absent).
+  telemetry_cycles_ = EnvInt64("HOROVOD_TELEMETRY_CYCLES", 50);
+  if (telemetry_cycles_ < 0) telemetry_cycles_ = 0;
+  // A new incarnation starts a fresh fleet table (re-ranked rows from a
+  // dead world would mix identities); telem_last_ deliberately SURVIVES
+  // so counter deltas stay exact across the re-init.
+  {
+    std::lock_guard<std::mutex> lk(fleet_mu_);
+    fleet_rows_.clear();
+    quorum_attr_.clear();
+  }
+  {
+    std::lock_guard<std::mutex> lk(quorum_mu_);
+    quorum_lag_samples_.clear();
+    quorum_lag_next_ = 0;
+  }
+  stall_last_warned_.clear();
   // A dead incarnation's banked skip tokens are meaningless in the new
   // world (fresh epoch, fresh commits).
   skip_tokens_.clear();
@@ -862,11 +891,6 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       break;
     }
   }
-  const char* timeline_path = std::getenv("HOROVOD_TIMELINE");
-  if (timeline_path != nullptr && timeline_path[0] != '\0' && rank_ == 0) {
-    timeline_.Initialize(timeline_path);
-  }
-
   if (size_ > 1) {
     std::string host = "127.0.0.1";
     int port = 0;
@@ -1136,6 +1160,39 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     epoch_.fetch_add(1);
   }
 
+  // Timeline: initialized AFTER rendezvous so the file name reflects the
+  // COMMITTED rank (an elastic re-rank would otherwise mislabel tracks)
+  // and the header can carry the rendezvous-estimated clock offset.
+  // Rank 0 keeps the exact HOROVOD_TIMELINE path (back-compat);
+  // HOROVOD_TIMELINE_ALL_RANKS=1 adds "<path>.rank<r>" per worker so
+  // `python -m horovod_tpu.timeline merge` can build the fleet view.
+  if (const char* tl = std::getenv("HOROVOD_TIMELINE");
+      tl != nullptr && tl[0] != '\0') {
+    timeline_.SetMaxBytes(EnvInt64("HOROVOD_TIMELINE_MAX_MB", 0) << 20);
+    if (rank_ == 0) {
+      timeline_.Initialize(tl);
+    } else if (EnvInt64("HOROVOD_TIMELINE_ALL_RANKS", 0) != 0) {
+      timeline_.Initialize(std::string(tl) + ".rank" +
+                           std::to_string(rank_));
+    }
+    timeline_.SetMeta(rank_, epoch_.load(), clock_offset_ns_);
+  }
+  // Flight recorder: ring is in-memory always (capacity knob); dumps
+  // need a sink dir.  The fatal-signal handlers are installed only when
+  // a sink exists — without one a dump is a no-op anyway, and default
+  // signal dispositions stay untouched.
+  {
+    int cap =
+        static_cast<int>(EnvInt64("HOROVOD_FLIGHT_RECORDER_EVENTS", 256));
+    const char* dir = std::getenv("HOROVOD_FLIGHT_RECORDER_DIR");
+    GlobalFlightRecorder().Configure(cap, dir ? dir : "", rank_,
+                                     epoch_.load(), clock_offset_ns_);
+    if (dir != nullptr && dir[0] != '\0') InstallFlightSignalHandlers();
+    GlobalFlightRecorder().Record(
+        "epoch", control_cycle_seq_,
+        "committed epoch=%lld rank=%d size=%d hosts=%d",
+        static_cast<long long>(epoch_.load()), rank_, size_, nnodes_);
+  }
   last_stall_check_ = std::chrono::steady_clock::now();
   last_sub_stall_check_ = last_stall_check_;
   last_exec_time_ = std::chrono::steady_clock::now();
@@ -1151,6 +1208,22 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
 // scanner) must never be mistaken for a membership candidate — in the
 // mid-run path that mistake would abort the whole world.
 static constexpr uint32_t kJoinMagic = 0x4e4a5648u;
+
+// Clock-sync ping ("HVPG"), folded into the JOIN/ASSIGN handshake: right
+// after adopting its ASSIGN each worker runs kClockPings request/reply
+// rounds against the coordinator's rendezvous conn and keeps the min-RTT
+// midpoint estimate of rank 0's monotonic clock vs its own — the offset
+// the merged timeline and the flight-recorder post-mortem align tracks
+// with.  Serial per-worker service is fine: only a worker's FIRST round
+// can queue behind another worker's service, and min-RTT discards it.
+static constexpr uint32_t kPingMagic = 0x47505648u;
+static constexpr int kClockPings = 5;
+
+static int64_t MonoNowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
 
 // Coordinator-led membership rendezvous (see engine.h).  The first init
 // (and every non-elastic re-init) requires the full env world within
@@ -1389,6 +1462,34 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     }
     assign_bytes_tx_.fetch_add(static_cast<int64_t>(w.bytes().size()) + 8);
   }
+  // Clock-sync service (see kPingMagic): each worker pings right after
+  // parsing its ASSIGN; serve every member's rounds before the cycle
+  // loop takes over the conns.
+  for (int r = 1; r < new_size; ++r) {
+    for (int k = 0; k < kClockPings; ++k) {
+      std::vector<uint8_t> pf;
+      if (!conns[r].RecvFrame(&pf)) {
+        last_error_ = "clock-sync ping from worker id " +
+                      std::to_string(member_ids[r]) + " failed";
+        return 1;
+      }
+      Reader pr(pf.data(), pf.size());
+      uint32_t magic = pr.u32();
+      (void)pr.i64();  // worker's t0 (only the worker needs it)
+      if (!pr.ok() || magic != kPingMagic) {
+        last_error_ = "bad clock-sync ping frame";
+        return 1;
+      }
+      Writer pw;
+      pw.i64(MonoNowNs());
+      if (!conns[r].SendFrame(pw.bytes())) {
+        last_error_ = "clock-sync reply to worker id " +
+                      std::to_string(member_ids[r]) + " failed";
+        return 1;
+      }
+    }
+  }
+  clock_offset_ns_ = 0;  // rank 0 IS the reference clock
   worker_conns_.clear();
   worker_conns_.resize(new_size);
   for (int r = 1; r < new_size; ++r) worker_conns_[r] = std::move(conns[r]);
@@ -1539,6 +1640,38 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
                    worker_id_, static_cast<long long>(new_epoch), new_rank,
                    new_size);
     }
+    // Clock-offset estimation against the coordinator (see kPingMagic):
+    // min-RTT midpoint over kClockPings rounds on the still-open
+    // rendezvous conn.  rank0_mono ≈ my_mono + clock_offset_ns_.
+    {
+      int64_t best_rtt = std::numeric_limits<int64_t>::max();
+      int64_t best_off = 0;
+      for (int k = 0; k < kClockPings; ++k) {
+        Writer pw;
+        pw.u32(kPingMagic);
+        pw.i64(MonoNowNs());
+        const int64_t t0 = MonoNowNs();
+        std::vector<uint8_t> pf;
+        if (!coordinator_conn_.SendFrame(pw.bytes()) ||
+            !coordinator_conn_.RecvFrame(&pf)) {
+          last_error_ = "clock-sync exchange with the coordinator failed";
+          return 1;
+        }
+        const int64_t t1 = MonoNowNs();
+        Reader pr(pf.data(), pf.size());
+        const int64_t tc = pr.i64();
+        if (!pr.ok()) {
+          last_error_ = "bad clock-sync reply frame";
+          return 1;
+        }
+        const int64_t rtt = t1 - t0;
+        if (rtt < best_rtt) {
+          best_rtt = rtt;
+          best_off = tc - (t0 + rtt / 2);
+        }
+      }
+      clock_offset_ns_ = best_off;
+    }
     rank_ = new_rank;
     size_ = new_size;
     epoch_.store(new_epoch);
@@ -1647,6 +1780,16 @@ void Engine::BackgroundLoop() {
       ? "Horovod has been shut down. This was caused by an exception on one "
         "of the ranks or an attempt to enqueue after shutdown."
       : abort_reason_;
+  if (!abort_reason_.empty()) {
+    // The world is dying abnormally: flush the last timeline events (the
+    // cycle before a crash must never be lost to stdio buffering) and
+    // dump the flight recorder for the post-mortem CLI.  A clean
+    // shutdown dumps nothing — the recorder is a crash artifact.
+    GlobalFlightRecorder().Record("abort", control_cycle_seq_, "%s",
+                                  abort_reason_.c_str());
+    GlobalFlightRecorder().Dump(abort_reason_.c_str());
+    timeline_.Flush();
+  }
   std::vector<TensorTableEntry> leftovers;
   {
     std::lock_guard<std::mutex> lk(mu_);
@@ -1943,6 +2086,8 @@ std::string Engine::TransportError(const std::string& op,
 void Engine::BroadcastAbort(int culprit, const std::string& message) {
   abort_reason_ = message;
   std::fprintf(stderr, "horovod_tpu coordinator: %s\n", message.c_str());
+  GlobalFlightRecorder().Record("abort", control_cycle_seq_,
+                                "culprit=%d %s", culprit, message.c_str());
   ResponseList abort_list;
   abort_list.epoch = epoch_.load();
   abort_list.abort = true;
@@ -2048,8 +2193,35 @@ void Engine::AggregateGroup(RequestList* agg) {
       agg->fail_message = std::move(ml.fail_message);
     }
     for (auto& q : ml.requests) agg->requests.push_back(std::move(q));
+    for (auto& te : ml.telem) agg->telem.push_back(std::move(te));
     for (uint32_t s : ml.cache_evicts) evicts.insert(s);
     note_hits(ml.cache_hits, m);
+  }
+  // Telemetry aggregation: SUM the group's TELEM deltas into ONE
+  // per-host entry (deltas make this exact — each member's delta is
+  // absorbed exactly once whether it traveled merged or alone), keep
+  // the worst step-time gauge and its owning rank as the host's
+  // slowest-member attribution.  Rank 0 thereby receives O(hosts)
+  // telemetry bytes per telemetry cycle, same shape as the readiness
+  // aggregation above.
+  if (!agg->telem.empty()) {
+    TelemEntry host;
+    host.rank = rank_;
+    host.host = node_id_;
+    host.nranks = 0;
+    host.deltas.assign(TC_COUNT, 0);
+    for (const auto& te : agg->telem) {
+      host.nranks += te.nranks;
+      const size_t n = std::min<size_t>(te.deltas.size(), TC_COUNT);
+      for (size_t i = 0; i < n; ++i) host.deltas[i] += te.deltas[i];
+      if (te.step_p50 > host.step_p50) host.step_p50 = te.step_p50;
+      if (te.step_p99 > host.step_p99) host.step_p99 = te.step_p99;
+      if (te.slow_p99 >= host.slow_p99) {
+        host.slow_p99 = te.slow_p99;
+        host.slow_rank = te.slow_rank;
+      }
+    }
+    agg->telem.assign(1, std::move(host));
   }
   agg->cache_evicts.assign(evicts.begin(), evicts.end());
   // A slot evicted this very cycle can never fire: drop its held bits
@@ -2128,7 +2300,211 @@ void Engine::CheckForStalledSubBits() {
                  "submitting the tensor, which will cause deadlock.\n",
                  rank_, node_id_, kv.first, static_cast<long long>(age),
                  missing.c_str());
+    stall_warnings_.fetch_add(1);
+    GlobalFlightRecorder().Record(
+        "stall", control_cycle_seq_, "sub slot=%u age=%llds missing=%s",
+        kv.first, static_cast<long long>(age), missing.c_str());
   }
+}
+
+// ---------------------------------------------------------------------------
+// Fleet telemetry (HOROVOD_TELEMETRY_CYCLES)
+// ---------------------------------------------------------------------------
+
+const char* const kTelemCounterNames[TC_COUNT] = {
+    "data_bytes_tx",        "data_bytes_rx",
+    "allreduce_bytes",      "reducescatter_bytes",
+    "negotiation_bytes_tx", "negotiation_bytes_rx",
+    "control_round_trips",  "cache_hits",
+    "cache_misses",         "tensors",
+    "responses",            "cycles",
+    "shm_bytes_tx",         "compressed_bytes_tx",
+    "wire_bytes_saved",     "backup_skips",
+    "stale_epoch_msgs",     "stall_warnings",
+};
+
+TelemEntry Engine::BuildTelemEntry() {
+  AssertBackgroundThread();
+  TelemEntry t;
+  t.rank = rank_;
+  t.host = node_id_;
+  t.nranks = 1;
+  t.step_p50 = step_time_ns_p50();
+  t.step_p99 = step_time_ns_p99();
+  t.slow_rank = rank_;
+  t.slow_p99 = t.step_p99;
+  const int64_t cur[TC_COUNT] = {
+      data_bytes_tx_.load(),        data_bytes_rx_.load(),
+      allreduce_bytes_.load(),      reducescatter_bytes_.load(),
+      negotiation_bytes_tx_.load(), negotiation_bytes_rx_.load(),
+      control_round_trips_.load(),  cache_hits_.load(),
+      cache_misses_.load(),         tensors_executed_.load(),
+      responses_executed_.load(),   exec_cycles_.load(),
+      shm_bytes_tx_.load(),         compressed_bytes_tx_.load(),
+      wire_bytes_saved_.load(),     backup_skips_.load(),
+      stale_epoch_msgs_.load(),     stall_warnings_.load(),
+  };
+  t.deltas.resize(TC_COUNT);
+  for (int i = 0; i < TC_COUNT; ++i) {
+    t.deltas[i] = cur[i] - telem_last_[i];
+    telem_last_[i] = cur[i];
+  }
+  return t;
+}
+
+void Engine::MaybeAttachTelem(RequestList* list, bool force) {
+  if (telemetry_cycles_ <= 0) return;
+  ++telem_cycle_count_;
+  if (!force && telem_cycle_count_ % telemetry_cycles_ != 0) return;
+  list->telem.push_back(BuildTelemEntry());
+}
+
+void Engine::FleetAbsorb(const TelemEntry& t) {
+  std::lock_guard<std::mutex> lk(fleet_mu_);
+  FleetRow& row = fleet_rows_[t.rank];
+  row.nranks = t.nranks;
+  row.host = t.host;
+  const size_t n = std::min<size_t>(t.deltas.size(), TC_COUNT);
+  for (size_t i = 0; i < n; ++i) row.counters[i] += t.deltas[i];
+  row.step_p50 = t.step_p50;
+  row.step_p99 = t.step_p99;
+  row.slow_rank = t.slow_rank;
+  row.slow_p99 = t.slow_p99;
+  row.updates++;
+  row.last_update_mono_ns =
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count();
+}
+
+std::string Engine::FleetJson() const {
+  std::lock_guard<std::mutex> lk(fleet_mu_);
+  std::string out;
+  out.reserve(1024 + fleet_rows_.size() * 640);
+  char buf[256];
+  auto num = [&](const char* key, long long v, bool comma = true) {
+    std::snprintf(buf, sizeof(buf), "\"%s\": %lld%s", key, v,
+                  comma ? ", " : "");
+    out += buf;
+  };
+  out += "{";
+  num("ranks_reporting", static_cast<long long>(fleet_rows_.size()));
+  num("world_size", size_);
+  num("hosts", nnodes_);
+  num("epoch", static_cast<long long>(epoch_.load()));
+  num("telemetry_cycles", static_cast<long long>(telemetry_cycles_));
+  num("quorum_lag_ns_p50",
+      static_cast<long long>(QuorumLagNsPercentile(0.50)));
+  num("quorum_lag_ns_p99",
+      static_cast<long long>(QuorumLagNsPercentile(0.99)));
+  // Slowest-rank attribution across every row's gauge.
+  int32_t slow_rank = -1;
+  int64_t slow_p99 = 0;
+  int64_t totals[TC_COUNT] = {0};
+  for (const auto& kv : fleet_rows_) {
+    for (int i = 0; i < TC_COUNT; ++i) totals[i] += kv.second.counters[i];
+    if (kv.second.slow_p99 >= slow_p99) {
+      slow_p99 = kv.second.slow_p99;
+      slow_rank = kv.second.slow_rank;
+    }
+  }
+  out += "\"slowest\": {";
+  num("rank", slow_rank);
+  num("step_time_ns_p99", static_cast<long long>(slow_p99), false);
+  out += "}, \"totals\": {";
+  for (int i = 0; i < TC_COUNT; ++i) {
+    num(kTelemCounterNames[i], static_cast<long long>(totals[i]),
+        i + 1 < TC_COUNT);
+  }
+  out += "}, \"quorum_lag_by_rank\": {";
+  {
+    bool first = true;
+    for (const auto& kv : quorum_attr_) {
+      if (!first) out += ", ";
+      first = false;
+      std::snprintf(buf, sizeof(buf),
+                    "\"%d\": {\"attributions\": %lld, \"max_ns\": %lld}",
+                    kv.first, static_cast<long long>(kv.second.count),
+                    static_cast<long long>(kv.second.max_ns));
+      out += buf;
+    }
+  }
+  out += "}, \"rows\": [";
+  bool first = true;
+  for (const auto& kv : fleet_rows_) {
+    if (!first) out += ", ";
+    first = false;
+    out += "{";
+    num("rank", kv.first);
+    num("nranks", kv.second.nranks);
+    num("host", kv.second.host);
+    num("updates", static_cast<long long>(kv.second.updates));
+    num("step_time_ns_p50", static_cast<long long>(kv.second.step_p50));
+    num("step_time_ns_p99", static_cast<long long>(kv.second.step_p99));
+    num("slow_rank", kv.second.slow_rank);
+    num("slow_step_ns_p99", static_cast<long long>(kv.second.slow_p99));
+    num("last_update_mono_ns",
+        static_cast<long long>(kv.second.last_update_mono_ns));
+    out += "\"counters\": {";
+    for (int i = 0; i < TC_COUNT; ++i) {
+      num(kTelemCounterNames[i],
+          static_cast<long long>(kv.second.counters[i]), i + 1 < TC_COUNT);
+    }
+    out += "}}";
+  }
+  out += "]}";
+  return out;
+}
+
+int64_t Engine::fleet_rows() const {
+  std::lock_guard<std::mutex> lk(fleet_mu_);
+  return static_cast<int64_t>(fleet_rows_.size());
+}
+
+void Engine::NoteQuorumLag(
+    const std::vector<std::chrono::steady_clock::time_point>& times,
+    const std::vector<int>& voter_ranks) {
+  if (times.size() < 2 || times.size() != voter_ranks.size()) return;
+  // Last voter and second-to-last: one pass, no sort.
+  size_t last = 0;
+  for (size_t i = 1; i < times.size(); ++i) {
+    if (times[i] > times[last]) last = i;
+  }
+  auto second = std::chrono::steady_clock::time_point::min();
+  for (size_t i = 0; i < times.size(); ++i) {
+    if (i != last && times[i] > second) second = times[i];
+  }
+  const int64_t lag =
+      std::chrono::duration_cast<std::chrono::nanoseconds>(times[last] -
+                                                           second)
+          .count();
+  {
+    std::lock_guard<std::mutex> lk(quorum_mu_);
+    constexpr size_t kCap = 4096;
+    if (quorum_lag_samples_.size() < kCap) {
+      quorum_lag_samples_.push_back(lag);
+    } else {
+      quorum_lag_samples_[quorum_lag_next_ % kCap] = lag;
+    }
+    ++quorum_lag_next_;
+  }
+  std::lock_guard<std::mutex> lk(fleet_mu_);
+  QuorumAttr& attr = quorum_attr_[voter_ranks[last]];
+  attr.count++;
+  if (lag > attr.max_ns) attr.max_ns = lag;
+}
+
+int64_t Engine::QuorumLagNsPercentile(double p) const {
+  std::vector<int64_t> snap;
+  {
+    std::lock_guard<std::mutex> lk(quorum_mu_);
+    snap = quorum_lag_samples_;
+  }
+  if (snap.empty()) return 0;
+  size_t idx = static_cast<size_t>(p * (snap.size() - 1) + 0.5);
+  if (idx >= snap.size()) idx = snap.size() - 1;
+  std::nth_element(snap.begin(), snap.begin() + idx, snap.end());
+  return snap[idx];
 }
 
 void Engine::RecordCoordCycleNs(int64_t ns) {
@@ -2219,8 +2595,14 @@ bool Engine::RunLoopOnce() {
   DrainMessageQueue(&my_list);
   my_list.epoch = epoch_.load();
   my_list.shutdown = shutdown_requested_.load();
+  // Fleet telemetry rides the regular control frame (idle heartbeats
+  // included, so a quiesced fleet's counters still converge); the
+  // shutdown frame force-flushes the final deltas.
+  MaybeAttachTelem(&my_list, my_list.shutdown);
 
   if (size_ == 1) {
+    for (const auto& te : my_list.telem) FleetAbsorb(te);
+    my_list.telem.clear();
     // Single process: every tensor is instantly "globally ready".
     AssertBackgroundThread();
     for (auto& q : my_list.requests) {
@@ -2235,6 +2617,9 @@ bool Engine::RunLoopOnce() {
     for (auto& q : my_list.requests) {
       timeline_.NegotiateEnd(q.tensor_name);
       responses.push_back(BuildResponse(q.tensor_name));
+      if (responses.back().type != ResponseType::ERROR) {
+        timeline_.FlowSend(q.tensor_name, epoch_.load());
+      }
     }
     FuseResponses(responses);
     if (!responses.empty()) exec_cycles_.fetch_add(1);
@@ -2300,6 +2685,12 @@ bool Engine::RunLoopOnce() {
         return false;
       }
     }
+    // Fold every gathered TELEM entry (rank 0's own included — its
+    // frame never hits the wire but carries the entry all the same)
+    // into the fleet table.
+    for (auto& l : lists) {
+      for (const auto& te : l.telem) FleetAbsorb(te);
+    }
     ResponseList response_list = CoordinatorStep(lists);
     // Piggyback a queued autotune proposal on this cycle's broadcast;
     // every rank (the coordinator included) applies it after executing
@@ -2355,6 +2746,16 @@ bool Engine::RunLoopOnce() {
           std::chrono::duration_cast<std::chrono::nanoseconds>(
               std::chrono::steady_clock::now() - cyc0)
               .count());
+      ++control_cycle_seq_;
+      size_t nreq = 0;
+      for (const auto& l : lists) nreq += l.requests.size();
+      GlobalFlightRecorder().Record(
+          "cycle", control_cycle_seq_,
+          "reqs=%zu resp=%zu cached=%zu evict=%zu partial=%zu", nreq,
+          response_list.responses.size(),
+          response_list.cached_slots.size(),
+          response_list.evict_slots.size(),
+          response_list.partial_slots.size());
     }
     // The coordinator is a cache participant like any worker: update the
     // local replica from the list it just broadcast, execute the fully
@@ -2427,6 +2828,13 @@ bool Engine::RunLoopOnce() {
       }
     }
   };
+  // Telemetry wire accounting: what the TELEM piggyback itself costs on
+  // this rank's upstream frame (leaders count their merged entry once).
+  if (!my_list.telem.empty()) {
+    Writer tw;
+    for (const auto& te : my_list.telem) SerializeTelemEntry(te, &tw);
+    telem_bytes_tx_.fetch_add(static_cast<int64_t>(tw.bytes().size()) + 2);
+  }
   Writer w;
   SerializeRequestList(my_list, &w);
   if (fault_stale_epoch_.exchange(false)) {
@@ -2531,6 +2939,13 @@ bool Engine::RunLoopOnce() {
   // coordinator): idle heartbeat exchanges are not counted.
   if (HasPayload(my_list) || HasPayload(response_list)) {
     control_round_trips_.fetch_add(1);
+    ++control_cycle_seq_;
+    GlobalFlightRecorder().Record(
+        "cycle", control_cycle_seq_,
+        "reqs=%zu hits=%zu resp=%zu cached=%zu evict=%zu",
+        my_list.requests.size(), my_list.cache_hits.size(),
+        response_list.responses.size(), response_list.cached_slots.size(),
+        response_list.evict_slots.size());
   }
   ApplyCacheUpdates(response_list);
   // TUNE before execution — same reasoning (and the same ordering) as
@@ -2631,6 +3046,8 @@ void Engine::ApplyTune(const ResponseList& list) {
                 static_cast<long long>(algo_threshold_.load()),
                 WireDtypeName(static_cast<WireDtype>(wire_dtype_.load())));
   timeline_.TuneTrial(desc, list.tune_commit);
+  GlobalFlightRecorder().Record("tune", control_cycle_seq_, "%s%s", desc,
+                                list.tune_commit ? " (commit)" : "");
 }
 
 // Request types whose responses are pure functions of the validated
@@ -2844,6 +3261,8 @@ void Engine::CoordinatorEvictSlot(uint32_t slot, ResponseList* out) {
   AssertBackgroundThread();
   auto it = coord_slot_names_.find(slot);
   if (it == coord_slot_names_.end()) return;  // duplicate evict this cycle
+  GlobalFlightRecorder().Record("evict", control_cycle_seq_, "slot=%u %s",
+                                slot, it->second.c_str());
   coord_slot_by_name_.erase(it->second);
   coord_slot_names_.erase(it);
   coord_slot_bits_.erase(slot);
@@ -2934,8 +3353,28 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
   }
   std::sort(agreed.begin(), agreed.end());
   for (uint32_t slot : agreed) {
+    // Quorum-lag sample (how far the last voter trailed the rest) before
+    // the readiness bits are dropped; under hierarchical coordination a
+    // voter is a host group, attributed to its leader rank.
+    auto bit = coord_slot_bits_.find(slot);
+    if (bit != coord_slot_bits_.end()) {
+      std::vector<std::chrono::steady_clock::time_point> vt;
+      std::vector<int> vr;
+      for (size_t v = 0; v < bit->second.seen.size(); ++v) {
+        if (bit->second.seen[v]) {
+          vt.push_back(bit->second.seen_time[v]);
+          vr.push_back(HierActive() ? group_leaders_[v]
+                                    : static_cast<int>(v));
+        }
+      }
+      NoteQuorumLag(vt, vr);
+    }
     coord_slot_bits_.erase(slot);
     out.cached_slots.push_back(slot);
+    auto nit = coord_slot_names_.find(slot);
+    if (nit != coord_slot_names_.end()) {
+      timeline_.FlowSend(nit->second, epoch_.load());
+    }
   }
   for (auto& name : became_ready) {
     timeline_.NegotiateEnd(name);
@@ -2945,9 +3384,29 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
       for (int r = 0; it != message_table_.end() && r < size_; ++r) {
         if (it->second.requests[r].probe) any_probe = true;
       }
+      // Quorum-lag sample at rank granularity (full requests carry
+      // per-rank arrival times even under hierarchical coordination).
+      if (it != message_table_.end() && size_ > 1) {
+        std::vector<std::chrono::steady_clock::time_point> vt;
+        std::vector<int> vr;
+        for (int r = 0; r < size_; ++r) {
+          if (it->second.seen[r]) {
+            vt.push_back(it->second.seen_time[r]);
+            vr.push_back(r);
+          }
+        }
+        NoteQuorumLag(vt, vr);
+      }
     }
     Response resp = BuildResponse(name);
     resp.cache_slots.assign(resp.tensor_names.size(), -1);
+    // Cross-rank flow trace: the negotiation's commit is the flow SOURCE
+    // ("s"); every rank's execution span carries the matching sink ("f")
+    // — see Timeline::FlowSend/FlowRecv.  Errors never execute, so they
+    // never open a flow.
+    if (resp.type != ResponseType::ERROR) {
+      timeline_.FlowSend(name, epoch_.load());
+    }
     if (cache_enabled_ && !any_probe && resp.type != ResponseType::ERROR &&
         IsCacheableResponse(resp.type) &&
         static_cast<int64_t>(coord_slot_names_.size()) < cache_capacity_) {
@@ -3325,22 +3784,45 @@ void Engine::MaybePartialCommits(ResponseList* out) {
   AssertBackgroundThread();
   int k = backup_workers_;
   if (backup_auto_) {
-    // Auto mode: evaluate the arming rule each cycle on the
-    // coordinator's own completion-latency window (a straggler anywhere
-    // inflates every participant's p99, the coordinator's included).
-    // Needs a meaningfully filled window — arming off 2 samples would
-    // mistake warmup jitter for a straggler.
-    size_t nsamp;
-    {
-      std::lock_guard<std::mutex> lk(step_ns_mu_);
-      nsamp = step_ns_samples_.size();
+    bool armed;
+    if (backup_auto_rule_ == 1) {
+      // HOROVOD_BACKUP_AUTO_RULE=steptime (the PR 12 rule, kept as the
+      // documented fallback): the coordinator's own completion-latency
+      // window — cheap, but blind to rank 0 itself straggling (its own
+      // enqueue delay inflates every sample equally).
+      size_t nsamp;
+      {
+        std::lock_guard<std::mutex> lk(step_ns_mu_);
+        nsamp = step_ns_samples_.size();
+      }
+      const int64_t p50 = step_time_ns_p50();
+      const int64_t p99 = step_time_ns_p99();
+      armed = nsamp >= 64 && p50 > 0 &&
+              static_cast<double>(p99) >
+                  backup_auto_ratio_ * static_cast<double>(p50);
+    } else {
+      // Default rule: per-entry QUORUM LAG (last voter's arrival minus
+      // the second-to-last's, sampled on every committed negotiation).
+      // It measures exactly what a k=1 partial commit would save — and
+      // because arrival times are observed at the coordinator for EVERY
+      // rank's requests, a straggling rank 0 shows up like any other
+      // (closing the steptime rule's coordinator blind spot,
+      // docs/performance.md).  The threshold is the GRACE WINDOW, not a
+      // p99/p50 ratio: a persistent straggler makes lag p50 ≈ p99 (a
+      // ratio test would never fire), and grace is the exact point
+      // where an armed partial commit becomes actionable — median lag
+      // above it means the last voter would be skipped on a typical
+      // step, below it arming changes nothing.
+      size_t nsamp;
+      {
+        std::lock_guard<std::mutex> lk(quorum_mu_);
+        nsamp = quorum_lag_samples_.size();
+      }
+      const int64_t p50 = quorum_lag_ns_p50();
+      armed = nsamp >= 64 &&
+              static_cast<double>(p50) >
+                  static_cast<double>(backup_grace_ms_) * 1e6;
     }
-    const int64_t p50 = step_time_ns_p50();
-    const int64_t p99 = step_time_ns_p99();
-    const bool armed =
-        nsamp >= 64 && p50 > 0 &&
-        static_cast<double>(p99) >
-            backup_auto_ratio_ * static_cast<double>(p50);
     backup_armed_.store(armed);
     k = armed ? 1 : 0;
   }
@@ -3424,6 +3906,10 @@ void Engine::MaybePartialCommits(ResponseList* out) {
       continue;
     }
     timeline_.PartialCommit(name, RankListString(rank_in, size_, true));
+    timeline_.FlowSend(name, epoch_.load());
+    GlobalFlightRecorder().Record(
+        "partial", control_cycle_seq_, "%s skipped=%s", name.c_str(),
+        RankListString(rank_in, size_, true).c_str());
     out->responses.push_back(BuildPartialResponse(name, participants));
   }
 
@@ -3470,9 +3956,14 @@ void Engine::MaybePartialCommits(ResponseList* out) {
       continue;
     }
     auto nit = coord_slot_names_.find(slot);
-    timeline_.PartialCommit(nit == coord_slot_names_.end() ? "?"
-                                                           : nit->second,
-                            RankListString(rank_in, size_, true));
+    const std::string pname =
+        nit == coord_slot_names_.end() ? "?" : nit->second;
+    timeline_.PartialCommit(pname, RankListString(rank_in, size_, true));
+    timeline_.FlowSend(pname, epoch_.load());
+    GlobalFlightRecorder().Record(
+        "partial", control_cycle_seq_, "%s slot=%u skipped=%s",
+        pname.c_str(), slot,
+        RankListString(rank_in, size_, true).c_str());
     coord_slot_bits_.erase(slot);
     out->cached_slots.push_back(slot);
     ResponseList::PartialSlot ps;
@@ -3786,6 +4277,10 @@ void Engine::NoteSkippedResponse(const Response& response,
                                  std::vector<TensorTableEntry>& entries) {
   AssertBackgroundThread();  // skip_tokens_/pending_cache_hits_ owner
   backup_skips_.fetch_add(1);
+  GlobalFlightRecorder().Record(
+      "skipped", control_cycle_seq_, "%s",
+      response.tensor_names.empty() ? "?"
+                                    : response.tensor_names[0].c_str());
   std::set<std::string> held;
   for (auto& e : entries) held.insert(e.name);
   for (const auto& name : response.tensor_names) {
@@ -3873,6 +4368,14 @@ void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
   if (!ghost) {
     responses_executed_.fetch_add(1);
     tensors_executed_.fetch_add(static_cast<int64_t>(entries.size()));
+  }
+  // Flow sink: every executing rank closes the flow the coordinator's
+  // commit opened — one "f" per tensor name (fusion preserves the name
+  // set, so per-name flow counters stay aligned with the per-name "s"
+  // counters on rank 0).  Ghost rides execute the response too: the
+  // flow arrow correctly lands on the ghost's RING span.
+  for (const auto& name : response.tensor_names) {
+    timeline_.FlowRecv(name, epoch_.load());
   }
   switch (response.type) {
     case ResponseType::ALLREDUCE:
@@ -5549,14 +6052,45 @@ void Engine::CheckForStalledTensors() {
     }
     return missing;
   };
+  // Per-tensor rate limit (at most one warning per HOROVOD_STALL_WARNING
+  // _SEC per tensor, independent of the scan cadence), with every emitted
+  // warning counted (horovod_stall_warnings_total) and mirrored into the
+  // flight recorder.  A tensor stalled past TWICE the warning interval
+  // escalates: one flight-recorder dump per process, so the operator gets
+  // the control-plane history even when the job later limps on.
+  auto rate_limited = [&](const std::string& name) {
+    auto it = stall_last_warned_.find(name);
+    if (it != stall_last_warned_.end() &&
+        now - it->second < std::chrono::seconds(stall_warning_sec_)) {
+      return true;
+    }
+    stall_last_warned_[name] = now;
+    return false;
+  };
+  auto escalate = [&](const std::string& name, long long age) {
+    if (flight_escalated_ || age < 2ll * stall_warning_sec_) return;
+    flight_escalated_ = true;
+    GlobalFlightRecorder().Dump(
+        ("stall-warning escalation: '" + name + "' stalled " +
+         std::to_string(age) + "s")
+            .c_str());
+  };
   for (auto& kv : message_table_) {
     auto age = std::chrono::duration_cast<std::chrono::seconds>(
                    now - kv.second.first_seen)
                    .count();
-    if (age < stall_warning_sec_) continue;
+    if (age < stall_warning_sec_ || rate_limited(kv.first)) continue;
     warn_preamble();
+    const std::string missing = missing_ranks(kv.second.seen);
     std::fprintf(stderr, "%s [missing ranks: %s]\n", kv.first.c_str(),
-                 missing_ranks(kv.second.seen).c_str());
+                 missing.c_str());
+    stall_warnings_.fetch_add(1);
+    GlobalFlightRecorder().Record("stall", control_cycle_seq_,
+                                  "%s age=%llds missing=%s",
+                                  kv.first.c_str(),
+                                  static_cast<long long>(age),
+                                  missing.c_str());
+    escalate(kv.first, age);
   }
   // Cache-hit readiness bits stall the same way full requests do (a
   // subset of ranks re-enqueued a cached tensor, the rest never did).
@@ -5567,11 +6101,31 @@ void Engine::CheckForStalledTensors() {
                    now - kv.second.first_seen)
                    .count();
     if (age < stall_warning_sec_) continue;
-    warn_preamble();
     auto nit = coord_slot_names_.find(kv.first);
-    std::fprintf(stderr, "%s [cached slot %u; missing: %s]\n",
-                 nit == coord_slot_names_.end() ? "?" : nit->second.c_str(),
-                 kv.first, missing_voters(kv.second.seen).c_str());
+    const std::string name =
+        nit == coord_slot_names_.end() ? "?" : nit->second;
+    if (rate_limited(name)) continue;
+    warn_preamble();
+    const std::string missing = missing_voters(kv.second.seen);
+    std::fprintf(stderr, "%s [cached slot %u; missing: %s]\n", name.c_str(),
+                 kv.first, missing.c_str());
+    stall_warnings_.fetch_add(1);
+    GlobalFlightRecorder().Record("stall", control_cycle_seq_,
+                                  "%s slot=%u age=%llds missing=%s",
+                                  name.c_str(), kv.first,
+                                  static_cast<long long>(age),
+                                  missing.c_str());
+    escalate(name, age);
+  }
+  // Entries that resolved (or died with the world) drop out of the
+  // rate-limit map so it cannot grow without bound across a long job.
+  for (auto it = stall_last_warned_.begin();
+       it != stall_last_warned_.end();) {
+    if (now - it->second > std::chrono::seconds(4 * stall_warning_sec_)) {
+      it = stall_last_warned_.erase(it);
+    } else {
+      ++it;
+    }
   }
 }
 

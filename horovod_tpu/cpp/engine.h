@@ -33,6 +33,7 @@
 #include <cstdint>
 #include <deque>
 #include <functional>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <set>
@@ -42,12 +43,39 @@
 #include <vector>
 
 #include "common.h"
+#include "flightrec.h"
 #include "message.h"
 #include "shm.h"
 #include "socket.h"
 #include "timeline.h"
 
 namespace hvd {
+
+// Fixed order of TelemEntry::deltas (the fleet-telemetry counter set).
+// Keep in lockstep with horovod_tpu/monitor/metrics.py TELEM_COUNTERS —
+// the wire carries positions, not names.
+enum TelemCounter {
+  TC_DATA_BYTES_TX = 0,
+  TC_DATA_BYTES_RX,
+  TC_ALLREDUCE_BYTES,
+  TC_REDUCESCATTER_BYTES,
+  TC_NEGOTIATION_BYTES_TX,
+  TC_NEGOTIATION_BYTES_RX,
+  TC_CONTROL_ROUND_TRIPS,
+  TC_CACHE_HITS,
+  TC_CACHE_MISSES,
+  TC_TENSORS,
+  TC_RESPONSES,
+  TC_EXEC_CYCLES,
+  TC_SHM_BYTES_TX,
+  TC_COMPRESSED_BYTES_TX,
+  TC_WIRE_BYTES_SAVED,
+  TC_BACKUP_SKIPS,
+  TC_STALE_EPOCH_MSGS,
+  TC_STALL_WARNINGS,
+  TC_COUNT,
+};
+extern const char* const kTelemCounterNames[TC_COUNT];
 
 struct TensorTableEntry {
   std::string name;
@@ -324,6 +352,52 @@ class Engine {
   int64_t step_time_ns_p99() const { return StepTimeNsPercentile(0.99); }
   // Participant count recorded on a finished handle (see HandleState).
   int ResultParticipants(int64_t handle);
+
+  // -- fleet observability (HOROVOD_TELEMETRY_CYCLES) --
+  // Every `telemetry_cycles` negotiation cycles each rank piggybacks a
+  // TELEM entry of counter DELTAS on its RequestList (host leaders sum
+  // their group's entries into one per-host entry under hierarchical
+  // coordination, so rank 0 still handles O(hosts) telemetry bytes);
+  // rank 0 folds the entries into a fleet table readable via FleetJson.
+  // 0 disables telemetry entirely — frames are then byte-identical to
+  // the pre-telemetry wire (the section is gated on remaining bytes,
+  // not a flag).  Final deltas ride the shutdown frame so fleet totals
+  // of quiesced counters equal the sum of per-rank stats exactly.
+  int64_t telemetry_cycles() const { return telemetry_cycles_; }
+  int64_t telem_bytes_tx() const { return telem_bytes_tx_.load(); }
+  // Stalled-tensor warnings emitted by this process (coordinator and
+  // sub-coordinator detectors), each also mirrored into the flight
+  // recorder — the source of the horovod_stall_warnings_total metric.
+  int64_t stall_warnings() const { return stall_warnings_.load(); }
+  // Rendezvous-estimated monotonic-clock offset to rank 0 (rank0_now ≈
+  // my_now + offset; 0 on rank 0): min-RTT midpoint over the ping
+  // exchange folded into the JOIN/ASSIGN handshake.  Recorded in the
+  // timeline header so `timeline merge` can align per-rank tracks.
+  int64_t clock_offset_ns() const { return clock_offset_ns_; }
+  // Coordinator-only quorum-lag percentiles: per committed entry, how
+  // long the LAST voter trailed the second-to-last (the "would one
+  // backup worker have helped" instrument; HOROVOD_BACKUP_WORKERS=auto
+  // arms from it under the default rule).  0 on workers / idle worlds.
+  int64_t quorum_lag_ns_p50() const { return QuorumLagNsPercentile(0.50); }
+  int64_t quorum_lag_ns_p99() const { return QuorumLagNsPercentile(0.99); }
+  // HOROVOD_BACKUP_AUTO_RULE: 0 = quorum (default — arm k=1 while the
+  // quorum-lag p50 exceeds the grace window: the median last-voter lag
+  // being past the grace means a partial commit would be actionable on
+  // a typical step), 1 = steptime (the PR 12 rule on rank 0's own
+  // completion-latency window, kept as the documented fallback; it
+  // cannot see rank 0 itself straggling).
+  int backup_auto_rule() const { return backup_auto_rule_; }
+  // Rank 0's fleet table as JSON (rows + totals + slowest-rank
+  // attribution + quorum-lag percentiles); "{}" on workers before any
+  // telemetry arrived.  Readable from any thread, including after
+  // shutdown (post-mortem scrapes).
+  std::string FleetJson() const;
+  int64_t fleet_rows() const;
+  // Manual flight-recorder dump (tests, operator tooling); returns 0 on
+  // success, -1 when the recorder is disabled or has no dump dir.
+  int FlightDump(const char* reason) {
+    return GlobalFlightRecorder().Dump(reason);
+  }
 
   // Effective (currently in-force) values of the live-tunable knobs plus
   // the wiring-time ones, for stats()["config"]: post-TUNE, not the env
@@ -905,6 +979,66 @@ class Engine {
   mutable std::mutex step_ns_mu_;
   std::vector<int64_t> step_ns_samples_;
   size_t step_ns_next_ = 0;
+
+  // -- fleet telemetry (see the public accessors above) --
+  // Per-rank send side (background thread only): cycle cadence counter
+  // and the last-sent absolute counter snapshot the deltas derive from.
+  // telem_last_ survives re-Init on purpose — deltas stay exact across
+  // an elastic recovery because they are differences of process-
+  // cumulative counters.
+  int64_t telemetry_cycles_ = 50;
+  int64_t telem_cycle_count_ = 0;
+  int64_t telem_last_[TC_COUNT] = {0};
+  std::atomic<int64_t> telem_bytes_tx_{0};
+  std::atomic<int64_t> stall_warnings_{0};
+  // Attach this rank's TELEM entry to the outgoing RequestList when the
+  // cadence (or `force` — the shutdown frame) says so.
+  void MaybeAttachTelem(RequestList* list, bool force);
+  TelemEntry BuildTelemEntry();
+  // Rank-0 fleet table: one row per reporting entry (per rank on the
+  // flat control plane, per host group under hierarchical coordination).
+  // Own mutex: the background thread absorbs, API/monitor threads read.
+  struct FleetRow {
+    int32_t nranks = 0;
+    int32_t host = 0;
+    int64_t counters[TC_COUNT] = {0};
+    int64_t step_p50 = 0, step_p99 = 0;
+    int32_t slow_rank = -1;
+    int64_t slow_p99 = 0;
+    int64_t updates = 0;
+    int64_t last_update_mono_ns = 0;
+  };
+  mutable std::mutex fleet_mu_;
+  std::map<int32_t, FleetRow> fleet_rows_;
+  // Rank-granular quorum-lag attribution (commits whose LAST voter was
+  // this rank, and its worst lag).  Separate from fleet_rows_ — rows
+  // are per-host under hierarchical coordination while attribution
+  // stays per rank.  Guarded by fleet_mu_ with the rows.
+  struct QuorumAttr {
+    int64_t count = 0;
+    int64_t max_ns = 0;
+  };
+  std::map<int32_t, QuorumAttr> quorum_attr_;
+  void FleetAbsorb(const TelemEntry& t);
+  // Coordinator quorum-lag window (lag of the last voter behind the
+  // second-to-last, per committed entry) + per-rank attribution into
+  // the fleet rows.  voter_ranks parallel to voter_times.
+  void NoteQuorumLag(
+      const std::vector<std::chrono::steady_clock::time_point>& times,
+      const std::vector<int>& voter_ranks);
+  int64_t QuorumLagNsPercentile(double p) const;
+  mutable std::mutex quorum_mu_;
+  std::vector<int64_t> quorum_lag_samples_;
+  size_t quorum_lag_next_ = 0;
+  int backup_auto_rule_ = 0;       // 0 = quorum (default), 1 = steptime
+  // Rendezvous clock sync + flight recorder plumbing.
+  int64_t clock_offset_ns_ = 0;
+  int64_t control_cycle_seq_ = 0;  // background thread only
+  // Per-tensor stall-warning rate limit + one-shot escalation dump.
+  std::unordered_map<std::string,
+                     std::chrono::steady_clock::time_point>
+      stall_last_warned_;
+  bool flight_escalated_ = false;
 
   // -- hierarchical coordination state --
   // Committed flag (coordinator env resolution broadcast in the ASSIGN

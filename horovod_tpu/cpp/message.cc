@@ -97,6 +97,35 @@ static bool ParseSlotList(Reader* r, std::vector<uint32_t>* slots) {
   return r->ok();
 }
 
+// Telemetry piggyback: counter deltas are varint-coded (small deltas —
+// the steady-state common case — are one byte each), gauges zigzag.
+void SerializeTelemEntry(const TelemEntry& t, Writer* w) {
+  w->vi(t.rank);
+  w->vu(static_cast<uint64_t>(t.nranks));
+  w->vu(static_cast<uint64_t>(t.host));
+  w->vi(t.step_p50);
+  w->vi(t.step_p99);
+  w->vi(t.slow_rank);
+  w->vi(t.slow_p99);
+  w->vu(t.deltas.size());
+  for (auto d : t.deltas) w->vi(d);
+}
+
+static bool ParseTelemEntry(Reader* r, TelemEntry* t) {
+  t->rank = static_cast<int32_t>(r->vi());
+  t->nranks = static_cast<int32_t>(r->vu());
+  t->host = static_cast<int32_t>(r->vu());
+  t->step_p50 = r->vi();
+  t->step_p99 = r->vi();
+  t->slow_rank = static_cast<int32_t>(r->vi());
+  t->slow_p99 = r->vi();
+  uint64_t n = r->vu();
+  if (n > (1u << 10)) return false;  // corrupt frame guard
+  t->deltas.clear();
+  for (uint64_t i = 0; i < n && r->ok(); ++i) t->deltas.push_back(r->vi());
+  return r->ok();
+}
+
 void SerializeRequestList(const RequestList& list, Writer* w) {
   w->vi(list.epoch);
   w->u8(list.shutdown ? 1 : 0);
@@ -110,6 +139,14 @@ void SerializeRequestList(const RequestList& list, Writer* w) {
   if (list.fail_rank >= 0) {
     w->vi(list.fail_rank);
     w->str(list.fail_message);
+  }
+  // Fleet-telemetry piggyback: appended ONLY when present, so the
+  // telemetry-off wire is byte-identical to the pre-telemetry protocol
+  // (the parser gates on remaining bytes, not a flag).
+  if (!list.telem.empty()) {
+    w->u8(1);
+    w->vu(list.telem.size());
+    for (const auto& t : list.telem) SerializeTelemEntry(t, w);
   }
 }
 
@@ -130,6 +167,16 @@ bool ParseRequestList(Reader* r, RequestList* out) {
   } else {
     out->fail_rank = -1;
     out->fail_message.clear();
+  }
+  out->telem.clear();
+  if (r->ok() && r->remaining() > 0) {
+    if (r->u8() != 1) return false;  // unknown trailing section
+    uint64_t n = r->vu();
+    if (n > (1u << 16)) return false;
+    out->telem.resize(n);
+    for (uint64_t i = 0; i < n; ++i) {
+      if (!ParseTelemEntry(r, &out->telem[i])) return false;
+    }
   }
   return r->ok();
 }

@@ -107,6 +107,28 @@ struct Request {
   std::vector<int64_t> shape;
 };
 
+// Fleet telemetry (HOROVOD_TELEMETRY_CYCLES): every N negotiation cycles
+// a rank piggybacks one TelemEntry of COUNTER DELTAS (since its previous
+// send) on its RequestList, so rank 0 can maintain a fleet-wide counter
+// table without a second wire protocol.  The deltas vector follows the
+// fixed kTelemCounter order (engine.h); deltas-not-absolutes make the
+// aggregation exact under hierarchical coordination, where a host
+// leader SUMS its members' entries into one per-host entry (nranks
+// grows, rank becomes the leader's) so rank 0 still receives O(hosts)
+// telemetry bytes per telemetry cycle.  step/quorum percentiles are
+// GAUGES (max-merged), with `slow_rank` attributing the worst step-time
+// p99 inside a merged entry.
+struct TelemEntry {
+  int32_t rank = 0;        // reporting rank (host leader after a merge)
+  int32_t nranks = 1;      // ranks aggregated into this entry
+  int32_t host = 0;        // committed host-group id
+  int64_t step_p50 = 0;    // step_time_ns_p50 gauge
+  int64_t step_p99 = 0;    // step_time_ns_p99 gauge
+  int32_t slow_rank = -1;  // rank with the largest step_p99 in this entry
+  int64_t slow_p99 = 0;
+  std::vector<int64_t> deltas;  // kTelemCounter order
+};
+
 struct RequestList {
   // Membership epoch this frame belongs to (elastic in-place resize).
   // Every control message is stamped with the sender's committed epoch;
@@ -134,6 +156,13 @@ struct RequestList {
   // signature); the full replacement Request rides in `requests` in the
   // same frame.
   std::vector<uint32_t> cache_evicts;
+  // Piggybacked fleet telemetry (see TelemEntry).  The wire section is
+  // appended ONLY when non-empty, and the parser reads it only when
+  // bytes remain after the PR 12 fields — so HOROVOD_TELEMETRY_CYCLES=0
+  // frames are BYTE-IDENTICAL to the pre-telemetry protocol, and an
+  // idle telemetry cycle costs nothing at all (no flag byte: absence is
+  // the flag).
+  std::vector<TelemEntry> telem;
 };
 
 struct Response {
@@ -312,6 +341,10 @@ class Reader {
     return std::string(reinterpret_cast<const char*>(s), n);
   }
   bool ok() const { return ok_; }
+  // Bytes not yet consumed.  Trailing optional sections (the TELEM
+  // piggyback) are gated on this instead of a flag byte, so a frame
+  // without the section is byte-identical to the pre-section protocol.
+  size_t remaining() const { return static_cast<size_t>(end_ - p_); }
 
  private:
   const uint8_t* take(size_t n) {
@@ -331,6 +364,9 @@ class Reader {
 
 void SerializeRequestList(const RequestList& list, Writer* w);
 bool ParseRequestList(Reader* r, RequestList* out);
+// Exposed for the engine's telem_bytes_tx accounting (the per-entry wire
+// cost without serializing the whole frame twice).
+void SerializeTelemEntry(const TelemEntry& t, Writer* w);
 void SerializeResponseList(const ResponseList& list, Writer* w);
 bool ParseResponseList(Reader* r, ResponseList* out);
 

@@ -21,6 +21,31 @@ class Timeline {
  public:
   void Initialize(const std::string& path);
   bool Initialized() const { return file_ != nullptr; }
+  // Merged-timeline header: one metadata event carrying the writer's
+  // rank, membership epoch, monotonic base of the trace's ts axis, and
+  // the rendezvous-estimated clock offset to rank 0 — everything
+  // `python -m horovod_tpu.timeline merge` needs to put every rank's
+  // events on one aligned time axis.  Re-emitted after a rotation so
+  // the newest file stays self-contained.
+  void SetMeta(int rank, int64_t epoch, int64_t clock_offset_ns);
+  // HOROVOD_TIMELINE_MAX_MB rotation: when the file exceeds this many
+  // bytes it is terminated as valid JSON, renamed to "<path>.old"
+  // (replacing any previous rotation), and a fresh file (meta header +
+  // known pid metadata re-emitted) continues at the same path — the
+  // newest events are always in the configured file.  0 = unbounded.
+  void SetMaxBytes(int64_t max_bytes) { max_bytes_ = max_bytes; }
+  // Flush buffered events now (abort paths: the last cycle before a
+  // crash must never be lost to stdio buffering).
+  void Flush();
+  // Cross-rank flow trace (Dapper-style): the coordinator emits the
+  // flow SOURCE ("s") when it commits a negotiation, every executing
+  // rank emits the SINK ("f") on its execution span.  The flow id is
+  // the string "<name>#<epoch>#<n>" with n a per-name occurrence
+  // counter — identical across ranks because every commit executes
+  // exactly once on every rank, so the merged trace joins them without
+  // any cross-file bookkeeping.
+  void FlowSend(const std::string& name, int64_t epoch);
+  void FlowRecv(const std::string& name, int64_t epoch);
 
   void NegotiateStart(const std::string& name);
   void NegotiateRankReady(const std::string& name, int rank);
@@ -65,6 +90,10 @@ class Timeline {
   void WriteEvent(int pid, char phase, const std::string& category,
                   const std::string& op_name = "", int tid = 0);
   void FlushIfDue();
+  void WriteMetaHeader();
+  void MaybeRotate();
+  // fprintf wrapper that feeds the rotation byte counter.
+  void Out(const char* fmt, ...) __attribute__((format(printf, 2, 3)));
 
   FILE* file_ = nullptr;
   std::recursive_mutex mu_;
@@ -73,6 +102,16 @@ class Timeline {
   std::chrono::steady_clock::time_point start_;
   std::chrono::steady_clock::time_point last_flush_;
   int next_pid_ = 0;
+  std::string path_;
+  int64_t max_bytes_ = 0;
+  int64_t written_ = 0;
+  bool meta_set_ = false;
+  int meta_rank_ = 0;
+  int64_t meta_epoch_ = 0;
+  int64_t meta_offset_ns_ = 0;
+  // Per-name flow occurrence counters (send side / recv side — rank 0
+  // uses both, workers only the recv side).
+  std::unordered_map<std::string, int64_t> flow_send_, flow_recv_;
 };
 
 }  // namespace hvd

@@ -25,6 +25,11 @@ dispatching to ``--replicas`` replica worlds with continuous batching
 and a paged KV cache (docs/serving.md); ``--restart-on-failure`` doubles
 as the replica relaunch budget.
 
+Live status: ``--status host:port`` queries a running job's metrics
+endpoint (rank 0 serves it when ``HOROVOD_METRICS_PORT`` is set — see
+docs/observability.md) and prints a fleet summary; ``--raw`` dumps the
+/json payload.
+
 Elastic membership: ``--elastic`` additionally sets ``HOROVOD_ELASTIC=1``
 so the engine may re-form the world IN PLACE around the survivors — the
 env rank becomes a persistent worker id (a join candidacy, not the final
@@ -94,6 +99,12 @@ def main(argv=None) -> int:
                         help="supervisor mode: wait SEC before relaunching "
                              "a dead worker (forces an elastic shrink "
                              "before the rejoin; mainly for tests)")
+    parser.add_argument("--status", default=None, metavar="HOST:PORT",
+                        help="query a LIVE job's metrics endpoint "
+                             "(HOROVOD_METRICS_PORT on rank 0) and print "
+                             "a fleet summary; add --raw for the JSON")
+    parser.add_argument("--raw", action="store_true",
+                        help="with --status: print the raw /json payload")
     parser.add_argument("--print-config", action="store_true",
                         help="dump the full resolved engine knob table "
                              "(env -> default -> effective) and exit; "
@@ -118,6 +129,25 @@ def main(argv=None) -> int:
                         help="command to run (prefix with --)")
     args = parser.parse_args(argv)
 
+    if args.status:
+        from horovod_tpu.monitor.server import format_status, query_status
+
+        try:
+            payload = query_status(args.status)
+        except (OSError, ValueError) as exc:
+            # ValueError covers a malformed host:port and a non-JSON
+            # response from something else squatting on the port.
+            sys.stderr.write(
+                f"cannot reach metrics endpoint at {args.status}: {exc}\n"
+                "(is the job running with HOROVOD_METRICS_PORT set?)\n")
+            return 1
+        if args.raw:
+            import json
+
+            print(json.dumps(payload, indent=2, sort_keys=True))
+        else:
+            print(format_status(payload))
+        return 0
     if args.print_config:
         from horovod_tpu.autotune import format_table
 

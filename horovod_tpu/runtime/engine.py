@@ -250,6 +250,16 @@ class NativeEngine:
                         "horovod_reducescatter_ns",
                         "horovod_reducescatter_fallbacks",
                         "horovod_sharded_steps",
+                        "horovod_telemetry_cycles",
+                        "horovod_telem_bytes_tx",
+                        "horovod_stall_warnings",
+                        "horovod_clock_offset_ns",
+                        "horovod_quorum_lag_ns_p50",
+                        "horovod_quorum_lag_ns_p99",
+                        "horovod_backup_auto_rule",
+                        "horovod_fleet_rows",
+                        "horovod_flight_events",
+                        "horovod_flight_dumps",
                         "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
@@ -284,6 +294,15 @@ class NativeEngine:
             lib.horovod_autotune_set.restype = ctypes.c_int
         except AttributeError:
             pass  # stale .so: the autotuner refuses to start
+        try:
+            lib.horovod_fleet_json.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+            ]
+            lib.horovod_fleet_json.restype = ctypes.c_int64
+            lib.horovod_flight_dump.argtypes = [ctypes.c_char_p]
+            lib.horovod_flight_dump.restype = ctypes.c_int
+        except AttributeError:
+            pass  # stale .so: fleet_stats()/flight_dump() degrade
 
     # -- naming (auto names must be identical across ranks, which holds when
     #    ranks enqueue in the same program order — same contract as the
@@ -492,13 +511,13 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_sharded_steps",
+        if getattr(getattr(self._lib, "horovod_fleet_rows",
                            None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the reduce-scatter / sharded-"
-                "optimizer counters (and possibly earlier counter "
-                "families) — rebuild it with `make -C horovod_tpu/cpp`")
+                "libhorovod_core.so predates the fleet-observability "
+                "counters (and possibly earlier counter families) — "
+                "rebuild it with `make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
         ar_bytes = self._lib.horovod_allreduce_bytes()
         ar_ns = self._lib.horovod_allreduce_ns()
@@ -544,6 +563,20 @@ class NativeEngine:
             # Python policy completed.
             "step_time_ns_p50": self._lib.horovod_step_time_ns_p50(),
             "step_time_ns_p99": self._lib.horovod_step_time_ns_p99(),
+            # Fleet observability: coordinator quorum-lag percentiles
+            # (how long the LAST voter trailed the second-to-last per
+            # committed negotiation — the straggler instrument
+            # backup=auto's default rule arms from), TELEM piggyback
+            # bytes this rank sent, stall warnings emitted, and the
+            # rendezvous-estimated monotonic clock offset to rank 0
+            # (the merged timeline's alignment term).
+            "quorum_lag_ns_p50": self._lib.horovod_quorum_lag_ns_p50(),
+            "quorum_lag_ns_p99": self._lib.horovod_quorum_lag_ns_p99(),
+            "telem_bytes_tx": self._lib.horovod_telem_bytes_tx(),
+            "stall_warnings": self._lib.horovod_stall_warnings(),
+            "clock_offset_ns": self._lib.horovod_clock_offset_ns(),
+            "flight_events": self._lib.horovod_flight_events(),
+            "flight_dumps": self._lib.horovod_flight_dumps(),
             "backup_skips": self._lib.horovod_backup_skips(),
             "local_sgd_syncs": self._lib.horovod_local_sgd_syncs(),
             "data_bytes_tx": self._lib.horovod_data_bytes_tx(),
@@ -617,6 +650,16 @@ class NativeEngine:
                 "backup_auto_ratio":
                     self._lib.horovod_backup_auto_ratio_milli() / 1000.0,
                 "backup_armed": bool(self._lib.horovod_backup_armed()),
+                # backup=auto arming instrument: "quorum" (default —
+                # per-entry quorum-lag percentiles) or "steptime" (the
+                # legacy rank-0 completion-latency window,
+                # HOROVOD_BACKUP_AUTO_RULE=steptime).
+                "backup_auto_rule":
+                    "steptime" if self._lib.horovod_backup_auto_rule()
+                    else "quorum",
+                # Fleet telemetry cadence (0 = off: control frames are
+                # byte-identical to the pre-telemetry wire).
+                "telemetry_cycles": self._lib.horovod_telemetry_cycles(),
             },
         }
 
@@ -641,7 +684,10 @@ class NativeEngine:
                      "coordinator_cycle_ns_p50",
                      "coordinator_cycle_ns_p99",
                      "step_time_ns_p50",
-                     "step_time_ns_p99"):
+                     "step_time_ns_p99",
+                     "quorum_lag_ns_p50",
+                     "quorum_lag_ns_p99",
+                     "clock_offset_ns"):
                 delta[k] = v
                 continue
             delta[k] = v - since.get(k, 0)
@@ -657,6 +703,43 @@ class NativeEngine:
                      / size) / (delta["reducescatter_ns"] / 1e9)
         delta["reducescatter_bus_bw_bytes_per_sec"] = rs_bw
         return delta
+
+    def fleet_stats(self) -> dict:
+        """Rank 0's fleet telemetry table (HOROVOD_TELEMETRY_CYCLES).
+
+        Returns the aggregated per-rank (flat control plane) or per-host
+        (hierarchical coordination) counter rows, fleet totals,
+        slowest-rank attribution and quorum-lag percentiles as a dict —
+        ``{}`` on workers, with telemetry off, or before the first TELEM
+        frame arrived.  Counters are DELTAS summed on the coordinator,
+        so a quiesced fleet's totals equal the sum of the per-rank
+        :meth:`stats` values exactly (the observability ci gate asserts
+        this on ``data_bytes_tx``).  Readable after shutdown too — the
+        fleet table survives for post-mortem scrapes."""
+        fn = getattr(self._lib, "horovod_fleet_json", None)
+        if getattr(fn, "restype", None) is not ctypes.c_int64:
+            return {}
+        need = int(fn(None, 0))
+        if need <= 2:  # "{}" — nothing reported yet
+            return {}
+        buf = ctypes.create_string_buffer(need + 1)
+        fn(buf, need + 1)
+        import json
+
+        try:
+            return json.loads(buf.value.decode(errors="replace"))
+        except ValueError:
+            return {}
+
+    def flight_dump(self, reason: str = "manual dump") -> bool:
+        """Dump the flight recorder to HOROVOD_FLIGHT_RECORDER_DIR now
+        (``flightrec.rank<r>.json``); the engine also dumps on abort,
+        stall-warning escalation, and fatal signals.  False when the
+        recorder is disabled or has no dump directory."""
+        fn = getattr(self._lib, "horovod_flight_dump", None)
+        if getattr(fn, "restype", None) is not ctypes.c_int:
+            return False
+        return int(fn(reason.encode())) == 0
 
     def autotune_set(self, *, chunk_bytes: int = 0,
                      fusion_threshold: int = 0, cycle_time_ms: int = 0,

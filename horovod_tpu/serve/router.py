@@ -511,6 +511,34 @@ class Router:
         if self.probe_sec > 0:
             self._tasks.append(asyncio.ensure_future(self._probe_loop()))
         port = server.sockets[0].getsockname()[1]
+        # Observability mount: when HOROVOD_METRICS_PORT is set the
+        # router's counters + fleet liveness join the same HTTP endpoint
+        # the engine plane serves (horovod_serve_* gauges on /metrics,
+        # key "serve" on /json) — one scrape covers train AND serve.
+        if os.environ.get("HOROVOD_METRICS_PORT", "") not in ("", "0"):
+            from horovod_tpu.monitor.server import (
+                get_metrics_server,
+                start_metrics_server,
+            )
+
+            def _router_stats() -> dict:
+                out = dict(self.counters)
+                out["replicas"] = self.num_replicas
+                out["replicas_alive"] = sum(
+                    1 for r in self.replicas if r.alive)
+                return out
+
+            try:
+                mport = start_metrics_server(
+                    int(os.environ["HOROVOD_METRICS_PORT"]),
+                    lambda: {}, lambda: {})
+                srv = get_metrics_server()
+                if srv is not None:
+                    srv.mount("serve", _router_stats)
+                print(f"SERVE_METRICS_READY port={mport}", flush=True)
+            except (OSError, RuntimeError, ValueError) as exc:
+                print(f"serve metrics endpoint disabled: {exc}",
+                      flush=True)
         print(f"SERVE_ROUTER_READY port={port} replicas="
               f"{self.num_replicas} startup_sec="
               f"{time.monotonic() - t0:.1f}", flush=True)

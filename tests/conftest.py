@@ -51,6 +51,12 @@ def pytest_configure(config):
         "straggler: backup-worker chaos soaks (slow-fault schedules, "
         "step-time p99 comparison); ci.sh runs them in the straggler "
         "gate under a hard timeout, separate from the fault/soak gates")
+    config.addinivalue_line(
+        "markers",
+        "observability: fleet-telemetry / metrics-endpoint / flight-"
+        "recorder tests; ci.sh runs them in the observability gate "
+        "under a hard timeout (main sweep excludes the marker, tier-1 "
+        "still runs them)")
 
 
 @pytest.fixture(scope="session")

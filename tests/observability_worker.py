@@ -1,0 +1,200 @@
+"""Worker body for the fleet-observability tests (multi-process).
+
+Same harness contract as tests/native_worker.py: ``python
+observability_worker.py <scenario>`` with identity in
+HOROVOD_RANK/HOROVOD_SIZE/HOROVOD_COORDINATOR.  Scenarios print
+machine-readable ``OBS_*`` lines the pytest side parses — cross-rank
+assertions (fleet == Σ per-rank) live in the HARNESS, where every
+rank's numbers are visible, so the workers never need extra collectives
+that would perturb the very byte counters under test.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import (  # noqa: E402
+    StepSkipped,
+    get_engine,
+)
+
+#: The deterministic counters the fleet-sum assertions run on: stable
+#: once the data plane quiesces (idle heartbeats move NEGOTIATION bytes,
+#: never these).
+SUM_KEYS = ("data_bytes_tx", "data_bytes_rx", "allreduce_bytes",
+            "tensors", "responses")
+
+
+def _workload(rank, size, eng, steps=24):
+    for i in range(steps):
+        n = 256 * (1 + i % 3)
+        out = eng.allreduce(np.full((n,), float(rank + 1), np.float32),
+                            name=f"obs.t{i % 4}")
+        assert np.allclose(out, size * (size + 1) / 2.0), out[0]
+
+
+def _quiesce_and_print(eng, rank):
+    # Barrier so every rank finished its workload, then idle long enough
+    # for the per-cycle TELEM deltas (HOROVOD_TELEMETRY_CYCLES=1 in the
+    # tests) to drain into rank 0's fleet table.  Data-plane counters
+    # are frozen from here on — only negotiation/telemetry bytes keep
+    # ticking with the heartbeats.
+    eng.allreduce(np.zeros((4,), np.float32), name="obs.barrier")
+    time.sleep(1.2)
+    s = eng.stats()
+    rec = {k: s[k] for k in SUM_KEYS}
+    rec["rank"] = rank
+    rec["telem_bytes_tx"] = s["telem_bytes_tx"]
+    rec["clock_offset_ns"] = s["clock_offset_ns"]
+    rec["negotiation_bytes_tx"] = s["negotiation_bytes_tx"]
+    print("OBS_STATS " + json.dumps(rec), flush=True)
+    if rank == 0:
+        print("OBS_FLEET " + json.dumps(basics.fleet_stats()), flush=True)
+
+
+def scenario_fleet_sums(rank, size, eng):
+    _workload(rank, size, eng)
+    _quiesce_and_print(eng, rank)
+
+
+def scenario_scrape_hold(rank, size, eng):
+    # Like fleet_sums, but every rank then HOLDS (mid-job idle) so the
+    # pytest harness can scrape rank 0's live HTTP endpoint and compare
+    # the fleet table against the printed per-rank stats.
+    _workload(rank, size, eng)
+    _quiesce_and_print(eng, rank)
+    time.sleep(float(os.environ.get("OBS_HOLD_SEC", "5")))
+
+
+def scenario_parity(rank, size, eng):
+    # Deterministic workload; the result bytes are hashed so the harness
+    # can assert telemetry on/off changes NOTHING the collectives
+    # compute (the wire payload contract), and the telem_bytes counter
+    # proves the off wire carries zero telemetry bytes.
+    h = hashlib.sha256()
+    for i in range(16):
+        x = (np.arange(512, dtype=np.float32) * (rank + 1) + i)
+        out = eng.allreduce(x, name=f"par.t{i % 4}")
+        h.update(np.asarray(out).tobytes())
+    for dt in (np.int64, np.float64):
+        out = eng.allreduce((np.arange(33) + rank).astype(dt), name=f"par.{dt.__name__}")
+        h.update(np.asarray(out).tobytes())
+    s = eng.stats()
+    print("OBS_PARITY " + json.dumps({
+        "rank": rank, "sum": h.hexdigest(),
+        "telem_bytes_tx": s["telem_bytes_tx"],
+        "telemetry_cycles": s["config"]["telemetry_cycles"]}), flush=True)
+
+
+def scenario_overhead(rank, size, eng):
+    # Steady-state control-plane cost of the TELEM piggyback: a tight
+    # cached-allreduce loop, then rank 0's negotiation bytes per payload
+    # round trip — the acceptance bound is <= 10% growth vs telemetry
+    # off at the DEFAULT cadence (the harness runs this twice).
+    x = np.ones((64,), np.float32)
+    for _ in range(300):
+        eng.allreduce(x.copy(), name="ovh.t")
+    s = eng.stats()
+    print("OBS_OVERHEAD " + json.dumps({
+        "rank": rank,
+        "nego": s["negotiation_bytes_tx"] + s["negotiation_bytes_rx"],
+        "round_trips": s["control_round_trips"],
+        "telem_bytes_tx": s["telem_bytes_tx"]}), flush=True)
+
+
+def scenario_stall(rank, size, eng):
+    # Rank 0 enqueues a tensor rank 1 withholds for a while: the
+    # coordinator's stall detector must warn (rate-limited per tensor),
+    # count each warning, mirror it into the flight recorder, and — past
+    # 2x the warning interval — dump the recorder once (escalation).
+    handle = None
+    if rank == 0:
+        handle = eng.enqueue_allreduce(
+            np.ones((64,), np.float32), name="stall.lonely")
+        time.sleep(3.6)
+    else:
+        time.sleep(3.6)
+        handle = eng.enqueue_allreduce(
+            np.ones((64,), np.float32), name="stall.lonely")
+    eng.synchronize(handle)
+    s = eng.stats()
+    print("OBS_STALL " + json.dumps({
+        "rank": rank, "stall_warnings": s["stall_warnings"],
+        "flight_events": s["flight_events"],
+        "flight_dumps": s["flight_dumps"]}), flush=True)
+
+
+def scenario_timeline_workload(rank, size, eng):
+    # Mixed collectives for the merged-timeline test: allreduces (cached
+    # and fresh), a broadcast, an allgather — enough span/flow variety
+    # for the flow-join and causality assertions.
+    for i in range(18):
+        eng.allreduce(np.full((128,), float(rank + 1), np.float32),
+                      name=f"tlw.t{i % 3}")
+    eng.broadcast(np.arange(16, dtype=np.float32) * (rank + 1),
+                  root_rank=0, name="tlw.bcast")
+    eng.allgather(np.full((rank + 1, 2), float(rank), np.float32),
+                  name="tlw.gather")
+
+
+def scenario_rotate(rank, size, eng):
+    # Size-1 world: hammer the timeline past HOROVOD_TIMELINE_MAX_MB so
+    # it rotates at least once; the newest file must contain the LAST
+    # op and both files must parse.
+    assert size == 1
+    for i in range(2600):
+        eng.allreduce(np.ones((8,), np.float32),
+                      name=f"rotate.{'x' * 40}.{i % 7}")
+    eng.allreduce(np.ones((8,), np.float32), name="rotate.final.marker")
+
+
+def scenario_backup_auto(rank, size, eng):
+    # Deterministic straggler (HOROVOD_FAULT_INJECT=<r>:*:slow:<ms> set
+    # by the test) under HOROVOD_BACKUP_WORKERS=auto with the default
+    # quorum rule: the coordinator must ARM k=1 from the quorum-lag
+    # window (median lag > grace) and partial commits must start
+    # skipping the slow rank — including when the slow rank is the
+    # COORDINATOR itself, the blind spot the steptime rule cannot see.
+    skips = 0
+    for i in range(90):
+        try:
+            eng.allreduce(np.full((64,), 1.0, np.float32),
+                          name=f"auto.t{i % 2}")
+        except StepSkipped:
+            skips += 1
+    # MAX allreduce = a reliable barrier under k>0 (never partially
+    # committed): the fast ranks must not shut the world down while the
+    # straggler is still steps behind.
+    eng.allreduce(np.ones((4,), np.float32), name="auto.barrier",
+                  red_op="max")
+    time.sleep(1.0)
+    s = eng.stats()
+    rec = {"rank": rank, "skips": skips,
+           "backup_skips": s["backup_skips"],
+           "armed": s["config"]["backup_armed"],
+           "rule": s["config"]["backup_auto_rule"],
+           "quorum_lag_ns_p50": s["quorum_lag_ns_p50"]}
+    if rank == 0:
+        rec["fleet"] = basics.fleet_stats()
+    print("OBS_AUTO " + json.dumps(rec), flush=True)
+
+
+def main():
+    scenario = sys.argv[1]
+    basics.init()
+    eng = get_engine()
+    rank, size = basics.rank(), basics.size()
+    globals()[f"scenario_{scenario}"](rank, size, eng)
+    basics.shutdown()
+    print(f"OBS_DONE rank={rank}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
