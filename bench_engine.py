@@ -285,6 +285,33 @@ def _latency_worker() -> None:
     basics.shutdown()
 
 
+def _link_heal_bench_worker() -> None:
+    """Busbw + heal-latency under a seeded flap schedule (the test's
+    conn-reset fault kind, recurring): the run must complete with ZERO
+    aborts while edges break and heal, and rank 0 reports the engine's
+    link_heal percentiles next to the flap-loaded bus bandwidth."""
+    import numpy as np
+
+    basics, eng = _engine_setup()
+    nbytes = int(os.environ.get("BENCH_SWEEP_BYTES", str(1 << 20)))
+    n = max(1, nbytes // 4)
+    x = np.ones(n, dtype=np.float32)
+    eng.allreduce(x.copy(), name="link.warm")
+    before = eng.stats()
+    for _ in range(40):
+        eng.synchronize(eng.enqueue_allreduce(x.copy(), name="link.t"))
+    d = eng.stats_delta(before)
+    st = eng.stats()
+    assert eng.abort_reason() == "", eng.abort_reason()
+    assert st["link_heal_failures"] == 0, st["link_heal_failures"]
+    if basics.rank() == 0:
+        print(f"LINK_BENCH BUS_MB_S "
+              f"{d['allreduce_bus_bw_bytes_per_sec'] / 1e6:.1f} "
+              f"HEAL_MS_P50 {st['link_heal_ns_p50'] / 1e6:.3f} "
+              f"RECONNECTS {st['link_reconnects']}", flush=True)
+    basics.shutdown()
+
+
 def _gate_worker() -> None:
     """Alternate channels=4 / channels=1 IN-PROCESS (re-init between
     rounds) so machine drift hits both configs; print the per-round
@@ -751,6 +778,27 @@ def main() -> None:
     result["allreduce_small_latency_ms_shm"] = \
         lat["allreduce_small_latency_ms_shm"]
 
+    # Link self-healing under a seeded flap schedule: two ranks shoot
+    # their own data sockets every 7th/11th enqueue for the whole run
+    # (the conn-reset fault kind, recurring), and the job must absorb
+    # every break — the keys report the median transparent-reconnect
+    # latency and the bus bandwidth the flapping plane still sustains,
+    # next to the undisturbed sweep above.
+    out = _run_ranks(4, [sys.executable, os.path.abspath(__file__),
+                         "--link-heal-worker"],
+                     extra_env={"HOROVOD_SHM_DISABLE": "1",
+                                "HOROVOD_NUM_CHANNELS": "3",
+                                "BENCH_SWEEP_BYTES": str(1 << 20),
+                                "HOROVOD_FAULT_INJECT":
+                                    "0:*:conn-reset:7,"
+                                    "2:*:conn-reset:11:prev"})
+    m = re.search(r"LINK_BENCH BUS_MB_S ([\d.]+) HEAL_MS_P50 ([\d.]+) "
+                  r"RECONNECTS (\d+)", out)
+    if m:
+        result["allreduce_bus_bw_mb_s_flap"] = {"4": float(m.group(1))}
+        result["link_heal_ms_p50"] = float(m.group(2))
+        result["link_reconnects_flap"] = int(m.group(3))
+
     # Wire-dtype sweep (fp32/fp16/int8, 4 KB -> 64 MB, 2 and 4 ranks):
     # EFFECTIVE bus bandwidth per wire format, plus the deterministic
     # per-rank byte-counter ratio vs the fp32 wire — the gate metric
@@ -1195,6 +1243,8 @@ if __name__ == "__main__":
         _wire_gate_worker()
     elif "--fleet-worker" in sys.argv:
         _fleet_worker()
+    elif "--link-heal-worker" in sys.argv:
+        _link_heal_bench_worker()
     elif "--rs-sweep-worker" in sys.argv:
         _rs_sweep_worker()
     elif "--sharded-bytes-worker" in sys.argv:

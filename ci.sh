@@ -27,7 +27,7 @@ echo "== test suite (8-device virtual CPU mesh) =="
 # Caller args go BEFORE the marker filter so a user-passed -m cannot
 # override it — the fault tests must only ever run under the hard
 # timeout below (a reintroduced hang would otherwise eat the CI budget).
-PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability"
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability and not linkheal"
 
 echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # These tests previously WOULD HANG when a rank died mid-collective; the
@@ -45,6 +45,20 @@ echo "== chaos membership soak (seeded multi-failure, hard timeout) =="
 # never hang (the timeout is the hang detector).
 PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
     python -m pytest tests/ -q -m "fault and slow and not scale"
+
+echo "== link-heal gate (transparent reconnect under conn-reset, hard timeout) =="
+# Link self-healing regression gate (own `linkheal` marker, excluded from
+# the main sweep and the fault gates above): a 4-rank multichannel run
+# with one injected conn-reset per rank completes every step BIT-EXACT
+# with zero collective aborts and link_reconnects >= 1 on every rank
+# (test_heal_mid_allreduce_bitwise_parity), a transient recv stall heals
+# with zero reconnects, and a HOROVOD_LINK_HEAL_TIMEOUT_MS=1-strangled
+# run escalates to the clean attributed abort within the fault bound
+# (test_retries_exhausted_escalates_to_clean_abort).  The seeded flap
+# soak (slow-marked) rides the same budget; the hard timeout is the
+# hang detector for a healing loop that stops converging.
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
+    python -m pytest tests/ -q -m "linkheal"
 
 echo "== elastic resize gate (3 ranks, kill rank 2, no replacement) =="
 # In-place membership regression gate: rank 2 dies with no replacement;

@@ -113,6 +113,17 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_FAULT_TIMEOUT_SEC", "0 (off)",
          lambda raw: str(_int_env(raw, 0)),
          "hard failure-detection bound (caps the two above)"),
+    Knob("HOROVOD_LINK_RETRIES", "3",
+         lambda raw: str(max(0, min(1000, _int_env(raw, 3)))),
+         "link self-healing: reconnect attempts per suspect data-channel "
+         "socket before escalating to the abort path (0 = heal off, "
+         "fail-fast exactly as before; committed at rendezvous; see "
+         "docs/elastic.md 'Link self-healing')"),
+    Knob("HOROVOD_LINK_HEAL_TIMEOUT_MS", "10000",
+         lambda raw: str(max(1, _int_env(raw, 10000))),
+         "per-suspect heal deadline; clamped to 3/4 of the socket "
+         "timeout so healing always finishes inside every other rank's "
+         "no-progress patience (committed at rendezvous)"),
     Knob("HOROVOD_STALL_WARNING_SEC", "60",
          lambda raw: str(_int_env(raw, 60)),
          "stalled-tensor warning cadence"),

@@ -251,6 +251,31 @@ int64_t horovod_backup_armed() {
   return Engine::Get().backup_armed() ? 1 : 0;
 }
 int64_t horovod_backup_skips() { return Engine::Get().backup_skips(); }
+// Link self-healing (HOROVOD_LINK_RETRIES / HOROVOD_LINK_HEAL_TIMEOUT_MS):
+// data-channel edges transparently re-established mid-collective, suspects
+// that exhausted the retry/deadline budget and escalated to the unchanged
+// abort path, sliding-window percentiles of suspect→healed durations, and
+// the committed knob values (the coordinator's resolution rides the
+// rendezvous ASSIGN, like the channel count).  All counters are provably
+// zero under HOROVOD_LINK_RETRIES=0.
+int64_t horovod_link_reconnects() {
+  return Engine::Get().link_reconnects();
+}
+int64_t horovod_link_heal_failures() {
+  return Engine::Get().link_heal_failures();
+}
+int64_t horovod_link_heal_ns_p50() {
+  return Engine::Get().link_heal_ns_p50();
+}
+int64_t horovod_link_heal_ns_p99() {
+  return Engine::Get().link_heal_ns_p99();
+}
+int64_t horovod_link_retries() {
+  return static_cast<int64_t>(Engine::Get().link_retries());
+}
+int64_t horovod_link_heal_timeout_ms() {
+  return Engine::Get().link_heal_timeout_ms();
+}
 int64_t horovod_local_sgd_syncs() {
   return Engine::Get().local_sgd_syncs();
 }

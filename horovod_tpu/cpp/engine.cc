@@ -757,6 +757,16 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       static_cast<int>(EnvInt64("HOROVOD_STALL_WARNING_SEC", 60));
   socket_timeout_sec_ =
       static_cast<int>(EnvInt64("HOROVOD_SOCKET_TIMEOUT_SEC", 120));
+  // Link self-healing: bounded in-place reconnect of a failed data-channel
+  // socket before the expensive abort/elastic machinery fires.  0 retries
+  // = off (bit-for-bit the pre-heal engine).  The coordinator's resolution
+  // is committed at rendezvous (workers adopt it below, like the channel
+  // count).
+  link_retries_ = static_cast<int>(EnvInt64("HOROVOD_LINK_RETRIES", 3));
+  if (link_retries_ < 0) link_retries_ = 0;
+  if (link_retries_ > 1000) link_retries_ = 1000;
+  link_heal_timeout_ms_ = EnvInt64("HOROVOD_LINK_HEAL_TIMEOUT_MS", 10000);
+  if (link_heal_timeout_ms_ < 1) link_heal_timeout_ms_ = 1;
   // Bound on control-plane patience for a live-but-wedged peer.  The old
   // allowance scaled as (size+4) x socket timeout (~2.3 h at 64 ranks x
   // 120 s before the descriptive abort); HOROVOD_CONTROL_PATIENCE_SEC
@@ -798,6 +808,19 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     }
     control_patience_sec = std::min(control_patience_sec, third);
   }
+  // Healing must finish strictly inside every OTHER rank's no-progress
+  // patience: healthy ranks downstream of a healing edge stall on their
+  // own cascade steps, and a heal budget past their socket timeout would
+  // convert a healable blip into their "link: no progress" abort.  The
+  // fault bound (when set) already capped socket_timeout_sec_ above, so
+  // this single cap also keeps heal-then-escalate inside the coordinator's
+  // fault-timeout verdict window.
+  if (socket_timeout_sec_ > 0) {
+    link_heal_timeout_ms_ = std::min<int64_t>(
+        link_heal_timeout_ms_,
+        static_cast<int64_t>(socket_timeout_sec_) * 1000 * 3 / 4);
+    if (link_heal_timeout_ms_ < 1) link_heal_timeout_ms_ = 1;
+  }
   control_patience_rounds_ =
       socket_timeout_sec_ > 0
           ? std::max(1, control_patience_sec / socket_timeout_sec_)
@@ -819,6 +842,11 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
   fault_hang_.store(false);
   fault_drop_.store(false);
   fault_stale_epoch_.store(false);
+  fault_conn_reset_.store(false);
+  fault_stall_ms_.store(0);
+  fault_reset_period_ = 1;
+  fault_reset_prev_ = false;
+  fault_stall_len_ms_ = 200;
   if (const char* spec = std::getenv("HOROVOD_FAULT_INJECT");
       !fault_fired_ && spec != nullptr && spec[0] != '\0') {
     // Comma-separated schedule (chaos tests inject on several ranks in
@@ -878,11 +906,38 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         fault_slow_ms_ = fields.size() > 3
             ? std::strtoll(fields[3].c_str(), nullptr, 10) : 100;
         if (fault_slow_ms_ < 0) fault_slow_ms_ = 0;
+      } else if (fkind == "conn-reset") {
+        // rank:step:conn-reset[:K][:prev] — this rank shutdown(2)s one of
+        // its OWN data-channel sockets mid-cascade (the link-heal driver
+        // fault).  Optional numeric field = re-arm period for step '*'
+        // (a flap schedule); optional 'prev' shoots the recv-side socket,
+        // which discards buffered inbound bytes — the lost-data case.
+        fault_kind_ = FaultKind::CONN_RESET;
+        for (size_t fi = 3; fi < fields.size(); ++fi) {
+          if (fields[fi] == "prev") {
+            fault_reset_prev_ = true;
+          } else if (!fields[fi].empty()) {
+            long long period =
+                std::strtoll(fields[fi].c_str(), &endp, 10);
+            if (endp != nullptr && *endp == '\0' && period > 0) {
+              fault_reset_period_ = period;
+            }
+          }
+        }
+      } else if (fkind == "recv-stall") {
+        // rank:step:recv-stall:ms — the next cascade on this rank stops
+        // draining one channel for ms (a transient stall, not a dead
+        // link): the collective must complete with zero aborts AND zero
+        // reconnects — healing classifies, waits, and stands down.
+        fault_kind_ = FaultKind::RECV_STALL;
+        fault_stall_len_ms_ = fields.size() > 3
+            ? std::strtoll(fields[3].c_str(), nullptr, 10) : 200;
+        if (fault_stall_len_ms_ < 1) fault_stall_len_ms_ = 1;
       } else {
         std::fprintf(stderr,
                      "horovod_tpu: unknown HOROVOD_FAULT_INJECT kind '%s' "
-                     "(want exit|hang|drop-conn|stale-epoch|slow); "
-                     "ignored\n",
+                     "(want exit|hang|drop-conn|stale-epoch|slow|"
+                     "conn-reset|recv-stall); ignored\n",
                      fkind.c_str());
         fault_step_ = -1;
         fault_kind_ = FaultKind::NONE;
@@ -982,7 +1037,6 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     // channel slot in the new world's wiring.  Connect cannot deadlock:
     // every listener already exists, so connects complete from the
     // backlog even before the peer accepts.
-    enum RingId : int32_t { GLOBAL = 0, LOCAL = 1, CROSS = 2, CTRL = 3 };
     struct Edge {
       int peer;
       int32_t ring;
@@ -995,11 +1049,22 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     ring_prev_.resize(num_channels_);
     cross_next_.clear();
     cross_prev_.clear();
+    // Link self-healing plumbing: the committed peer table outlives
+    // wiring (mid-run reconnect targets), the cascade stream sequences
+    // restart per incarnation (a RESUME carries the epoch, so stale
+    // sequences can't collide), and a dead incarnation's parked resumes
+    // are dropped.
+    peer_hosts_ = peer_hosts;
+    peer_ports_ = peer_ports;
+    link_seq_global_.assign(num_channels_, 0);
+    link_seq_cross_.assign(num_channels_, 0);
+    HealInboxClear();
     std::vector<Edge> outgoing, incoming;
     for (int32_t c = 0; c < num_channels_; ++c) {
-      outgoing.push_back({(rank_ + 1) % size_, GLOBAL, c, &ring_next_[c]});
+      outgoing.push_back(
+          {(rank_ + 1) % size_, RING_GLOBAL, c, &ring_next_[c]});
       incoming.push_back(
-          {(rank_ - 1 + size_) % size_, GLOBAL, c, &ring_prev_[c]});
+          {(rank_ - 1 + size_) % size_, RING_GLOBAL, c, &ring_prev_[c]});
     }
     // Hierarchical-coordination control edges: every non-leader member
     // wires ONE control connection to its group leader (the leader's
@@ -1011,11 +1076,11 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       if (local_index_ == 0) {
         member_conns_.resize(group_size_);
         for (int m = 1; m < group_size_; ++m) {
-          incoming.push_back({group_members_[m], CTRL, 0,
+          incoming.push_back({group_members_[m], RING_CTRL, 0,
                               &member_conns_[m]});
         }
       } else {
-        outgoing.push_back({group_members_[0], CTRL, 0, &leader_conn_});
+        outgoing.push_back({group_members_[0], RING_CTRL, 0, &leader_conn_});
       }
     }
     if (two_level_ && local_index_ == 0 && nnodes_ > 1) {
@@ -1026,11 +1091,11 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
       cross_next_.resize(num_channels_);
       cross_prev_.resize(num_channels_);
       for (int32_t c = 0; c < num_channels_; ++c) {
-        outgoing.push_back({group_leaders_[(node_id_ + 1) % nnodes_], CROSS,
+        outgoing.push_back({group_leaders_[(node_id_ + 1) % nnodes_], RING_CROSS,
                             c, &cross_next_[c]});
         incoming.push_back({group_leaders_[(node_id_ - 1 + nnodes_) %
                                            nnodes_],
-                            CROSS, c, &cross_prev_[c]});
+                            RING_CROSS, c, &cross_prev_[c]});
       }
     }
     for (auto& edge : outgoing) {
@@ -1108,12 +1173,18 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     for (auto& s : ring_prev_) data_socks.push_back(&s);
     for (auto& s : cross_next_) data_socks.push_back(&s);
     for (auto& s : cross_prev_) data_socks.push_back(&s);
+    // ArmSocketDeadlines = keepalive probing PLUS TCP_USER_TIMEOUT bound
+    // to the (fault-capped) socket timeout: a silently-dead peer errors
+    // the socket inside the fault bound — data channels get a
+    // classifiable error the link-heal layer can act on, and control
+    // conns (rendezvous/CTRL) stop depending solely on the coordinator's
+    // patience for dead-peer detection.
     std::vector<Socket*> socks = data_socks;
     socks.push_back(&coordinator_conn_);
     for (Socket* s : socks) {
       if (s->valid()) {
         s->SetTimeouts(socket_timeout_sec_);
-        s->EnableKeepalive();
+        ArmSocketDeadlines(*s, socket_timeout_sec_);
       }
     }
     for (Socket* s : data_socks) {
@@ -1122,7 +1193,7 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     for (auto& c : worker_conns_) {
       if (c.valid()) {
         c.SetTimeouts(socket_timeout_sec_);
-        c.EnableKeepalive();
+        ArmSocketDeadlines(c, socket_timeout_sec_);
       }
     }
     // Hierarchical control edges get the control-plane transport bounds
@@ -1130,12 +1201,12 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     // surface within the same patience budget as any control peer.
     if (leader_conn_.valid()) {
       leader_conn_.SetTimeouts(socket_timeout_sec_);
-      leader_conn_.EnableKeepalive();
+      ArmSocketDeadlines(leader_conn_, socket_timeout_sec_);
     }
     for (auto& c : member_conns_) {
       if (c.valid()) {
         c.SetTimeouts(socket_timeout_sec_);
-        c.EnableKeepalive();
+        ArmSocketDeadlines(c, socket_timeout_sec_);
       }
     }
     // Shared-memory intra-host edges: the second channel kind.  Wired
@@ -1448,6 +1519,12 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     // committed world): behavior is driven by the per-cycle participant
     // bitmaps, but stats()["config"] must agree on every rank.
     w.i32(backup_workers_);
+    // Committed link-heal knobs: healing is a two-sided protocol (the
+    // sender re-dials, the receiver accepts+ACKs), so one endpoint
+    // healing an edge the other's env already abandoned must be
+    // impossible by construction.
+    w.i32(link_retries_);
+    w.i64(link_heal_timeout_ms_);
     w.vu(uniq_hosts.size());
     for (const auto& h : uniq_hosts) w.str(h);
     for (int i = 0; i < new_size; ++i) {
@@ -1587,10 +1664,14 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     int32_t committed_wave = r.i32();
     int64_t committed_algo = r.i64();
     int32_t committed_backup = r.i32();
+    int32_t committed_link_retries = r.i32();
+    int64_t committed_heal_ms = r.i64();
     if (!r.ok() || new_size < 1 || new_rank < 0 || new_rank >= new_size ||
         committed_channels < 1 || committed_channels > 16 ||
         committed_wave < 1 || committed_wave > 16 || committed_algo < 0 ||
-        committed_backup < 0 || committed_backup >= new_size) {
+        committed_backup < 0 || committed_backup >= new_size ||
+        committed_link_retries < 0 || committed_link_retries > 1000 ||
+        committed_heal_ms < 1) {
       lasterr = "bad membership assignment frame";
       break;
     }
@@ -1633,6 +1714,21 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     wave_width_.store(committed_wave);
     algo_threshold_.store(committed_algo);
     backup_workers_ = committed_backup;
+    link_retries_ = committed_link_retries;
+    // The committed deadline re-clamps against THIS rank's socket
+    // timeout: the coordinator clamped against its own, but "healing
+    // must finish strictly inside every other rank's no-progress
+    // patience" is a per-rank property — under heterogeneous
+    // HOROVOD_SOCKET_TIMEOUT_SEC, a worker with tighter patience would
+    // otherwise abort 'link: no progress' mid-way through a peer's
+    // committed-length heal.
+    link_heal_timeout_ms_ = committed_heal_ms;
+    if (socket_timeout_sec_ > 0) {
+      link_heal_timeout_ms_ = std::min<int64_t>(
+          link_heal_timeout_ms_,
+          static_cast<int64_t>(socket_timeout_sec_) * 1000 * 3 / 4);
+      if (link_heal_timeout_ms_ < 1) link_heal_timeout_ms_ = 1;
+    }
     if (new_rank != worker_id_ || new_size != world_size_) {
       std::fprintf(stderr,
                    "horovod_tpu worker id %d: joined membership epoch %lld "
@@ -1856,6 +1952,68 @@ void Engine::CloseSockets() {
   for (auto& c : member_conns_) c.Close();
   control_listener_.Close();
   data_listener_.Close();
+  // Parked RESUME connections belong to the incarnation being torn down.
+  HealInboxClear();
+}
+
+// -- link self-healing bookkeeping --
+
+void Engine::RecordLinkHealNs(int64_t ns) {
+  std::lock_guard<std::mutex> lk(heal_ns_mu_);
+  constexpr size_t kCap = 1024;
+  if (heal_ns_samples_.size() < kCap) {
+    heal_ns_samples_.push_back(ns);
+  } else {
+    heal_ns_samples_[heal_ns_next_ % kCap] = ns;
+  }
+  ++heal_ns_next_;
+}
+
+int64_t Engine::LinkHealNsPercentile(double p) const {
+  std::vector<int64_t> snap;
+  {
+    std::lock_guard<std::mutex> lk(heal_ns_mu_);
+    snap = heal_ns_samples_;
+  }
+  if (snap.empty()) return 0;
+  size_t idx = static_cast<size_t>(p * (snap.size() - 1) + 0.5);
+  if (idx >= snap.size()) idx = snap.size() - 1;
+  std::nth_element(snap.begin(), snap.begin() + idx, snap.end());
+  return snap[idx];
+}
+
+void Engine::HealInboxPut(int32_t ring, int32_t channel,
+                          const LinkResume& lr, Socket conn) {
+  std::lock_guard<std::mutex> lk(heal_mu_);
+  auto key = std::make_pair(ring, channel);
+  auto it = heal_inbox_.find(key);
+  if (it != heal_inbox_.end()) {
+    // Newest wins: the sender retries with fresh connects and abandons
+    // old ones, so a parked older conn is at best dead weight.
+    it->second = std::make_pair(lr, std::move(conn));
+    return;
+  }
+  heal_inbox_.emplace(key, std::make_pair(lr, std::move(conn)));
+  heal_inbox_size_.fetch_add(1);
+}
+
+bool Engine::HealInboxTake(int32_t ring, int32_t channel, LinkResume* lr,
+                           Socket* conn) {
+  if (heal_inbox_size_.load() == 0) return false;
+  std::lock_guard<std::mutex> lk(heal_mu_);
+  auto it = heal_inbox_.find(std::make_pair(ring, channel));
+  if (it == heal_inbox_.end()) return false;
+  *lr = it->second.first;
+  *conn = std::move(it->second.second);
+  heal_inbox_.erase(it);
+  heal_inbox_size_.fetch_sub(1);
+  return true;
+}
+
+void Engine::HealInboxClear() {
+  std::lock_guard<std::mutex> lk(heal_mu_);
+  heal_inbox_.clear();
+  heal_inbox_size_.store(0);
 }
 
 // ---------------------------------------------------------------------------
@@ -2016,6 +2174,10 @@ Engine::RingSpec Engine::TcpRingSpec() {
     spec.ports[c].next = &ring_next_[c];
     spec.ports[c].prev = &ring_prev_[c];
   }
+  spec.ring_id = RING_GLOBAL;
+  spec.next_peer = (rank_ + 1) % size_;
+  spec.prev_peer = (rank_ - 1 + size_) % size_;
+  spec.seq = &link_seq_global_;
   return spec;
 }
 
@@ -2042,6 +2204,10 @@ Engine::RingSpec Engine::CrossRingSpec() {
     spec.ports[c].next = &cross_next_[c];
     spec.ports[c].prev = &cross_prev_[c];
   }
+  spec.ring_id = RING_CROSS;
+  spec.next_peer = group_leaders_[(node_id_ + 1) % nnodes_];
+  spec.prev_peer = group_leaders_[(node_id_ - 1 + nnodes_) % nnodes_];
+  spec.seq = &link_seq_cross_;
   return spec;
 }
 
@@ -4578,8 +4744,9 @@ bool Engine::RingAllgatherPhaseCh(uint8_t* base,
 bool Engine::StreamingRingChannels(uint8_t* base,
                                    const std::vector<ChannelSegs>& channels,
                                    DataType dtype, ReduceOp op,
-                                   const RingSpec& spec, std::string* err,
-                                   bool rs_only) {
+                                   const RingSpec& spec,
+                                   const std::string& tname,
+                                   std::string* err, bool rs_only) {
   const size_t esize =
       spec.codec ? spec.codec->block_bytes : DataTypeSize(dtype);
   const int N = spec.rsize;
@@ -4628,7 +4795,6 @@ bool Engine::StreamingRingChannels(uint8_t* base,
   // so the transport branch is taken once, not per chunk.
   const bool is_shm = spec.ports[channels[0].ch].is_shm();
   std::vector<ChState> st(channels.size());
-  std::vector<std::unique_ptr<NonblockGuard>> guards;
   for (size_t i = 0; i < channels.size(); ++i) {
     ChState& c = st[i];
     c.segs = &channels[i];
@@ -4637,11 +4803,27 @@ bool Engine::StreamingRingChannels(uint8_t* base,
     int64_t max_seg = 0;
     for (auto n : c.segs->seg_count) max_seg = std::max(max_seg, n);
     c.tmp.reset(new uint8_t[static_cast<size_t>(max_seg) * esize]);
-    if (!is_shm) {
-      guards.emplace_back(new NonblockGuard(c.port->next->fd()));
-      guards.emplace_back(new NonblockGuard(c.port->prev->fd()));
+  }
+  // Cascade stream sequences: one bump per channel per invocation.  Both
+  // endpoints of an edge execute the same deterministic response sequence
+  // over the same channels, so the counters agree — a link-heal RESUME's
+  // seq names exactly one in-flight cascade on both sides.
+  std::vector<int64_t> ch_seq(st.size(), 0);
+  if (!is_shm && spec.seq != nullptr) {
+    for (size_t i = 0; i < st.size(); ++i) {
+      int ch = st[i].segs->ch;
+      if (ch >= 0 && ch < static_cast<int>(spec.seq->size())) {
+        ch_seq[i] = ++(*spec.seq)[ch];
+      }
     }
   }
+  // Link self-healing is a TCP-ring affair: shm edges have no socket to
+  // heal, and HOROVOD_LINK_RETRIES=0 restores the fail-fast path exactly.
+  const bool heal_on =
+      !is_shm && link_retries_ > 0 && spec.ring_id >= 0 &&
+      spec.seq != nullptr && spec.next_peer >= 0 && spec.prev_peer >= 0 &&
+      spec.next_peer < static_cast<int>(peer_hosts_.size()) &&
+      spec.prev_peer < static_cast<int>(peer_hosts_.size());
   auto seg_bytes = [&](const ChState& c, int seg) {
     return static_cast<size_t>(c.segs->seg_count[seg]) * esize;
   };
@@ -4774,25 +4956,417 @@ bool Engine::StreamingRingChannels(uint8_t* base,
       }
     }
   } else {
+  // -- TCP branch: poll-multiplexed cascade with link self-healing --
+  //
+  // A hard socket failure on a ring edge is classified SUSPECT instead of
+  // fatal when heal_on: the channel's cascade parks at its exact
+  // step/offset cursor while the edge re-establishes — the SENDER
+  // re-dials the receiver's data listener with a RESUME hello (bounded
+  // attempts/backoff), the RECEIVER ACKs its authoritative cursor, the
+  // sender rewinds, and the stream resumes bit-identically (un-received
+  // bytes are still intact in `base`: overwriting a chunk requires the
+  // ring to have cycled it all the way around, which implies the
+  // downstream receiver already consumed it).  Exhaustion escalates to
+  // the unchanged abort path carrying the ORIGINAL transport error, so
+  // culprit attribution is exactly what it was before healing existed.
+  struct Heal {
+    bool snd = false, rcv = false;  // per-direction suspect flags
+    std::string snd_err, rcv_err;   // the original (attributable) errors
+    std::chrono::steady_clock::time_point snd_t0, rcv_t0;
+    std::chrono::steady_clock::time_point snd_next;  // next re-dial
+    int snd_attempts = 0;
+    // The re-dial in flight: first a nonblocking connect awaiting
+    // POLLOUT (pending_connecting), then — hello sent — awaiting the
+    // ACK on POLLIN.  Both phases bounded by pending_deadline; neither
+    // ever blocks the driver's other channels.
+    Socket pending;
+    bool pending_connecting = false;
+    std::chrono::steady_clock::time_point pending_deadline;
+    bool span_open = false;
+  };
+  std::vector<Heal> heal(st.size());
+  const int64_t heal_ms = link_heal_timeout_ms_;
+  auto ms_since = [](std::chrono::steady_clock::time_point t) {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::steady_clock::now() - t)
+        .count();
+  };
+  // Backoff jitter (±25%): rank-keyed LCG so simultaneous two-sided
+  // failures don't re-dial in lockstep.
+  uint32_t jseed = static_cast<uint32_t>(rank_) * 2654435761u + 12345u;
+  auto jittered = [&jseed](int msv) {
+    jseed = jseed * 1664525u + 1013904223u;
+    int span = msv / 2;
+    return msv - msv / 4 + (span > 0 ? static_cast<int>(jseed % span) : 0);
+  };
+  auto set_nonblock = [](int fd) {
+    int fl = ::fcntl(fd, F_GETFL, 0);
+    if (fl >= 0) ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  };
+  auto set_block = [](int fd) {
+    int fl = ::fcntl(fd, F_GETFL, 0);
+    if (fl >= 0) ::fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+  };
+  for (auto& c : st) {
+    set_nonblock(c.port->next->fd());
+    set_nonblock(c.port->prev->fd());
+  }
+  auto last_progress = std::chrono::steady_clock::now();
+  // Injected recv-stall (rank:step:recv-stall:ms): stop draining the
+  // first channel until the deadline — a transient stall, not a failure.
+  std::chrono::steady_clock::time_point stall_until = last_progress;
+  size_t stall_idx = st.size();  // >= size: no stall armed
+  if (spec.ring_id == RING_GLOBAL) {
+    int64_t sms = fault_stall_ms_.exchange(0);
+    if (sms > 0) {
+      stall_idx = 0;
+      stall_until = last_progress + std::chrono::milliseconds(sms);
+      std::fprintf(stderr,
+                   "horovod_tpu rank %d: fault injection: not draining "
+                   "data channel %d for %lldms\n",
+                   rank_, st[0].segs->ch, static_cast<long long>(sms));
+    }
+  }
+  auto heal_span_open = [&](size_t i) {
+    if (!heal[i].span_open) {
+      timeline_.ActivityStartCh(tname, "LINK_HEAL", st[i].segs->ch + 1);
+      heal[i].span_open = true;
+    }
+  };
+  auto heal_span_close = [&](size_t i) {
+    if (heal[i].span_open && !heal[i].snd && !heal[i].rcv) {
+      timeline_.ActivityEndCh(tname, st[i].segs->ch + 1);
+      heal[i].span_open = false;
+    }
+  };
+  // Swap a freshly established connection into a ring port slot with the
+  // full data-socket option set the wiring path applies.
+  auto arm_healed = [&](Socket* slot, Socket conn) {
+    *slot = std::move(conn);
+    slot->SetTimeouts(socket_timeout_sec_);
+    ArmSocketDeadlines(*slot, socket_timeout_sec_);
+    slot->SetBufSizes(socket_buf_bytes_);
+    set_nonblock(slot->fd());
+  };
+  // Classify a hard failure.  Returns false (fatal, *err set) when
+  // healing is off — the pre-heal behavior, bit for bit.
+  auto suspect_snd = [&](size_t i, const std::string& what) -> bool {
+    if (!heal_on) {
+      *err = what;
+      return false;
+    }
+    Heal& h = heal[i];
+    if (h.snd) return true;  // already healing this direction
+    h.snd = true;
+    h.snd_err = what;
+    h.snd_t0 = std::chrono::steady_clock::now();
+    h.snd_next = h.snd_t0;  // first re-dial immediately
+    h.snd_attempts = 0;
+    heal_span_open(i);
+    GlobalFlightRecorder().Record(
+        "link", control_cycle_seq_, "suspect snd ch=%d seq=%lld: %s",
+        st[i].segs->ch, static_cast<long long>(ch_seq[i]),
+        what.c_str());
+    return true;
+  };
+  auto suspect_rcv = [&](size_t i, const std::string& what) -> bool {
+    if (!heal_on) {
+      *err = what;
+      return false;
+    }
+    Heal& h = heal[i];
+    if (h.rcv) return true;
+    h.rcv = true;
+    h.rcv_err = what;
+    h.rcv_t0 = std::chrono::steady_clock::now();
+    heal_span_open(i);
+    GlobalFlightRecorder().Record(
+        "link", control_cycle_seq_, "suspect rcv ch=%d seq=%lld: %s",
+        st[i].segs->ch, static_cast<long long>(ch_seq[i]),
+        what.c_str());
+    return true;
+  };
+  auto escalate = [&](size_t i, bool snd_dir) {
+    Heal& h = heal[i];
+    const std::string& base_err = snd_dir ? h.snd_err : h.rcv_err;
+    *err = base_err + " (link healing gave up after " +
+           std::to_string(snd_dir ? h.snd_attempts : 0) + " reconnect "
+           "attempts in " +
+           std::to_string(ms_since(snd_dir ? h.snd_t0 : h.rcv_t0)) + "ms)";
+    ok = false;
+    link_heal_failures_.fetch_add(1);
+    GlobalFlightRecorder().Record(
+        "link", control_cycle_seq_, "escalate %s ch=%d: %s",
+        snd_dir ? "snd" : "rcv", st[i].segs->ch, base_err.c_str());
+  };
+  // Abandon the in-flight re-dial (if any) and schedule the next one.
+  auto redial_backoff = [&](Heal& h) {
+    h.pending.Close();
+    h.pending_connecting = false;
+    h.snd_next =
+        std::chrono::steady_clock::now() +
+        std::chrono::milliseconds(jittered(
+            std::min(1000, 50 << std::min(h.snd_attempts, 5))));
+  };
+  // Send the RESUME hello on a freshly connected socket and start the
+  // ACK wait.  The 48-byte hello lands in an empty send buffer, so the
+  // (bounded, 2 s) blocking send cannot actually park the loop.
+  auto send_hello = [&](size_t i, Socket s) {
+    Heal& h = heal[i];
+    LinkResume lr;
+    lr.origin = rank_;
+    lr.ring = spec.ring_id;
+    lr.channel = st[i].segs->ch;
+    lr.epoch = epoch_.load();
+    lr.seq = ch_seq[i];
+    s.SetTimeouts(2);
+    if (!s.SendAll(&lr, sizeof(lr))) {
+      redial_backoff(h);
+      return;
+    }
+    h.pending = std::move(s);
+    h.pending_connecting = false;
+    h.pending_deadline = std::chrono::steady_clock::now() +
+                         std::chrono::milliseconds(2000);
+  };
+  // One sender-heal re-dial: NONBLOCKING connect (the in-flight fd joins
+  // the poll set — a driver multiplexing several channels must not park
+  // its healthy channels for a connect timeout) + RESUME hello; the ACK
+  // is collected asynchronously too, so concurrent two-sided heals
+  // (both neighbors re-dialing each other) cannot deadlock on each
+  // other's ACK waits.
+  auto try_redial = [&](size_t i) {
+    Heal& h = heal[i];
+    auto now = std::chrono::steady_clock::now();
+    if (!h.snd || h.pending.valid() || now < h.snd_next ||
+        h.snd_attempts >= link_retries_) {
+      return;
+    }
+    ++h.snd_attempts;
+    auto deadline = h.snd_t0 + std::chrono::milliseconds(heal_ms);
+    int64_t left = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       deadline - now)
+                       .count();
+    if (left <= 0) return;  // the escalation sweep handles expiry
+    std::string cerr;
+    bool in_progress = false;
+    Socket s = ConnectStart(peer_hosts_[spec.next_peer],
+                            peer_ports_[spec.next_peer], &in_progress,
+                            &cerr);
+    if (!s.valid()) {
+      redial_backoff(h);
+      return;
+    }
+    h.pending_deadline =
+        now + std::chrono::milliseconds(
+                  std::min<int64_t>(1000, std::max<int64_t>(50, left)));
+    if (in_progress) {
+      h.pending = std::move(s);
+      h.pending_connecting = true;
+      return;
+    }
+    send_hello(i, std::move(s));
+  };
+  // Service a RESUME naming one of THIS cascade's prev edges: ACK the
+  // authoritative receive cursor, swap the healed socket in.  Returns
+  // false only when the peer's stream moved past ours — the missing tail
+  // is unrecoverable and the rcv suspect escalates.
+  auto handle_resume = [&](size_t i, const LinkResume& lr,
+                           Socket conn) -> bool {
+    ChState& c = st[i];
+    Heal& h = heal[i];
+    LinkResumeAck ack;
+    ack.ok = (lr.seq == ch_seq[i]) ? 1 : 0;
+    ack.seq = ch_seq[i];
+    ack.step = c.rs;
+    ack.offset = static_cast<int64_t>(c.ro);
+    conn.SetTimeouts(2);
+    if (!conn.SendAll(&ack, sizeof(ack))) {
+      return true;  // sender abandoned this conn; it will re-dial
+    }
+    if (ack.ok == 0) {
+      if (lr.seq > ch_seq[i] && h.rcv) {
+        escalate(i, /*snd_dir=*/false);
+        *err = h.rcv_err +
+               " (link heal failed: peer moved to a newer stream — the "
+               "lost bytes are no longer replayable)";
+        return false;
+      }
+      return true;  // stale resume for an older stream: declined
+    }
+    arm_healed(c.port->prev, std::move(conn));
+    link_reconnects_.fetch_add(1);
+    auto now = std::chrono::steady_clock::now();
+    if (h.rcv) {
+      RecordLinkHealNs(
+          std::chrono::duration_cast<std::chrono::nanoseconds>(now -
+                                                               h.rcv_t0)
+              .count());
+      h.rcv = false;
+      heal_span_close(i);
+      GlobalFlightRecorder().Record(
+          "link", control_cycle_seq_,
+          "healed rcv ch=%d seq=%lld step=%lld off=%lld", st[i].segs->ch,
+          static_cast<long long>(ch_seq[i]),
+          static_cast<long long>(ack.step),
+          static_cast<long long>(ack.offset));
+    } else {
+      // Asymmetric failure: the sender detected a break our side never
+      // saw (e.g. its TCP_USER_TIMEOUT fired while our direction only
+      // went silent).  Adopt the fresh edge — the ACK cursor makes the
+      // rewind exact either way.
+      GlobalFlightRecorder().Record(
+          "link", control_cycle_seq_,
+          "peer-initiated resume ch=%d seq=%lld", st[i].segs->ch,
+          static_cast<long long>(ch_seq[i]));
+    }
+    last_progress = now;
+    return true;
+  };
   std::vector<pollfd> fds;
-  std::vector<std::pair<int, int>> owner;  // (channel idx, 0=send 1=recv)
+  // (channel idx, kind): 0 = send, 1 = recv, 2 = pending ACK,
+  // 3 = data listener, 4 = send-socket liveness probe (a broken edge is
+  // only visible to an idle sender through the reverse direction's
+  // EOF/error — without the probe, a receiver whose sender has nothing
+  // left to send would park for the full heal budget and escalate).
+  std::vector<std::pair<int, int>> owner;
   while (ok) {
+    auto now = std::chrono::steady_clock::now();
+    // Injected conn-reset: fire once bytes have moved (mid-cascade).
+    if (spec.ring_id == RING_GLOBAL && fault_conn_reset_.load()) {
+      int64_t moved = 0;
+      for (auto& c : st) moved += static_cast<int64_t>(c.tx + c.rx);
+      if (moved > 0 && fault_conn_reset_.exchange(false)) {
+        ChState& c0 = st[0];
+        int fd = fault_reset_prev_ ? c0.port->prev->fd()
+                                   : c0.port->next->fd();
+        std::fprintf(stderr,
+                     "horovod_tpu rank %d: fault injection: shutting down "
+                     "data channel %d %s socket mid-cascade\n",
+                     rank_, c0.segs->ch,
+                     fault_reset_prev_ ? "recv" : "send");
+        GlobalFlightRecorder().Record(
+            "link", control_cycle_seq_,
+            "fault-inject conn-reset ch=%d side=%s", c0.segs->ch,
+            fault_reset_prev_ ? "recv" : "send");
+        ::shutdown(fd, SHUT_RDWR);
+      }
+    }
+    // Escalate suspects that exhausted their budget.
+    for (size_t i = 0; ok && i < st.size(); ++i) {
+      Heal& h = heal[i];
+      if (h.snd &&
+          (ms_since(h.snd_t0) > heal_ms ||
+           (h.snd_attempts >= link_retries_ && !h.pending.valid()))) {
+        escalate(i, /*snd_dir=*/true);
+      }
+      if (ok && h.rcv && ms_since(h.rcv_t0) > heal_ms) {
+        escalate(i, /*snd_dir=*/false);
+      }
+      // Per-attempt bound on the in-flight re-dial (connect or ACK
+      // phase): expire it and let the backoff schedule the next one.
+      if (ok && h.pending.valid() && now > h.pending_deadline) {
+        redial_backoff(h);
+      }
+    }
+    if (!ok) break;
+    for (size_t i = 0; i < st.size(); ++i) try_redial(i);
+    // Parked resumes deposited by other cascades/drivers.
+    if (heal_on && heal_inbox_size_.load() > 0) {
+      for (size_t i = 0; ok && i < st.size(); ++i) {
+        LinkResume lr;
+        Socket conn;
+        if (HealInboxTake(spec.ring_id, st[i].segs->ch, &lr, &conn)) {
+          if (lr.epoch == epoch_.load() && lr.origin == spec.prev_peer) {
+            ok = handle_resume(i, lr, std::move(conn));
+          }
+        }
+      }
+      if (!ok) break;
+    }
+    bool all_done = true;
+    for (auto& c : st) {
+      if (c.ss < nsteps || c.rs < nsteps) {
+        all_done = false;
+        break;
+      }
+    }
+    if (all_done) break;
+    const bool stall_active = stall_idx < st.size() && now < stall_until;
+    bool heals_active = false;
     fds.clear();
     owner.clear();
     for (size_t i = 0; i < st.size(); ++i) {
       ChState& c = st[i];
-      if (c.ss < nsteps && c.so < c.ready[c.ss]) {
-        fds.push_back({c.port->next->fd(), POLLOUT, 0});
-        owner.emplace_back(static_cast<int>(i), 0);
+      Heal& h = heal[i];
+      heals_active = heals_active || h.snd || h.rcv;
+      if (!h.snd) {
+        if (c.ss < nsteps && c.so < c.ready[c.ss]) {
+          fds.push_back({c.port->next->fd(), POLLOUT, 0});
+          owner.emplace_back(static_cast<int>(i), 0);
+        } else if (heal_on && c.ss < nsteps) {
+          // Liveness probe: nothing eligible to send, but the edge still
+          // owes bytes — a reverse-direction EOF/error is the only
+          // prompt signal that the connection died under an idle sender.
+          fds.push_back({c.port->next->fd(),
+                         static_cast<short>(POLLIN | POLLRDHUP), 0});
+          owner.emplace_back(static_cast<int>(i), 4);
+        }
       }
-      if (c.rs < nsteps) {
+      if (h.pending.valid()) {
+        fds.push_back({h.pending.fd(),
+                       static_cast<short>(h.pending_connecting ? POLLOUT
+                                                               : POLLIN),
+                       0});
+        owner.emplace_back(static_cast<int>(i), 2);
+      }
+      if (!h.rcv && c.rs < nsteps && !(stall_active && i == stall_idx)) {
         fds.push_back({c.port->prev->fd(), POLLIN, 0});
         owner.emplace_back(static_cast<int>(i), 1);
       }
     }
-    if (fds.empty()) break;  // every channel's cascade completed
+    if (heal_on && data_listener_.valid()) {
+      fds.push_back({data_listener_.fd(), POLLIN, 0});
+      owner.emplace_back(-1, 3);
+    }
+    // No-progress budget (the pre-heal "link:" abort): suspended while a
+    // suspect's own deadline governs, restored the moment healing ends.
+    int64_t budget_left = -1;
+    if (timeout_ms > 0) {
+      budget_left = timeout_ms - ms_since(last_progress);
+      if (budget_left <= 0 && !heals_active) {
+        *err = "link: no progress for " +
+               std::to_string(timeout_ms / 1000) + "s (peer hung?)";
+        ok = false;
+        break;
+      }
+    }
+    int64_t slice = timeout_ms > 0 ? std::max<int64_t>(budget_left, 1)
+                                   : -1;
+    if (heal_on) {
+      // Bounded slices keep inbox pickup, re-dial backoff timers and
+      // suspect deadlines responsive even when no fd fires.
+      slice = slice < 0 ? 250 : std::min<int64_t>(slice, 250);
+    }
+    if (stall_active) {
+      int64_t stall_left =
+          std::chrono::duration_cast<std::chrono::milliseconds>(
+              stall_until - now)
+              .count() +
+          1;
+      slice = slice < 0 ? stall_left
+                        : std::min<int64_t>(slice, stall_left);
+    }
+    if (fds.empty()) {
+      // Everything pending is parked (suspect waits / stall): nap one
+      // slice and re-evaluate — deadlines above bound the loop.
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(std::max<int64_t>(
+              1, std::min<int64_t>(slice < 0 ? 50 : slice, 50))));
+      continue;
+    }
     int rc = ::poll(fds.data(), static_cast<nfds_t>(fds.size()),
-                    timeout_ms > 0 ? timeout_ms : -1);
+                    static_cast<int>(slice));
     if (rc < 0) {
       if (errno == EINTR) continue;
       *err = std::string("poll: ") + strerror(errno);
@@ -4800,19 +5374,182 @@ bool Engine::StreamingRingChannels(uint8_t* base,
       break;
     }
     if (rc == 0) {
-      *err = "link: no progress for " + std::to_string(timeout_ms / 1000) +
-             "s (peer hung?)";
-      ok = false;
-      break;
+      if (!heal_on && !stall_active) {
+        *err = "link: no progress for " +
+               std::to_string(timeout_ms / 1000) + "s (peer hung?)";
+        ok = false;
+        break;
+      }
+      continue;  // deadline sweeps at the loop top decide what's next
     }
     // Drain loops: after one poll wakeup, move bytes until EAGAIN (or a
     // cursor runs out of eligible work) — poll syscalls are the
     // expensive part on sandboxed kernels, so each should amortize as
     // much IO as the buffers will take.
     for (size_t f = 0; ok && f < fds.size(); ++f) {
+      const int kind = owner[f].second;
+      if (kind == 3) {
+        if ((fds[f].revents & POLLIN) == 0) continue;
+        // Accept every ready connection: RESUME hellos for my channels
+        // are serviced here; anyone else's are parked in the inbox.
+        // Bounded per drain pass: a genuine RESUME arrives with its
+        // hello bytes already in flight (the sender writes it right
+        // after connect), so a connection with nothing readable within
+        // a fraction of a slice is a silent stray (health probe,
+        // scanner) — drop it instead of parking the cascade, the
+        // PollJoinCandidate discipline applied to the data listener.
+        // Worst-case synchronous stall: 2 × 50 ms per pass, only while
+        // someone is actively dialing the data port.
+        for (int accepts = 0; accepts < 2; ++accepts) {
+          Socket conn = TryAcceptNow(data_listener_);
+          if (!conn.valid()) break;
+          if (!WaitReadable(conn, 50)) continue;  // silent stray: drop
+          // Peek-validate before committing to a read: a genuine RESUME
+          // arrives as one 48-byte write right behind the connect, so
+          // anything shorter after the readability wait is a stray (a
+          // prober that sent a byte) or a torn hello (the sender will
+          // re-dial) — drop it rather than park the drain loop in a
+          // blocking read on an untrusted connection.
+          LinkResume lr;
+          ssize_t pk = ::recv(conn.fd(), &lr, sizeof(lr),
+                              MSG_PEEK | MSG_DONTWAIT);
+          if (pk != static_cast<ssize_t>(sizeof(lr)) ||
+              !ValidLinkResume(lr)) {
+            continue;
+          }
+          conn.SetTimeouts(1);
+          if (!conn.RecvAll(&lr, sizeof(lr))) {  // consume; cannot block
+            continue;
+          }
+          if (lr.epoch != epoch_.load()) continue;  // dead incarnation
+          bool mine = false;
+          for (size_t i = 0; i < st.size(); ++i) {
+            if (st[i].segs->ch == lr.channel &&
+                spec.ring_id == lr.ring && spec.prev_peer == lr.origin) {
+              ok = handle_resume(i, lr, std::move(conn));
+              mine = true;
+              break;
+            }
+          }
+          if (!mine && conn.valid()) {
+            HealInboxPut(static_cast<int32_t>(lr.ring),
+                         static_cast<int32_t>(lr.channel), lr,
+                         std::move(conn));
+          }
+          if (!ok) break;
+        }
+        continue;
+      }
       ChState& c = st[owner[f].first];
-      if (owner[f].second == 0) {
-        if ((fds[f].revents & (POLLOUT | POLLERR | POLLHUP)) == 0) continue;
+      Heal& h = heal[owner[f].first];
+      // A swap earlier in THIS drain pass (listener-serviced resume)
+      // invalidates poll entries that captured the replaced fd — touching
+      // them would recv/send on a closed (or reused) descriptor.
+      if ((kind == 0 || kind == 4) && fds[f].fd != c.port->next->fd()) {
+        continue;
+      }
+      if (kind == 1 && fds[f].fd != c.port->prev->fd()) continue;
+      if (kind == 2 &&
+          (!h.pending.valid() || fds[f].fd != h.pending.fd())) {
+        continue;
+      }
+      if (kind == 2 && h.pending_connecting) {
+        if ((fds[f].revents & (POLLOUT | POLLERR | POLLHUP)) == 0) {
+          continue;
+        }
+        std::string cerr;
+        if (!ConnectFinish(h.pending, &cerr)) {
+          redial_backoff(h);
+          continue;
+        }
+        send_hello(owner[f].first, std::move(h.pending));
+        continue;  // the ACK arrives through a later POLLIN
+      }
+      if (kind == 2) {
+        if ((fds[f].revents & (POLLIN | POLLERR | POLLHUP)) == 0) {
+          continue;
+        }
+        LinkResumeAck ack;
+        bool got = h.pending.RecvAll(&ack, sizeof(ack)) &&
+                   ValidLinkResumeAck(ack);
+        if (got && ack.ok == 1 && ack.seq == ch_seq[owner[f].first] &&
+            ack.step >= 0 && ack.step <= nsteps &&
+            (ack.step == nsteps ||
+             static_cast<size_t>(ack.offset) <=
+                 seg_bytes(c, send_seg[ack.step]))) {
+          // REWIND to the receiver's authoritative cursor: everything at
+          // or past it is still intact in `base` (credit-chain
+          // guarantee), so the resent bytes are identical.
+          c.ss = static_cast<int>(ack.step);
+          c.so = ack.step == nsteps ? 0
+                                    : static_cast<size_t>(ack.offset);
+          advance_sender(c);
+          auto healed_at = std::chrono::steady_clock::now();
+          arm_healed(c.port->next, std::move(h.pending));
+          link_reconnects_.fetch_add(1);
+          RecordLinkHealNs(
+              std::chrono::duration_cast<std::chrono::nanoseconds>(
+                  healed_at - h.snd_t0)
+                  .count());
+          h.snd = false;
+          heal_span_close(owner[f].first);
+          GlobalFlightRecorder().Record(
+              "link", control_cycle_seq_,
+              "healed snd ch=%d seq=%lld rewind step=%lld off=%lld",
+              c.segs->ch,
+              static_cast<long long>(ch_seq[owner[f].first]),
+              static_cast<long long>(ack.step),
+              static_cast<long long>(ack.offset));
+          last_progress = healed_at;
+        } else if (got && (ack.ok == 0 ||
+                           ack.seq != ch_seq[owner[f].first])) {
+          if (ack.seq < ch_seq[owner[f].first]) {
+            // The receiver is still on an OLDER cascade of this channel
+            // (e.g. draining the broken socket's buffered tail of the
+            // previous collective — a FIN'd socket keeps delivering
+            // buffered bytes).  It will catch up to our stream; back
+            // off and re-dial instead of aborting a healable blip.
+            redial_backoff(h);
+          } else {
+            // The receiver's stream moved PAST ours: the bytes it
+            // still owed us are unrecoverable — escalate with the
+            // original attribution.
+            h.pending.Close();
+            escalate(owner[f].first, /*snd_dir=*/true);
+          }
+        } else {
+          // Dead or garbled ACK conn: back off and re-dial.
+          h.pending.Close();
+          h.snd_next = std::chrono::steady_clock::now() +
+                       std::chrono::milliseconds(jittered(std::min(
+                           1000, 50 << std::min(h.snd_attempts, 5))));
+        }
+        continue;
+      }
+      if (kind == 4) {
+        if ((fds[f].revents &
+             (POLLIN | POLLRDHUP | POLLERR | POLLHUP)) == 0) {
+          continue;
+        }
+        // The send socket should never become readable: EOF/error means
+        // the edge died while this sender had nothing eligible to send.
+        char probe;
+        ssize_t k = ::recv(c.port->next->fd(), &probe, 1, 0);
+        if (k == 0 ||
+            (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+             errno != EINTR)) {
+          ok = suspect_snd(
+              owner[f].first,
+              std::string("send to peer: ") +
+                  (k == 0 ? "connection closed (peer process exited?)"
+                          : strerror(errno)));
+        }
+        continue;
+      }
+      if (kind == 0) {
+        if ((fds[f].revents & (POLLOUT | POLLERR | POLLHUP)) == 0) {
+          continue;
+        }
         while (c.ss < nsteps && c.so < c.ready[c.ss]) {
           const uint8_t* p =
               base + c.segs->seg_off[send_seg[c.ss]] * esize + c.so;
@@ -4821,13 +5558,15 @@ bool Engine::StreamingRingChannels(uint8_t* base,
           if (k > 0) {
             c.so += static_cast<size_t>(k);
             c.tx += static_cast<size_t>(k);
+            last_progress = std::chrono::steady_clock::now();
             advance_sender(c);
           } else if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
                                errno == EINTR)) {
             break;
           } else {
-            *err = std::string("send to peer: ") + strerror(errno);
-            ok = false;
+            ok = suspect_snd(owner[f].first,
+                             std::string("send to peer: ") +
+                                 strerror(errno));
             break;
           }
         }
@@ -4844,23 +5583,35 @@ bool Engine::StreamingRingChannels(uint8_t* base,
           if (k > 0) {
             c.ro += static_cast<size_t>(k);
             c.rx += static_cast<size_t>(k);
+            last_progress = std::chrono::steady_clock::now();
             credit_recv(c, static_cast<size_t>(k));
           } else if (k == 0) {
-            *err =
-                "recv from peer: connection closed (peer process exited?)";
-            ok = false;
+            ok = suspect_rcv(
+                owner[f].first,
+                "recv from peer: connection closed (peer process "
+                "exited?)");
             break;
           } else if (errno == EAGAIN || errno == EWOULDBLOCK ||
                      errno == EINTR) {
             break;
           } else {
-            *err = std::string("recv from peer: ") + strerror(errno);
-            ok = false;
+            ok = suspect_rcv(owner[f].first,
+                             std::string("recv from peer: ") +
+                                 strerror(errno));
             break;
           }
         }
       }
     }
+  }
+  // Close any mid-flight re-dial and restore blocking mode on the ring
+  // sockets (frame-based ops — broadcast relays, allgather steps — rely
+  // on blocking reads).  A failed cascade's sockets may already be dead;
+  // restoring flags on them is harmless.
+  for (auto& h : heal) h.pending.Close();
+  for (auto& c : st) {
+    if (c.port->next->valid()) set_block(c.port->next->fd());
+    if (c.port->prev->valid()) set_block(c.port->prev->fd());
   }
   }  // transport branch
   wire_ns_.fetch_add(std::chrono::duration_cast<std::chrono::nanoseconds>(
@@ -4948,8 +5699,8 @@ bool Engine::ChanneledRingAllreduce(uint8_t* base, int64_t count,
       timeline_.ActivityStartCh(tname, spec.span + std::to_string(cs.ch),
                                 cs.ch + 1);
     }
-    bool ok = StreamingRingChannels(base, part, dtype, op, spec, derr,
-                                    rs_only);
+    bool ok = StreamingRingChannels(base, part, dtype, op, spec, tname,
+                                    derr, rs_only);
     for (const auto& cs : part) timeline_.ActivityEndCh(tname, cs.ch + 1);
     return ok;
   };
@@ -6140,6 +6891,15 @@ void Engine::CheckForStalledTensors() {
 void Engine::MaybeInjectFault() {
   if (fault_kind_ == FaultKind::NONE) return;
   int64_t idx = enqueue_count_.fetch_add(1);
+  if (fault_kind_ == FaultKind::CONN_RESET && fault_step_ == -2) {
+    // Flap schedule (step '*'): arm a reset every K-th enqueue, skipping
+    // enqueue 0 so wiring warms up.  Recurring by design — never sets
+    // fault_fired_, so a flap soak keeps flapping across the whole run.
+    if (idx > 0 && idx % fault_reset_period_ == 0) {
+      fault_conn_reset_.store(true);
+    }
+    return;
+  }
   if (fault_step_ != -2 && idx != fault_step_) return;  // -2: every step
   if (fault_kind_ == FaultKind::SLOW) {
     // Straggler injection: delay THIS enqueue in the API thread (the
@@ -6180,6 +6940,22 @@ void Engine::MaybeInjectFault() {
       break;
     case FaultKind::SLOW:
       break;  // handled above
+    case FaultKind::CONN_RESET:
+      std::fprintf(stderr,
+                   "horovod_tpu rank %d: fault injection: arming a data-"
+                   "channel %s-socket reset at enqueue %lld\n",
+                   rank_, fault_reset_prev_ ? "recv" : "send",
+                   static_cast<long long>(idx));
+      fault_conn_reset_.store(true);
+      break;
+    case FaultKind::RECV_STALL:
+      std::fprintf(stderr,
+                   "horovod_tpu rank %d: fault injection: arming a %lldms "
+                   "recv stall at enqueue %lld\n",
+                   rank_, static_cast<long long>(fault_stall_len_ms_),
+                   static_cast<long long>(idx));
+      fault_stall_ms_.store(fault_stall_len_ms_);
+      break;
     case FaultKind::STALE_EPOCH:
       // Worker-only (the coordinator sends no RequestList frames): the
       // next control frame is preceded by a duplicate stamped epoch-1,

@@ -346,6 +346,21 @@ class Engine {
   }
   bool backup_armed() const { return backup_armed_.load(); }
   int64_t backup_skips() const { return backup_skips_.load(); }
+  // Link self-healing observability (HOROVOD_LINK_RETRIES /
+  // HOROVOD_LINK_HEAL_TIMEOUT_MS).  `link_reconnects` counts data-channel
+  // edges transparently re-established mid-collective (each healed edge
+  // counts once per endpoint: the sender that re-dialed and the receiver
+  // that accepted+ACKed); `link_heal_failures` counts suspects that
+  // exhausted the retry/deadline budget and escalated to the unchanged
+  // abort path; `link_heal_ns_p50/p99` are sliding-window percentiles of
+  // suspect→healed durations on this rank.  All zero under
+  // HOROVOD_LINK_RETRIES=0 — the observable proof healing never ran.
+  int64_t link_reconnects() const { return link_reconnects_.load(); }
+  int64_t link_heal_failures() const { return link_heal_failures_.load(); }
+  int64_t link_heal_ns_p50() const { return LinkHealNsPercentile(0.50); }
+  int64_t link_heal_ns_p99() const { return LinkHealNsPercentile(0.99); }
+  int link_retries() const { return link_retries_; }
+  int64_t link_heal_timeout_ms() const { return link_heal_timeout_ms_; }
   int64_t local_sgd_syncs() const { return local_sgd_syncs_.load(); }
   void NoteLocalSgdSync() { local_sgd_syncs_.fetch_add(1); }
   int64_t step_time_ns_p50() const { return StepTimeNsPercentile(0.50); }
@@ -566,6 +581,11 @@ class Engine {
   // wire streams live on disjoint socket pairs.  `channel` also indexes
   // the fusion scratch slot, keeping concurrent fused batches off each
   // other's buffers.
+  // Ring identities stamped into the wiring handshake (hello[1]) and the
+  // link-heal RESUME frames.
+  enum RingId : int32_t {
+    RING_GLOBAL = 0, RING_LOCAL = 1, RING_CROSS = 2, RING_CTRL = 3,
+  };
   // One channel's duplex transport toward the ring neighbors: exactly one
   // of (TCP sockets, shm edges) is set.  RingSpec bundles a whole ring's
   // identity — who I am on it, how many ranks it has, and its per-channel
@@ -602,6 +622,16 @@ class Engine {
     // this spec's ports are compressed payload → compressed_bytes_tx).
     const WireCodec* codec = nullptr;
     bool compressed = false;
+    // Link self-healing identity: which RingId this spec's TCP edges
+    // belong to, the committed neighbor ranks (reconnect targets via the
+    // peer table), and the per-channel cascade stream-sequence counters
+    // (both endpoints of an edge count the same deterministic response
+    // sequence per channel, so a RESUME's seq identifies the exact
+    // in-flight cascade).  ring_id < 0 / null seq = healing not
+    // applicable (shm rings).
+    int32_t ring_id = -1;
+    int next_peer = -1, prev_peer = -1;
+    std::vector<int64_t>* seq = nullptr;
   };
 
   struct ExecCtx {
@@ -702,8 +732,8 @@ class Engine {
   bool StreamingRingChannels(uint8_t* base,
                              const std::vector<ChannelSegs>& channels,
                              DataType dtype, ReduceOp op,
-                             const RingSpec& spec, std::string* err,
-                             bool rs_only = false);
+                             const RingSpec& spec, const std::string& tname,
+                             std::string* err, bool rs_only = false);
   // Star-shaped shard delivery down the shm star: the leader (group
   // position 0), holding the fully reduced buffer, sends each member
   // exactly its owned slice [shard_off[m], shard_off[m]+shard_count[m])
@@ -836,10 +866,28 @@ class Engine {
   // thread (the background loop keeps heartbeating: a STRAGGLER, not a
   // wedge).  step may be '*' (every enqueue, recurring) so chaos
   // schedules can make a rank permanently slow without killing it.
-  enum class FaultKind { NONE, EXIT, HANG, DROP_CONN, STALE_EPOCH, SLOW };
+  // conn-reset: rank:step:conn-reset[:prev] — the rank SHUTDOWN(2)s one
+  // of its own data-channel sockets the next time a streaming cascade has
+  // moved bytes (send side by default; `prev` shoots the recv side, which
+  // discards buffered inbound bytes — the realistic lost-data case the
+  // RESUME rewind must repair).  step '*' with a numeric 4th field K
+  // re-arms every K-th enqueue (a deterministic flap schedule).
+  // recv-stall: rank:step:recv-stall:ms — the next cascade stops draining
+  // one channel for ms (a transient network/scheduling stall, NOT a dead
+  // link: progress resumes by itself and healing must not reconnect).
+  enum class FaultKind {
+    NONE, EXIT, HANG, DROP_CONN, STALE_EPOCH, SLOW, CONN_RESET, RECV_STALL
+  };
   FaultKind fault_kind_ = FaultKind::NONE;
   int64_t fault_step_ = -1;     // -2: every step ('*')
   int64_t fault_slow_ms_ = 0;
+  int64_t fault_reset_period_ = 1;   // conn-reset '*': every K-th enqueue
+  bool fault_reset_prev_ = false;    // shoot the recv-side socket instead
+  int64_t fault_stall_len_ms_ = 200;
+  // Armed by MaybeInjectFault (API thread), consumed by the next GLOBAL-
+  // ring streaming cascade (background/pool thread).
+  std::atomic<bool> fault_conn_reset_{false};
+  std::atomic<int64_t> fault_stall_ms_{0};
   // Survives re-Init: an injected fault fires once per process, so an
   // in-process elastic recovery (shutdown + init with the env var still
   // set) does not re-fire it on every incarnation.
@@ -1265,6 +1313,59 @@ class Engine {
   // adding channels never oversubscribes a small host.
   int channel_drivers_ = 1;
   DataPool pool_;
+
+  // -- link self-healing (HOROVOD_LINK_RETRIES > 0) --
+  // A data-channel socket failure mid-cascade (reset/EOF/TCP_USER_TIMEOUT)
+  // is classified SUSPECT instead of fatal: the channel's cascade parks at
+  // its exact step/offset cursor while the edge's sender re-dials the
+  // receiver's data listener with a RESUME hello (capped-backoff loop,
+  // at most link_retries_ attempts within link_heal_timeout_ms_) and the
+  // receiver ACKs its authoritative cursor so the sender rewinds — the
+  // collective then completes bit-identically (resent bytes are re-read
+  // from the same buffer positions; the pipeline's credit chain
+  // guarantees un-received bytes are never overwritten).  Exhaustion
+  // escalates to the UNCHANGED abort path with the original transport
+  // error (same culprit attribution).  =0 disables healing entirely —
+  // behavior is bit-for-bit the pre-heal engine.  Both knobs are the
+  // coordinator's resolution, committed in the ASSIGN frame: a
+  // heterogeneous env must not leave one endpoint healing an edge the
+  // other already abandoned.
+  int link_retries_ = 3;
+  int64_t link_heal_timeout_ms_ = 10000;
+  // Committed peer table (host:port per rank), kept for mid-run
+  // reconnects; refreshed by every rendezvous.
+  std::vector<std::string> peer_hosts_;
+  std::vector<int> peer_ports_;
+  // Per-channel cascade stream sequences (GLOBAL ring / leader CROSS
+  // ring).  Each StreamingRingChannels invocation bumps its channels'
+  // counters; both endpoints of an edge execute the same deterministic
+  // response sequence over the same channels, so the counters agree and
+  // a RESUME names exactly one in-flight cascade.  Channel-disjoint
+  // writers (wave/driver assignment) — no lock needed.
+  std::vector<int64_t> link_seq_global_, link_seq_cross_;
+  // Resume connections accepted by a cascade that does not own the named
+  // channel (another driver's channel, or a cascade not yet entered):
+  // parked here for the owner, which ACKs from its own cursor.  Keyed
+  // (ring_id, channel); newest wins.
+  std::mutex heal_mu_;
+  std::map<std::pair<int32_t, int32_t>, std::pair<LinkResume, Socket>>
+      heal_inbox_;
+  std::atomic<int> heal_inbox_size_{0};
+  std::atomic<int64_t> link_reconnects_{0};
+  std::atomic<int64_t> link_heal_failures_{0};
+  mutable std::mutex heal_ns_mu_;
+  std::vector<int64_t> heal_ns_samples_;
+  size_t heal_ns_next_ = 0;
+  void RecordLinkHealNs(int64_t ns);
+  int64_t LinkHealNsPercentile(double p) const;
+  // Deposit an accepted RESUME conn for the owning cascade (newest wins).
+  void HealInboxPut(int32_t ring, int32_t channel, const LinkResume& lr,
+                    Socket conn);
+  // Claim a parked RESUME conn for (ring, channel); invalid Socket when
+  // none is parked.
+  bool HealInboxTake(int32_t ring, int32_t channel, LinkResume* lr,
+                     Socket* conn);
+  void HealInboxClear();
 
   // -- fusion scratch (one slot per channel: a concurrent wave gives each
   //    response its own buffer; slot 0 serves the serial path).  Capped

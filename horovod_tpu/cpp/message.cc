@@ -309,4 +309,18 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
   return r->ok();
 }
 
+// -- link self-healing handshake validation --
+// The frames travel raw (fixed-width int64s, same build both ends); the
+// magic check is what distinguishes a genuine RESUME/ACK from a stray
+// connect's garbage or a truncated read filled with zeros.
+bool ValidLinkResume(const LinkResume& r) {
+  return r.magic == kLinkResumeMagic && r.origin >= 0 && r.ring >= 0 &&
+         r.channel >= 0 && r.seq >= 0;
+}
+
+bool ValidLinkResumeAck(const LinkResumeAck& a) {
+  return a.magic == kLinkAckMagic && (a.ok == 0 || a.ok == 1) &&
+         a.step >= 0 && a.offset >= 0;
+}
+
 }  // namespace hvd

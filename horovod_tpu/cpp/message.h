@@ -362,6 +362,44 @@ class Reader {
   bool ok_ = true;
 };
 
+// -- link self-healing handshake (data-plane reconnect) --
+//
+// When a data-channel socket fails mid-collective and HOROVOD_LINK_RETRIES
+// allows healing, the edge's ORIGIN (the ring sender, who opened the
+// original wiring connect) re-dials the receiver's data listener and sends
+// a RESUME hello instead of the 4-int wiring handshake; the receiver
+// answers with an ACK carrying its authoritative chunk-cascade cursor
+// (stream seq, step, byte offset within the step) so the sender rewinds
+// and the collective completes bit-identically.  Fixed-width frames on a
+// raw socket (both ends are the same build on the same arch): 6 and 5
+// int64s, distinguished from wiring hellos by the magic in word 0 —
+// wiring hellos start with a rank in [0, 2^31), these start with a magic
+// far outside any epoch-stamped rank/field value.
+constexpr int64_t kLinkResumeMagic = 0x4c52534d31ll;  // "LRSM1"
+constexpr int64_t kLinkAckMagic = 0x4c52414b31ll;     // "LRAK1"
+
+struct LinkResume {
+  int64_t magic = kLinkResumeMagic;
+  int64_t origin = -1;   // reconnecting rank (the edge's ring sender)
+  int64_t ring = -1;     // RingId (engine.h): GLOBAL or CROSS
+  int64_t channel = -1;  // global channel id of the failed edge
+  int64_t epoch = -1;    // stale-incarnation connects are dropped, as ever
+  int64_t seq = -1;      // sender's per-(ring,channel) cascade stream seq
+};
+
+struct LinkResumeAck {
+  int64_t magic = kLinkAckMagic;
+  int64_t ok = 0;      // 1 = cursor follows; 0 = declined (stream moved on)
+  int64_t seq = -1;    // receiver's current stream seq for the channel
+  int64_t step = 0;    // receiver's authoritative cascade step cursor
+  int64_t offset = 0;  // bytes of `step` already received
+};
+
+// Validation-only decode helpers (the structs are sent raw): false when
+// the magic does not match — the caller treats the frame as garbage.
+bool ValidLinkResume(const LinkResume& r);
+bool ValidLinkResumeAck(const LinkResumeAck& a);
+
 void SerializeRequestList(const RequestList& list, Writer* w);
 bool ParseRequestList(Reader* r, RequestList* out);
 // Exposed for the engine's telem_bytes_tx accounting (the per-entry wire

@@ -10,6 +10,7 @@
 #include <sys/time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cerrno>
 #include <chrono>
 #include <cstring>
@@ -47,18 +48,6 @@ void Socket::SetBufSizes(int bytes) {
   if (fd_ < 0 || bytes <= 0) return;
   ::setsockopt(fd_, SOL_SOCKET, SO_SNDBUF, &bytes, sizeof(bytes));
   ::setsockopt(fd_, SOL_SOCKET, SO_RCVBUF, &bytes, sizeof(bytes));
-}
-
-void Socket::EnableKeepalive() {
-  if (fd_ < 0) return;
-  int one = 1;
-  ::setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
-  // Aggressive probing: detect a dead-but-ESTABLISHED peer in ~30 s
-  // instead of the kernel's multi-hour default.
-  int idle = 10, intvl = 5, cnt = 4;
-  ::setsockopt(fd_, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
-  ::setsockopt(fd_, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
-  ::setsockopt(fd_, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
 }
 
 bool Socket::SendAll(const void* data, size_t n) {
@@ -144,6 +133,22 @@ static void SetNoDelay(int fd) {
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
+// Shared IPv4 resolve (literal first, gethostbyname fallback) for the
+// connect paths.  NOTE: gethostbyname is not thread-safe; in this stack
+// hosts are near-always IP literals (the peer table carries what workers
+// reported), so the fallback only runs on cold non-literal paths.
+static bool ResolveIPv4(const std::string& host, in_addr* out,
+                        std::string* err) {
+  if (::inet_pton(AF_INET, host.c_str(), out) == 1) return true;
+  hostent* he = ::gethostbyname(host.c_str());
+  if (he == nullptr || he->h_addr_list[0] == nullptr) {
+    *err = "cannot resolve host " + host;
+    return false;
+  }
+  memcpy(out, he->h_addr_list[0], sizeof(*out));
+  return true;
+}
+
 NonblockGuard::NonblockGuard(int fd)
     : fd_(fd), flags_(::fcntl(fd, F_GETFL, 0)) {
   if (flags_ >= 0) ::fcntl(fd_, F_SETFL, flags_ | O_NONBLOCK);
@@ -167,14 +172,9 @@ Socket Listen(const std::string& host, int port, int backlog,
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (host.empty() || host == "0.0.0.0") {
     addr.sin_addr.s_addr = INADDR_ANY;
-  } else if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-    hostent* he = ::gethostbyname(host.c_str());
-    if (he == nullptr || he->h_addr_list[0] == nullptr) {
-      *error = "cannot resolve host " + host;
-      ::close(fd);
-      return Socket();
-    }
-    memcpy(&addr.sin_addr, he->h_addr_list[0], sizeof(addr.sin_addr));
+  } else if (!ResolveIPv4(host, &addr.sin_addr, error)) {
+    ::close(fd);
+    return Socket();
   }
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
     *error = std::string("bind: ") + strerror(errno);
@@ -254,6 +254,103 @@ bool HasPendingConnection(Socket& listener) {
   return WaitReadable(listener, 0);
 }
 
+Socket TryAcceptNow(Socket& listener) {
+  if (!listener.valid() || !HasPendingConnection(listener)) return Socket();
+  // The listener goes PERMANENTLY nonblocking on first use: several
+  // channel drivers call this concurrently on ONE shared listener, and a
+  // save/set/restore guard would race — one driver restoring blocking
+  // mode while another sits inside accept(2) on a queue a third just
+  // drained re-creates exactly the block-on-empty-queue hazard this
+  // function exists to avoid.  The only other accept path (hvd::Accept)
+  // already runs its accept nonblocking under poll, so the sticky flag
+  // is harmless to it.
+  int fl = ::fcntl(listener.fd(), F_GETFL, 0);
+  if (fl >= 0 && (fl & O_NONBLOCK) == 0) {
+    ::fcntl(listener.fd(), F_SETFL, fl | O_NONBLOCK);
+  }
+  int fd = ::accept(listener.fd(), nullptr, nullptr);
+  if (fd < 0) return Socket();
+  SetNoDelay(fd);
+  return Socket(fd);
+}
+
+Socket ConnectStart(const std::string& host, int port, bool* in_progress,
+                    std::string* err) {
+  *in_progress = false;
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    *err = std::string("socket: ") + strerror(errno);
+    return Socket();
+  }
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (!ResolveIPv4(host, &addr.sin_addr, err)) {
+    ::close(fd);
+    return Socket();
+  }
+  int fl = ::fcntl(fd, F_GETFL, 0);
+  if (fl >= 0) ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    // Completed immediately (the loopback common case): hand back a
+    // blocking socket like ConnectRetry would.
+    SetNoDelay(fd);
+    if (fl >= 0) ::fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+    return Socket(fd);
+  }
+  if (errno == EINPROGRESS) {
+    *in_progress = true;
+    return Socket(fd);  // caller polls POLLOUT, then ConnectFinish
+  }
+  *err = std::string("connect: ") + strerror(errno);
+  ::close(fd);
+  return Socket();
+}
+
+bool ConnectFinish(Socket& s, std::string* err) {
+  int soerr = 0;
+  socklen_t len = sizeof(soerr);
+  if (::getsockopt(s.fd(), SOL_SOCKET, SO_ERROR, &soerr, &len) != 0) {
+    soerr = errno;
+  }
+  if (soerr != 0) {
+    *err = std::string("connect: ") + strerror(soerr);
+    return false;
+  }
+  SetNoDelay(s.fd());
+  int fl = ::fcntl(s.fd(), F_GETFL, 0);
+  if (fl >= 0) ::fcntl(s.fd(), F_SETFL, fl & ~O_NONBLOCK);
+  return true;
+}
+
+void ArmSocketDeadlines(Socket& s, int deadline_sec) {
+  if (!s.valid()) return;
+  int one = 1;
+  ::setsockopt(s.fd(), SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  // Probe timing: never SLOWER than the legacy ~30 s detection
+  // (idle 10 + 4 x intvl 5), and tightened toward deadline_sec when a
+  // smaller bound is in force (fault-capped socket timeouts).
+  int idle = 10, intvl = 5, cnt = 4;
+  if (deadline_sec > 0) {
+    idle = std::max(1, std::min(10, deadline_sec / 3));
+    intvl = std::max(1, std::min(5, deadline_sec / 6));
+  }
+  ::setsockopt(s.fd(), IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+  ::setsockopt(s.fd(), IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+  ::setsockopt(s.fd(), IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
+#ifdef TCP_USER_TIMEOUT
+  if (deadline_sec > 0) {
+    // Unacked transmit data older than this errors the socket (ETIMEDOUT)
+    // — converting a "my sends vanish into retransmission limbo" stall
+    // into a classifiable error the link-heal layer can act on.  Ignored
+    // gracefully by kernels that lack the option (e.g. some sandboxes).
+    unsigned to_ms = static_cast<unsigned>(deadline_sec) * 1000u;
+    ::setsockopt(s.fd(), IPPROTO_TCP, TCP_USER_TIMEOUT, &to_ms,
+                 sizeof(to_ms));
+  }
+#endif
+}
+
 Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
                     std::string* error) {
   auto deadline = std::chrono::steady_clock::now() +
@@ -268,14 +365,9 @@ Socket ConnectRetry(const std::string& host, int port, int deadline_ms,
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_port = htons(static_cast<uint16_t>(port));
-    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
-      hostent* he = ::gethostbyname(host.c_str());
-      if (he == nullptr || he->h_addr_list[0] == nullptr) {
-        *error = "cannot resolve host " + host;
-        ::close(fd);
-        return Socket();
-      }
-      memcpy(&addr.sin_addr, he->h_addr_list[0], sizeof(addr.sin_addr));
+    if (!ResolveIPv4(host, &addr.sin_addr, error)) {
+      ::close(fd);
+      return Socket();
     }
     if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
       SetNoDelay(fd);

@@ -31,9 +31,9 @@ class Socket {
 
   // Robustness knobs (a hung-but-connected peer must not block forever —
   // the reference's stall story covers negotiation only; transport hangs
-  // were invisible).  Timeout 0 = never time out.
+  // were invisible).  Timeout 0 = never time out.  Dead-peer detection
+  // (keepalive + TCP_USER_TIMEOUT) is armed via ArmSocketDeadlines below.
   void SetTimeouts(int timeout_sec);
-  void EnableKeepalive();
   // SO_SNDBUF/SO_RCVBUF for data-plane sockets (HOROVOD_SOCKET_BUF_BYTES).
   // Bigger buffers let the kernel keep the wire busy while userland is in
   // a reduction kernel — the cheap half of wire/compute overlap.  0 = keep
@@ -129,6 +129,40 @@ extern const char* const kAcceptTimedOut;
 // NOW (poll with zero timeout) — the coordinator's per-cycle probe for
 // elastic mid-run join candidates; never blocks.
 bool HasPendingConnection(Socket& listener);
+
+// Accept a connection ONLY if one is ready right now (zero-timeout poll +
+// nonblocking accept); invalid Socket otherwise.  The link-heal path's
+// accept primitive: several channel drivers poll one shared data listener
+// for RESUME re-handshakes, so a driver whose POLLIN lost the accept race
+// must get "nothing" immediately, never block on the NEXT connection.
+// Side effect: the listener is left PERMANENTLY nonblocking (per-call flag
+// save/restore would race between concurrent drivers; hvd::Accept already
+// tolerates a nonblocking listener).
+Socket TryAcceptNow(Socket& listener);
+
+// Nonblocking connect pair for poll-multiplexed loops (the link-heal
+// re-dial must not park a channel driver for a connect timeout).
+// ConnectStart resolves + starts the connect: on immediate completion
+// returns a ready BLOCKING socket (*in_progress false); on EINPROGRESS
+// returns the in-flight nonblocking socket (*in_progress true) — poll it
+// for POLLOUT, then call ConnectFinish, which checks SO_ERROR and
+// restores blocking mode on success.
+Socket ConnectStart(const std::string& host, int port, bool* in_progress,
+                    std::string* err);
+bool ConnectFinish(Socket& s, std::string* err);
+
+// Kernel-side dead-peer detection bound for a long-lived connection:
+// SO_KEEPALIVE with probe timing that detects a dead-but-ESTABLISHED peer
+// within ~min(30s, deadline_sec), plus TCP_USER_TIMEOUT = deadline_sec so
+// unacknowledged SENT data errors the socket within the same bound (the
+// half a silent keepalive cannot cover: keepalive probes only run on an
+// idle connection).  deadline_sec <= 0 keeps the legacy ~30 s keepalive
+// probing and sets no user timeout.  Shared by data sockets (aligned with
+// HOROVOD_SOCKET_TIMEOUT_SEC, itself capped by the fault timeout) and
+// control sockets (rendezvous/CTRL conns), so a dead peer surfaces as a
+// socket ERROR inside the fault bound instead of only via the
+// coordinator's patience.
+void ArmSocketDeadlines(Socket& s, int deadline_sec);
 
 // True when `s` becomes readable within timeout_ms (0 = only if readable
 // right now).  Bounds a speculative read on a connection that may never

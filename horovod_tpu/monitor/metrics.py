@@ -86,6 +86,13 @@ STATS_METRICS: List[Metric] = [
            "buffer-level bytes saved by compressed wire formats"),
     Metric("backup_skips", "horovod_backup_skips_total", "counter",
            "backup-worker partial commits that left this rank out"),
+    Metric("link_reconnects", "horovod_link_reconnects_total", "counter",
+           "data-channel edges transparently re-established mid-collective "
+           "(link self-healing, HOROVOD_LINK_RETRIES)"),
+    Metric("link_heal_failures", "horovod_link_heal_failures_total",
+           "counter",
+           "link-heal suspects that exhausted the retry/deadline budget "
+           "and escalated to the abort path"),
     Metric("local_sgd_syncs", "horovod_local_sgd_syncs_total", "counter",
            "outer local-SGD delta syncs completed"),
     Metric("sharded_steps", "horovod_sharded_steps_total", "counter",
@@ -113,6 +120,10 @@ STATS_METRICS: List[Metric] = [
            "per-entry quorum lag p50 (last voter vs second-to-last)"),
     Metric("quorum_lag_ns_p99", "horovod_quorum_lag_ns_p99", "gauge",
            "per-entry quorum lag p99 — backup=auto's default instrument"),
+    Metric("link_heal_ns_p50", "horovod_link_heal_ns_p50", "gauge",
+           "link-heal suspect-to-healed duration p50 (sliding window)"),
+    Metric("link_heal_ns_p99", "horovod_link_heal_ns_p99", "gauge",
+           "link-heal suspect-to-healed duration p99"),
     Metric("clock_offset_ns", "horovod_clock_offset_ns", "gauge",
            "rendezvous-estimated monotonic clock offset to rank 0"),
 ]

@@ -55,6 +55,11 @@ def analyze(dumps: Dict[int, dict], world_size: Optional[int] = None) -> dict:
     votes: Dict[int, int] = {}
     verdicts: List[str] = []
     last_cycle: Dict[int, int] = {}
+    # Link-heal history per rank: (suspects, healed, escalated).  A world
+    # that "flapped then died" reads differently from one that just died —
+    # suspect/healed events before the abort say the link was unstable
+    # long before the fatal failure.
+    link_events: Dict[int, Dict[str, int]] = {}
     merged: List[Tuple[int, int, dict]] = []  # (aligned_ns, rank, event)
     for rank, d in sorted(dumps.items()):
         offset = int(d.get("clock_offset_ns", 0))
@@ -63,6 +68,13 @@ def analyze(dumps: Dict[int, dict], world_size: Optional[int] = None) -> dict:
             if e.get("kind") == "cycle":
                 last_cycle[rank] = max(last_cycle.get(rank, 0),
                                        int(e.get("cycle", 0)))
+            if e.get("kind") == "link":
+                text = e.get("text", "")
+                lk = link_events.setdefault(
+                    rank, {"suspect": 0, "healed": 0, "escalate": 0})
+                for key in lk:
+                    if text.startswith(key):
+                        lk[key] += 1
             if e.get("kind") == "abort":
                 text = e.get("text", "")
                 verdicts.append(f"rank {rank}: {text}")
@@ -97,6 +109,7 @@ def analyze(dumps: Dict[int, dict], world_size: Optional[int] = None) -> dict:
         # right after it.
         "last_committed_cycle": min(last_cycle.values()) if last_cycle
         else 0,
+        "link_events": link_events,
         "merged": merged,
     }
 
@@ -119,6 +132,19 @@ def format_report(result: dict, tail: int = 60) -> str:
                      "the dumps — stall escalation or manual dump?)")
     for v in result["verdicts"][:8]:
         lines.append(f"  verdict · {v}")
+    link = result.get("link_events") or {}
+    if link:
+        healed = sum(v["healed"] for v in link.values())
+        escal = sum(v["escalate"] for v in link.values())
+        per_link = ", ".join(
+            f"rank {r}: {v['suspect']} suspect / {v['healed']} healed / "
+            f"{v['escalate']} escalated" for r, v in sorted(link.items()))
+        lines.append(
+            ("link health: the world FLAPPED before it died — " if healed
+             else "link health: ") + per_link +
+            ("; the fatal failure followed earlier healed blips"
+             if healed and (escal or result["culprit"] is not None)
+             else ""))
     per = ", ".join(f"rank {r}={c}" for r, c in
                     sorted(result["last_cycle"].items()))
     lines.append(

@@ -260,6 +260,12 @@ class NativeEngine:
                         "horovod_fleet_rows",
                         "horovod_flight_events",
                         "horovod_flight_dumps",
+                        "horovod_link_reconnects",
+                        "horovod_link_heal_failures",
+                        "horovod_link_heal_ns_p50",
+                        "horovod_link_heal_ns_p99",
+                        "horovod_link_retries",
+                        "horovod_link_heal_timeout_ms",
                         "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
@@ -511,11 +517,11 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_fleet_rows",
+        if getattr(getattr(self._lib, "horovod_link_reconnects",
                            None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the fleet-observability "
+                "libhorovod_core.so predates the link self-healing "
                 "counters (and possibly earlier counter families) — "
                 "rebuild it with `make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
@@ -578,6 +584,17 @@ class NativeEngine:
             "flight_events": self._lib.horovod_flight_events(),
             "flight_dumps": self._lib.horovod_flight_dumps(),
             "backup_skips": self._lib.horovod_backup_skips(),
+            # Link self-healing (HOROVOD_LINK_RETRIES): data-channel
+            # edges transparently re-established mid-collective, suspects
+            # that exhausted the retry/deadline budget and escalated to
+            # the unchanged abort path, and sliding-window percentiles of
+            # suspect -> healed durations.  All provably zero under
+            # HOROVOD_LINK_RETRIES=0.
+            "link_reconnects": self._lib.horovod_link_reconnects(),
+            "link_heal_failures":
+                self._lib.horovod_link_heal_failures(),
+            "link_heal_ns_p50": self._lib.horovod_link_heal_ns_p50(),
+            "link_heal_ns_p99": self._lib.horovod_link_heal_ns_p99(),
             "local_sgd_syncs": self._lib.horovod_local_sgd_syncs(),
             "data_bytes_tx": self._lib.horovod_data_bytes_tx(),
             "data_bytes_rx": self._lib.horovod_data_bytes_rx(),
@@ -657,6 +674,13 @@ class NativeEngine:
                 "backup_auto_rule":
                     "steptime" if self._lib.horovod_backup_auto_rule()
                     else "quorum",
+                # Link self-healing knobs (committed at rendezvous):
+                # reconnect attempts per suspect edge (0 = healing off,
+                # bit-for-bit the fail-fast engine) and the per-suspect
+                # heal deadline.
+                "link_retries": self._lib.horovod_link_retries(),
+                "link_heal_timeout_ms":
+                    self._lib.horovod_link_heal_timeout_ms(),
                 # Fleet telemetry cadence (0 = off: control frames are
                 # byte-identical to the pre-telemetry wire).
                 "telemetry_cycles": self._lib.horovod_telemetry_cycles(),

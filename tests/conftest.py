@@ -57,6 +57,11 @@ def pytest_configure(config):
         "recorder tests; ci.sh runs them in the observability gate "
         "under a hard timeout (main sweep excludes the marker, tier-1 "
         "still runs them)")
+    config.addinivalue_line(
+        "markers",
+        "linkheal: link self-healing tests (transparent data-channel "
+        "reconnect under injected conn-reset/recv-stall faults); ci.sh "
+        "runs them in the link-heal gate under a hard timeout")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,206 @@
+"""Worker body for the link self-healing multiproc tests.
+
+Run as ``python link_heal_worker.py <scenario>`` with identity in
+HOROVOD_RANK/HOROVOD_SIZE/HOROVOD_COORDINATOR (the native_worker launch
+convention via tests.test_native_engine.run_workers).  The tests set
+HOROVOD_FAULT_INJECT conn-reset / recv-stall schedules and the
+HOROVOD_LINK_* knobs; this worker runs fixed allreduce loops and asserts
+the healing contract:
+
+* a healed run completes every step with ZERO aborts and the results are
+  BIT-IDENTICAL to an undisturbed re-run of the same world (fp32 steps are
+  additionally checked against the exact analytic sum — integer-valued
+  floats, no rounding);
+* a transient recv stall heals with ZERO reconnects;
+* an exhausted heal budget escalates to today's clean attributed abort.
+
+Deliberately jax-free (native engine + numpy only), like native_worker.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import (  # noqa: E402
+    HorovodInternalError,
+    StepSkipped,
+    get_engine,
+)
+
+STEPS = int(os.environ.get("HOROVOD_TEST_STEPS", "12"))
+COUNT = int(os.environ.get("HOROVOD_TEST_COUNT", "262144"))
+WIRE = os.environ.get("HOROVOD_TEST_WIRE") or None
+
+
+def run_loop(eng, rank, size, tag, steps=STEPS, count=COUNT):
+    """The fixed collective sequence both runs execute; returns raw result
+    bytes per step.  Integer-valued fp32 inputs keep analytic sums exact."""
+    results = []
+    for step in range(steps):
+        x = (np.arange(count, dtype=np.float32) % 1000.0) + rank * 7 + step
+        out = eng.allreduce(x, name=f"{tag}.{step}", wire_dtype=WIRE)
+        results.append(np.ascontiguousarray(out).tobytes())
+    return results
+
+
+def analytic(size, step, count=COUNT):
+    acc = np.zeros(count, dtype=np.float32)
+    for r in range(size):
+        acc += (np.arange(count, dtype=np.float32) % 1000.0) + r * 7 + step
+    return acc.tobytes()
+
+
+def scenario_heal_parity(rank, size, eng):
+    # Disturbed run: the test's HOROVOD_FAULT_INJECT schedule shoots one
+    # data socket per injected rank mid-cascade.  Healing must keep every
+    # step alive, bit-exact, with zero aborts — then an in-process re-init
+    # (the injected faults are one-shot per process) replays the identical
+    # sequence undisturbed and the bytes must match exactly.
+    disturbed = run_loop(eng, rank, size, "heal")
+    st = eng.stats()
+    assert eng.abort_reason() == "", eng.abort_reason()
+    assert st["link_heal_failures"] == 0, st["link_heal_failures"]
+    # Every rank of the schedule below touches at least one broken edge
+    # (it shot its own socket, or a neighbor shot the shared edge).
+    expect_heal = os.environ.get("HOROVOD_TEST_EXPECT_RECONNECT", "1") == "1"
+    if expect_heal:
+        assert st["link_reconnects"] >= 1, st["link_reconnects"]
+        assert st["link_heal_ns_p50"] > 0, st["link_heal_ns_p50"]
+    if WIRE in (None, "fp32"):
+        for step in range(STEPS):
+            assert disturbed[step] == analytic(size, step), (
+                f"step {step} diverged from the analytic sum")
+    # Undisturbed re-run of the same world (fault_fired_ survives re-init,
+    # so nothing re-fires): compressed wires are deterministic per world,
+    # fp32 is exact — either way the healed run must match bitwise.
+    basics.shutdown()
+    basics.init()
+    eng2 = get_engine()
+    clean = run_loop(eng2, basics.rank(), basics.size(), "heal")
+    assert basics.rank() == rank and basics.size() == size
+    for step in range(STEPS):
+        assert disturbed[step] == clean[step], (
+            f"step {step}: healed run is not bit-identical to the "
+            f"undisturbed run")
+
+
+def scenario_recv_stall(rank, size, eng):
+    # A transient stall (one rank stops draining a channel for a few
+    # hundred ms) must ride out inside the no-progress budget: every step
+    # completes, zero aborts, and — the point — ZERO reconnects: healing
+    # classifies, waits, and stands down.
+    results = run_loop(eng, rank, size, "stall")
+    st = eng.stats()
+    assert eng.abort_reason() == "", eng.abort_reason()
+    assert st["link_reconnects"] == 0, st["link_reconnects"]
+    assert st["link_heal_failures"] == 0
+    for step in range(STEPS):
+        assert results[step] == analytic(size, step), step
+
+
+def scenario_heal_exhaust(rank, size, eng):
+    # HOROVOD_LINK_HEAL_TIMEOUT_MS=1 strangles healing: the injected
+    # conn-reset must escalate to today's clean attributed abort — the
+    # receiver side names the TRUE culprit (its ring-prev neighbor, who
+    # shot the edge), nobody hangs, and link_heal_failures counts the
+    # escalation on the suspect ranks.
+    frank = int(os.environ["HOROVOD_FAULT_INJECT"].split(":")[0])
+    expect_fail_count = os.environ.get("HOROVOD_TEST_EXPECT_FAILURES", "1")
+    try:
+        run_loop(eng, rank, size, "exhaust", steps=STEPS)
+    except (HorovodInternalError, StepSkipped) as e:
+        msg = str(e)
+        if rank == (frank + 1) % size:
+            # The receiver of the shot edge: its recv error names its
+            # ring-prev neighbor — exactly the rank that killed the link.
+            assert f"rank {frank}" in msg, msg
+        if rank in (frank, (frank + 1) % size) and expect_fail_count == "1":
+            st = eng.stats()
+            assert st["link_heal_failures"] >= 1, st
+        print(f"worker rank={rank} got expected abort: {msg}", flush=True)
+        return
+    raise AssertionError(
+        f"rank {rank}: expected an abort after heal exhaustion")
+
+
+def scenario_partial_commit_heal(rank, size, eng):
+    # Healing composes with backup-worker partial commits: rank `size-1`
+    # is permanently slow (ghost-ridden by partial commits), rank 0 shoots
+    # a data socket mid-run, and the SUM results still identify a valid
+    # participant set.  Inputs are 2^rank, so each result IS the
+    # participant bitmask — self must be in it and at least nvoters-k
+    # ranks must have committed.
+    k = int(os.environ.get("HOROVOD_BACKUP_WORKERS", "0"))
+    skipped = 0
+    for step in range(STEPS):
+        x = np.full((1024,), float(1 << rank), dtype=np.float32)
+        try:
+            out = eng.allreduce(x, name=f"pc.{step}")
+        except StepSkipped:
+            skipped += 1
+            continue
+        mask = int(out[0])
+        assert out.min() == out.max(), (step, out)
+        assert mask & (1 << rank), (step, mask)
+        assert bin(mask).count("1") >= size - k, (step, mask)
+    # Epilogue barrier: MAX allreduces always wait for the FULL world
+    # (never partially committed), so the fast ranks cannot shut the
+    # engine down while the ghost-ridden slow rank still has steps queued.
+    np.testing.assert_allclose(
+        eng.allreduce(np.full((4,), float(rank), np.float32),
+                      name="pc.done", red_op="max"),
+        float(size - 1))
+    st = eng.stats()
+    assert eng.abort_reason() == "", eng.abort_reason()
+    assert st["link_heal_failures"] == 0, st
+    if rank == 0:
+        assert st["link_reconnects"] >= 1, st
+    print(f"worker rank={rank} skipped={skipped}", flush=True)
+
+
+def scenario_flap_soak(rank, size, eng):
+    # Seeded flap schedule: several ranks shoot their own data sockets
+    # every K-th step for the whole run.  Zero aborts, every step exact.
+    steps = int(os.environ.get("HOROVOD_TEST_STEPS", "60"))
+    for step in range(steps):
+        x = (np.arange(8192, dtype=np.float32) % 257.0) + rank + step
+        out = eng.allreduce(x, name=f"flap.{step}")
+        exp = np.zeros(8192, dtype=np.float32)
+        for r in range(size):
+            exp += (np.arange(8192, dtype=np.float32) % 257.0) + r + step
+        assert np.ascontiguousarray(out).tobytes() == exp.tobytes(), step
+    st = eng.stats()
+    assert eng.abort_reason() == "", eng.abort_reason()
+    assert st["link_heal_failures"] == 0, st
+    if rank == 0:
+        # The schedule makes rank 0 flap: it must have healed repeatedly.
+        assert st["link_reconnects"] >= 3, st["link_reconnects"]
+    print(f"worker rank={rank} reconnects={st['link_reconnects']}",
+          flush=True)
+
+
+SCENARIOS = {
+    "heal_parity": scenario_heal_parity,
+    "recv_stall": scenario_recv_stall,
+    "heal_exhaust": scenario_heal_exhaust,
+    "partial_commit_heal": scenario_partial_commit_heal,
+    "flap_soak": scenario_flap_soak,
+}
+
+
+def main():
+    scenario = sys.argv[1]
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    SCENARIOS[scenario](rank, size, eng)
+    basics.shutdown()
+    print(f"worker rank={rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

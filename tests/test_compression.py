@@ -307,4 +307,7 @@ def test_worker_death_mid_compressed_allreduce_aborts_cleanly():
     run_workers(3, "wire_death", timeout=90, expected_rc={2: 31},
                 extra_env={"HOROVOD_WIRE_DTYPE": "int8",
                            "HOROVOD_FAULT_TIMEOUT_SEC": "5",
-                           "HOROVOD_SOCKET_TIMEOUT_SEC": "2"})
+                           "HOROVOD_SOCKET_TIMEOUT_SEC": "2",
+                           # Abort-path coverage: healing stays off here
+                           # (its own suite: tests/test_link_heal.py).
+                           "HOROVOD_LINK_RETRIES": "0"})

@@ -40,10 +40,14 @@ ELASTIC_WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
 SHRINK_WORKER = os.path.join(REPO, "tests", "elastic_shrink_worker.py")
 
 # Tight failure-detection bound so every abort lands in seconds; the
-# subprocess timeout is the hang detector.
+# subprocess timeout is the hang detector.  Link self-healing is pinned
+# OFF: these tests are the abort machinery's dedicated coverage, and
+# HOROVOD_LINK_RETRIES=0 restores the fail-fast data plane bit-for-bit
+# (the healing path has its own suite, tests/test_link_heal.py).
 FAULT_ENV = {
     "HOROVOD_FAULT_TIMEOUT_SEC": "5",
     "HOROVOD_SOCKET_TIMEOUT_SEC": "2",
+    "HOROVOD_LINK_RETRIES": "0",
 }
 
 

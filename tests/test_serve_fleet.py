@@ -215,7 +215,11 @@ def test_replica_death_requeues_all_requests(offline):
     EVERY request completes with the exact offline tokens — zero
     dropped; the supervisor relaunches the dead replica (rejoin)."""
     fleet = _Fleet(replicas=2, restart=2,
-                   extra_env={"HOROVOD_FAULT_INJECT": "1:4:exit"})
+                   extra_env={"HOROVOD_FAULT_INJECT": "1:4:exit",
+                              # Abort/requeue-path coverage: link healing
+                              # stays off (tests/test_link_heal.py owns
+                              # the healing suite).
+                              "HOROVOD_LINK_RETRIES": "0"})
     try:
         cli = ServeClient("127.0.0.1", fleet.port, timeout=240)
         rng = np.random.default_rng(13)
