@@ -27,7 +27,7 @@ echo "== test suite (8-device virtual CPU mesh) =="
 # Caller args go BEFORE the marker filter so a user-passed -m cannot
 # override it — the fault tests must only ever run under the hard
 # timeout below (a reintroduced hang would otherwise eat the CI budget).
-PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability and not linkheal"
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability and not linkheal and not priority"
 
 echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # These tests previously WOULD HANG when a rank died mid-collective; the
@@ -171,6 +171,25 @@ echo "== compression gate (wire dtypes + sparse error feedback, hard timeout) ==
 # wedge detector for the quantized ring.
 PALLAS_AXON_POOL_IPS= timeout -k 15 700 \
     python bench_engine.py --compression-gate
+
+echo "== overlap gate (priority-scheduled communication, hard timeout) =="
+# Backprop-overlapped priority scheduling (HOROVOD_PRIORITY_BANDS): the
+# marker suite proves bands=0 stays bit-identical (stamping is gated on
+# bands, so the default wire never grows a priority section), banded
+# runs dispatch reverse-priority bursts with priority_inversions == 0 at
+# 2 AND 4 ranks over shm and TCP, the cached path preserves the order,
+# fusion respects band boundaries, and a cross-rank priority mismatch is
+# a clean negotiated error.  bench --overlap-gate then re-checks the
+# REAL-MODEL loop: inversions == 0 with bands on over HOROVOD_SMOKE_STEPS
+# tf steps, best-of-interleaved engine_tf_step_ms on the 0.85 regression
+# floor (the loopback-ceiling lesson: floor, not speedup), and the
+# wire-policy worker's deterministic data_bytes_tx cut at fp32-parity
+# convergence.  Hard timeouts are the wedge detectors for the banded
+# wave scheduler.
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
+    python -m pytest tests/test_priority.py -q -m "priority"
+PALLAS_AXON_POOL_IPS= HOROVOD_SMOKE_STEPS=50 timeout -k 15 900 \
+    python bench_engine.py --overlap-gate
 
 echo "== autotune gate (online knob search vs static grid, hard timeout) =="
 # Online autotuner (HOROVOD_AUTOTUNE=1): the search must converge within

@@ -134,6 +134,37 @@ KNOBS: List[Knob] = [
          "fp16/bf16 halve wire bytes (RNE), int8/fp8 quarter them with "
          "per-chunk scales (live-tunable; per-tensor override via "
          "wire_dtype=; see docs/performance.md 'Wire compression')"),
+    Knob("HOROVOD_PRIORITY_BANDS", "0 (off)",
+         lambda raw: str(_clamp(max(0, _int_env(raw, 0)), 0, 1 << 20)),
+         "priority band WIDTH (band = priority / width): the coordinator "
+         "orders each cycle's responses by (priority, name), fusion only "
+         "merges within a band, and waves dispatch in band order — so "
+         "front-layer gradients fly first (0 = off: legacy arrival "
+         "ordering bit-for-bit; committed at rendezvous, live-tunable; "
+         "docs/performance.md 'Priority scheduling & overlap')"),
+    Knob("HOROVOD_FUSION_LADDER", "(unset: global threshold)",
+         lambda raw: raw or "(unset: global threshold)",
+         "per-band fusion thresholds 't0,t1,...' (band b fuses up to "
+         "ladder[b] bytes; missing/zero entries fall back to "
+         "HOROVOD_FUSION_THRESHOLD; autotuner-learnable via the "
+         "fusion_ladder_<b> dims)"),
+    Knob("HOROVOD_WIRE_POLICY", "0",
+         lambda raw: str(1 if (raw or "") not in ("", "0") else 0),
+         "statistics-driven per-tensor wire dtypes on the gradient "
+         "paths: int8 for large embedding-shaped grads, fp32 for "
+         "norm/bias leaves, stamped as ADVISORY overrides so per-rank "
+         "stats can never split negotiation (runtime/wire_policy.py)"),
+    Knob("HOROVOD_WIRE_POLICY_MIN_ELEMS", "65536",
+         lambda raw: str(max(1, _int_env(raw, 65536))),
+         "wire policy: leaves below this many elements (or 0/1-D) stay "
+         "fp32"),
+    Knob("HOROVOD_WIRE_POLICY_RATIO", "64.0",
+         lambda raw: raw or "64.0",
+         "wire policy: max rolling abs-max/rms dynamic range for the "
+         "int8 wire (spiky leaves stay fp32)"),
+    Knob("HOROVOD_WIRE_POLICY_WARMUP", "3",
+         lambda raw: str(max(0, _int_env(raw, 3))),
+         "wire policy: observed steps per leaf before compressing"),
     Knob("HOROVOD_SPARSE_TOPK", "0.01", _sparse_topk,
          "default top-k ratio for Compression.topk sparse allreduce "
          "(indices+values ride the allgather path; error-feedback "

@@ -19,12 +19,25 @@ __all__ = ["load_state", "save_state", "apply_wiring_warm_start"]
 _VERSION = 1
 
 #: Live-tunable knob names a committed config may carry.  For
-#: ``algo_threshold`` 0 is a REAL value (small-tensor star path off) and
-#: for ``wire_dtype`` 0 is fp32 (the uncompressed default), so the
-#: sanitizer below accepts >= 0 for them while the others need > 0.
+#: ``algo_threshold`` 0 is a REAL value (small-tensor star path off),
+#: for ``wire_dtype`` 0 is fp32 (the uncompressed default), and for
+#: ``priority_bands`` 0 is bands-off — so the sanitizer below accepts
+#: >= 0 for them while the others need > 0.  ``fusion_ladder_<b>``
+#: (the per-band bucket sizes) round-trip by prefix.
 LIVE_KNOBS = ("chunk_bytes", "fusion_threshold", "cycle_time_ms",
-              "wave_width", "algo_threshold", "wire_dtype")
-_ZERO_OK_KNOBS = ("algo_threshold", "wire_dtype")
+              "wave_width", "algo_threshold", "wire_dtype",
+              "priority_bands")
+_ZERO_OK_KNOBS = ("algo_threshold", "wire_dtype", "priority_bands")
+_LADDER_PREFIX = "fusion_ladder_"
+
+
+def _knob_ok(k: str) -> bool:
+    if k in LIVE_KNOBS:
+        return True
+    if k.startswith(_LADDER_PREFIX):
+        suffix = k[len(_LADDER_PREFIX):]
+        return suffix.isdigit() and int(suffix) < 8
+    return False
 #: Wiring-time knobs the startup micro-probe may pin.
 WIRING_KNOBS = {"num_channels": "HOROVOD_NUM_CHANNELS",
                 "channel_drivers": "HOROVOD_CHANNEL_DRIVERS"}
@@ -47,7 +60,7 @@ def load_state(path: str) -> Optional[dict]:
     if not isinstance(committed, dict):
         return None
     clean = {k: int(v) for k, v in committed.items()
-             if k in LIVE_KNOBS and isinstance(v, (int, float)) and
+             if _knob_ok(k) and isinstance(v, (int, float)) and
              (v > 0 or (v == 0 and k in _ZERO_OK_KNOBS))}
     if not clean:
         return None
@@ -71,7 +84,7 @@ def save_state(path: str, committed: dict, score: Optional[float],
     state = {
         "version": _VERSION,
         "committed": {k: int(v) for k, v in committed.items()
-                      if k in LIVE_KNOBS},
+                      if _knob_ok(k)},
         "score": score,
         "seed": int(seed),
     }

@@ -61,7 +61,8 @@ def _env_float(name: str, dflt: float) -> float:
         return dflt
 
 
-def default_space(num_channels: int) -> Dict[str, List[int]]:
+def default_space(num_channels: int,
+                  priority_bands: int = 0) -> Dict[str, List[int]]:
     """Log-scaled ladders for the live-tunable knobs.  The wave ladder is
     bounded by the committed channel fan-out (waves cannot exceed it).
     ``HOROVOD_AUTOTUNE_KNOBS`` (comma list) restricts which knobs are
@@ -86,6 +87,18 @@ def default_space(num_channels: int) -> Dict[str, List[int]]:
         # crossover is host-dependent, which is exactly why it's a knob.
         "algo_threshold": [0] + ladder(8 << 10, 256 << 10),
     }
+    # Per-band fusion-threshold LADDER (priority scheduling): with
+    # HOROVOD_PRIORITY_BANDS committed on, each band's bucket size is
+    # its own coordinate — urgent bands typically want SMALL buckets
+    # (dispatch sooner), bulk bands big ones (amortize) — so the model's
+    # bucket sizes are LEARNED instead of one-size-fits-all.
+    # HOROVOD_AUTOTUNE_LADDER_BANDS caps how many leading bands get a
+    # dimension (default 2; bands past the ladder share the global
+    # fusion threshold).
+    if priority_bands > 0:
+        nb = _env_int("HOROVOD_AUTOTUNE_LADDER_BANDS", 2)
+        for b in range(max(0, min(8, nb))):
+            space[f"fusion_ladder_{b}"] = ladder(1 << 20, 64 << 20)
     only = os.environ.get("HOROVOD_AUTOTUNE_KNOBS", "")
     keep = {k.strip() for k in only.split(",") if k.strip()}
     if os.environ.get("HOROVOD_AUTOTUNE_WIRE", "") not in ("", "0") or \
@@ -179,6 +192,17 @@ class Autotuner(threading.Thread):
         and breaking the deterministic-schedule contract."""
         before = self._lib.horovod_tune_trials()
         epoch0 = self._eng.epoch()
+        # Per-band fusion ladder rides as a positional array (band b's
+        # threshold; 0 = leave that band unchanged).
+        ladder_keys = sorted(
+            (k for k in cfg if k.startswith("fusion_ladder_")),
+            key=lambda k: int(k.rsplit("_", 1)[1]))
+        fusion_ladder = None
+        if ladder_keys:
+            nb = max(int(k.rsplit("_", 1)[1]) for k in ladder_keys) + 1
+            fusion_ladder = [0] * nb
+            for k in ladder_keys:
+                fusion_ladder[int(k.rsplit("_", 1)[1])] = int(cfg[k])
         ok = self._eng.autotune_set(
             chunk_bytes=cfg.get("chunk_bytes", 0),
             fusion_threshold=cfg.get("fusion_threshold", 0),
@@ -186,6 +210,8 @@ class Autotuner(threading.Thread):
             wave_width=cfg.get("wave_width", 0),
             algo_threshold=cfg.get("algo_threshold", -1),
             wire_dtype=cfg.get("wire_dtype", -1),
+            priority_bands=cfg.get("priority_bands", -1),
+            fusion_ladder=fusion_ladder,
             commit=commit)
         if not ok:
             return False
@@ -255,6 +281,14 @@ class Autotuner(threading.Thread):
             # a warm restart without that opt-in must not silently put
             # the new job on a lossy wire.
             warm.pop("wire_dtype", None)
+        if warm is not None:
+            # The band width is never swept (ordering semantics belong
+            # to the user's env), and a LIVE flip races enqueue-time
+            # priority stamping across ranks for one step — a state
+            # file carrying priority_bands (hand-edited; the store's
+            # sanitizer admits the key for the ladder's sake) must not
+            # re-apply it mid-run.  The env knob is the only way in.
+            warm.pop("priority_bands", None)
         return warm or None
 
     def _search_once(self) -> bool:
@@ -266,7 +300,13 @@ class Autotuner(threading.Thread):
         base = {k: int(v) for k, v in cfg_now.items()
                 if k in ("chunk_bytes", "fusion_threshold",
                          "cycle_time_ms", "wave_width", "algo_threshold")}
-        space = default_space(cfg_now["num_channels"])
+        space = default_space(cfg_now["num_channels"],
+                              int(cfg_now.get("priority_bands", 0)))
+        for k in space:
+            # Ladder dims start from the global fusion threshold (the
+            # engine's effective per-band value when unset).
+            if k.startswith("fusion_ladder_"):
+                base.setdefault(k, int(cfg_now["fusion_threshold"]))
         if "wire_dtype" in space:
             # Only when the wire knob is actually swept does it join the
             # base/committed config (config reports it as a NAME; the

@@ -73,6 +73,26 @@ int64_t horovod_enqueue_wire(int op, const char* name, int dtype, int ndim,
                                /*probe=*/false, wire_dtype);
 }
 
+// Like horovod_enqueue_wire with the full per-tensor scheduling surface:
+// `priority` (>= 0; 0 = most urgent, the default) is the metadata the
+// priority-banded coordinator orders responses by (frontends stamp it
+// from registration order), and `wire_advisory` != 0 marks the explicit
+// wire_dtype as knob-like (the coordinator commits the first value on a
+// cross-rank disagreement instead of erroring — the seam the
+// statistics-driven wire policy rides, since per-rank gradient stats may
+// legitimately disagree for a step).
+int64_t horovod_enqueue_priority(int op, const char* name, int dtype,
+                                 int ndim, const int64_t* shape, void* data,
+                                 int root_rank, int red_op, int wire_dtype,
+                                 int wire_advisory, int priority) {
+  std::vector<int64_t> dims(shape, shape + ndim);
+  return Engine::Get().Enqueue(static_cast<RequestType>(op), name,
+                               static_cast<DataType>(dtype), dims, data,
+                               root_rank, static_cast<hvd::ReduceOp>(red_op),
+                               /*probe=*/false, wire_dtype, priority,
+                               wire_advisory != 0);
+}
+
 // Layout-probe allreduce (sum) for a tensor whose gradient never
 // materialized locally: completes as a normal dense allreduce unless peers
 // are gathering the tensor sparsely, in which case the handle fails with
@@ -228,6 +248,18 @@ int64_t horovod_wire_dtype() {
   return static_cast<int64_t>(Engine::Get().wire_dtype());
 }
 
+// Priority scheduling (HOROVOD_PRIORITY_BANDS): the committed band
+// width (0 = off — legacy arrival ordering bit-for-bit) and the
+// deterministic inversions counter (committed responses dispatched
+// after a less-urgent response of the same cycle; 0 by construction
+// with bands on).
+int64_t horovod_priority_bands() {
+  return Engine::Get().priority_bands();
+}
+int64_t horovod_priority_inversions() {
+  return Engine::Get().priority_inversions();
+}
+
 // Straggler-tolerance observability (HOROVOD_BACKUP_WORKERS / local
 // SGD): the committed over-provisioning, how many partial commits left
 // THIS rank out, outer local-SGD syncs noted by the Python policy, and
@@ -325,13 +357,25 @@ int64_t horovod_tune_trials() { return Engine::Get().tune_trials(); }
 // algo_threshold, where 0 is a real value (small path off) and "leave
 // unchanged" is < 0; commit != 0 marks the search's final config.
 // Returns 0 queued, -1 when not initialized or not the coordinator.
+// `priority_bands` < 0 leaves the band width unchanged (0 is real:
+// bands off); `fusion_ladder` (ladder_n entries, may be null/0) sets
+// band b's fusion threshold where the entry is > 0.  Callers gate on
+// the horovod_priority_bands symbol before using this signature (the
+// same stale-.so discipline as the wire_dtype extension before it).
 int horovod_autotune_set(int64_t chunk_bytes, int64_t fusion_threshold,
                          int64_t cycle_time_ms, int64_t wave_width,
                          int64_t algo_threshold, int64_t wire_dtype,
+                         int64_t priority_bands,
+                         const int64_t* fusion_ladder, int ladder_n,
                          int commit) {
+  std::vector<int64_t> ladder;
+  if (fusion_ladder != nullptr && ladder_n > 0) {
+    ladder.assign(fusion_ladder, fusion_ladder + ladder_n);
+  }
   return Engine::Get().QueueTune(chunk_bytes, fusion_threshold,
                                  cycle_time_ms, wave_width, algo_threshold,
-                                 wire_dtype, commit != 0);
+                                 wire_dtype, priority_bands, ladder,
+                                 commit != 0);
 }
 
 // -- fleet observability plane (HOROVOD_TELEMETRY_CYCLES /

@@ -659,6 +659,37 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
     }
     wire_dtype_.store(wv);
   }
+  // Priority scheduling: HOROVOD_PRIORITY_BANDS is the band WIDTH
+  // (band = priority / width; 0 = off — bit-identical legacy arrival
+  // ordering).  The coordinator's resolution is committed at rendezvous
+  // like the channel count: response ORDER is part of the wire pattern
+  // (waves pair responses with channels by list index), so every rank
+  // must band identically.  Live-tunable thereafter (knob #7).
+  {
+    int64_t pb = EnvInt64("HOROVOD_PRIORITY_BANDS", 0);
+    if (pb < 0) pb = 0;
+    if (pb > (1 << 20)) pb = 1 << 20;
+    priority_bands_.store(pb);
+  }
+  // Per-band fusion-threshold ladder (autotuner-learned bucket sizes):
+  // HOROVOD_FUSION_LADDER="t0,t1,..." — band b fuses up to ladder[b]
+  // bytes (missing/zero entries fall back to HOROVOD_FUSION_THRESHOLD;
+  // bands past the last slot share it).
+  for (int b = 0; b < kFusionLadderMax; ++b) fusion_ladder_[b].store(0);
+  if (const char* lad = std::getenv("HOROVOD_FUSION_LADDER");
+      lad != nullptr && lad[0] != '\0') {
+    std::string all(lad);
+    int b = 0;
+    for (size_t start = 0; start < all.size() && b < kFusionLadderMax;
+         ++b) {
+      size_t end = all.find(',', start);
+      if (end == std::string::npos) end = all.size();
+      char* endp = nullptr;
+      long long v = std::strtoll(all.c_str() + start, &endp, 10);
+      if (endp != nullptr && v > 0) fusion_ladder_[b].store(v);
+      start = end + 1;
+    }
+  }
   shm_ring_bytes_ = EnvInt64("HOROVOD_SHM_RING_BYTES", 2 << 20);
   if (shm_ring_bytes_ < (1 << 16)) shm_ring_bytes_ = 1 << 16;
   // Straggler tolerance: over-provision k backup workers — the
@@ -1525,6 +1556,10 @@ int Engine::CoordinatorRendezvous(const std::string& host, int port,
     // impossible by construction.
     w.i32(link_retries_);
     w.i64(link_heal_timeout_ms_);
+    // Committed priority band width: response ORDER is wire pattern
+    // (waves pick channels by list index), so the whole world bands
+    // identically or not at all.
+    w.i64(priority_bands_.load());
     w.vu(uniq_hosts.size());
     for (const auto& h : uniq_hosts) w.str(h);
     for (int i = 0; i < new_size; ++i) {
@@ -1666,12 +1701,14 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     int32_t committed_backup = r.i32();
     int32_t committed_link_retries = r.i32();
     int64_t committed_heal_ms = r.i64();
+    int64_t committed_bands = r.i64();
     if (!r.ok() || new_size < 1 || new_rank < 0 || new_rank >= new_size ||
         committed_channels < 1 || committed_channels > 16 ||
         committed_wave < 1 || committed_wave > 16 || committed_algo < 0 ||
         committed_backup < 0 || committed_backup >= new_size ||
         committed_link_retries < 0 || committed_link_retries > 1000 ||
-        committed_heal_ms < 1) {
+        committed_heal_ms < 1 || committed_bands < 0 ||
+        committed_bands > (1 << 20)) {
       lasterr = "bad membership assignment frame";
       break;
     }
@@ -1715,6 +1752,7 @@ int Engine::WorkerRendezvous(const std::string& host, int port,
     algo_threshold_.store(committed_algo);
     backup_workers_ = committed_backup;
     link_retries_ = committed_link_retries;
+    priority_bands_.store(committed_bands);
     // The committed deadline re-clamps against THIS rank's socket
     // timeout: the coordinator clamped against its own, but "healing
     // must finish strictly inside every other rank's no-progress
@@ -2487,6 +2525,7 @@ const char* const kTelemCounterNames[TC_COUNT] = {
     "shm_bytes_tx",         "compressed_bytes_tx",
     "wire_bytes_saved",     "backup_skips",
     "stale_epoch_msgs",     "stall_warnings",
+    "priority_inversions",
 };
 
 TelemEntry Engine::BuildTelemEntry() {
@@ -2509,6 +2548,7 @@ TelemEntry Engine::BuildTelemEntry() {
       shm_bytes_tx_.load(),         compressed_bytes_tx_.load(),
       wire_bytes_saved_.load(),     backup_skips_.load(),
       stale_epoch_msgs_.load(),     stall_warnings_.load(),
+      priority_inversions_.load(),
   };
   t.deltas.resize(TC_COUNT);
   for (int i = 0; i < TC_COUNT; ++i) {
@@ -2787,7 +2827,9 @@ bool Engine::RunLoopOnce() {
         timeline_.FlowSend(q.tensor_name, epoch_.load());
       }
     }
+    if (priority_bands_.load() > 0) OrderResponsesByPriority(responses);
     FuseResponses(responses);
+    CountPriorityInversions(responses, {});
     if (!responses.empty()) exec_cycles_.fetch_add(1);
     ExecuteResponses(responses);
     // World of one: no frame flows, so drain + apply the pending TUNE
@@ -2939,9 +2981,8 @@ bool Engine::RunLoopOnce() {
     // (their wire formats were committed per response at negotiation;
     // chunk/wave/algo knobs flip identically everywhere).
     if (response_list.tune) ApplyTune(response_list);
-    bool executed_any = !response_list.responses.empty();
-    ExecuteResponses(response_list.responses);
-    if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
+    bool executed_any = false;
+    if (!DispatchCycleResponses(response_list, &executed_any)) return false;
     if (executed_any) exec_cycles_.fetch_add(1);
     if (!stall_check_disabled_) CheckForStalledTensors();
     if (hier) CheckForStalledSubBits();  // rank 0 leads group 0 too
@@ -3118,9 +3159,8 @@ bool Engine::RunLoopOnce() {
   // the coordinator path above: a completion-woken enqueue must never
   // read a pre-TUNE knob after a peer already applied it.
   if (response_list.tune) ApplyTune(response_list);
-  bool executed_any = !response_list.responses.empty();
-  ExecuteResponses(response_list.responses);
-  if (!ExecuteCachedResponses(response_list, &executed_any)) return false;
+  bool executed_any = false;
+  if (!DispatchCycleResponses(response_list, &executed_any)) return false;
   if (executed_any) exec_cycles_.fetch_add(1);
   if (leader) CheckForStalledSubBits();
   return !response_list.shutdown;
@@ -3133,6 +3173,8 @@ bool Engine::RunLoopOnce() {
 int Engine::QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
                       int64_t cycle_time_ms, int64_t wave_width,
                       int64_t algo_threshold, int64_t wire_dtype,
+                      int64_t priority_bands,
+                      const std::vector<int64_t>& fusion_ladder,
                       bool commit) {
   if (!initialized_.load() || shut_down_.load()) return -1;
   // Only the coordinator may propose: TUNE rides its response broadcast.
@@ -3145,6 +3187,15 @@ int Engine::QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
   pending_tune_.wave_width = static_cast<int32_t>(wave_width);
   pending_tune_.algo_threshold = algo_threshold;
   pending_tune_.wire_dtype = static_cast<int32_t>(wire_dtype);
+  pending_tune_.priority_bands = priority_bands;
+  // Clamp to the engine's ladder capacity BEFORE the wire: the frame
+  // parser rejects oversized ladders as corrupt (a whole-world abort),
+  // and entries past kFusionLadderMax could never apply anyway.
+  pending_tune_.fusion_ladder = fusion_ladder;
+  if (pending_tune_.fusion_ladder.size() >
+      static_cast<size_t>(kFusionLadderMax)) {
+    pending_tune_.fusion_ladder.resize(kFusionLadderMax);
+  }
   pending_tune_.commit = commit;
   tune_pending_.store(true);
   cycle_cv_.notify_one();  // an idle world still ships the frame promptly
@@ -3163,6 +3214,8 @@ bool Engine::DrainPendingTune(ResponseList* out) {
   out->tune_wave_width = pending_tune_.wave_width;
   out->tune_algo_threshold = pending_tune_.algo_threshold;
   out->tune_wire_dtype = pending_tune_.wire_dtype;
+  out->tune_priority_bands = pending_tune_.priority_bands;
+  out->tune_fusion_ladder = pending_tune_.fusion_ladder;
   tune_pending_.store(false);
   return true;
 }
@@ -3202,15 +3255,40 @@ void Engine::ApplyTune(const ResponseList& list) {
   if (list.tune_wire_dtype >= 0 && list.tune_wire_dtype <= 4) {
     wire_dtype_.store(static_cast<int>(list.tune_wire_dtype));
   }
+  // Priority band width (0 real = bands off, < 0 unchanged) — applied
+  // at the same between-cycles boundary as every other knob, so the
+  // whole world flips its response ordering atomically.  NOTE: the
+  // Python side gates priority STAMPING on bands>0, so a live flip can
+  // race one step's enqueue-time sampling across ranks (one rank stamps
+  // before applying, a peer after) — that surfaces as the clean
+  // "Mismatched priorities" error, never a garbled dispatch, and the
+  // autotuner never sweeps this knob (only the per-band ladder, which
+  // cannot change stamping).
+  if (list.tune_priority_bands >= 0) {
+    priority_bands_.store(
+        std::min<int64_t>(1 << 20, list.tune_priority_bands));
+  }
+  // Per-band fusion-threshold ladder: positive entries overwrite their
+  // band's threshold; <= 0 leaves the band unchanged.
+  for (size_t b = 0;
+       b < list.tune_fusion_ladder.size() &&
+       b < static_cast<size_t>(kFusionLadderMax);
+       ++b) {
+    if (list.tune_fusion_ladder[b] > 0) {
+      fusion_ladder_[b].store(list.tune_fusion_ladder[b]);
+    }
+  }
   tune_trials_.fetch_add(1);
-  char desc[224];
+  char desc[256];
   std::snprintf(desc, sizeof(desc),
-                "chunk=%lld,fusion=%lld,cycle=%d,wave=%d,algo=%lld,wire=%s",
+                "chunk=%lld,fusion=%lld,cycle=%d,wave=%d,algo=%lld,wire=%s,"
+                "bands=%lld",
                 static_cast<long long>(chunk_bytes_.load()),
                 static_cast<long long>(fusion_threshold_.load()),
                 cycle_time_ms_.load(), wave_width_.load(),
                 static_cast<long long>(algo_threshold_.load()),
-                WireDtypeName(static_cast<WireDtype>(wire_dtype_.load())));
+                WireDtypeName(static_cast<WireDtype>(wire_dtype_.load())),
+                static_cast<long long>(priority_bands_.load()));
   timeline_.TuneTrial(desc, list.tune_commit);
   GlobalFlightRecorder().Record("tune", control_cycle_seq_, "%s%s", desc,
                                 list.tune_commit ? " (commit)" : "");
@@ -3309,6 +3387,7 @@ static Request RequestFromEntry(const TensorTableEntry& e, int rank) {
   q.red_op = e.red_op;
   q.wire_dtype = e.wire_dtype;
   q.wire_default = e.wire_default;
+  q.priority = e.priority;
   for (int d = 0; d < e.shape.ndim(); ++d) q.shape.push_back(e.shape.dim(d));
   return q;
 }
@@ -3357,6 +3436,7 @@ void Engine::ApplyCacheUpdates(const ResponseList& list) {
         entry.sig.root_rank = e.root_rank;
         entry.sig.red_op = e.red_op;
         entry.sig.wire_dtype = e.wire_dtype;
+        entry.sig.priority = e.priority;
         for (int d = 0; d < e.shape.ndim(); ++d) {
           entry.sig.shape.push_back(e.shape.dim(d));
         }
@@ -3368,6 +3448,7 @@ void Engine::ApplyCacheUpdates(const ResponseList& list) {
       single.root_rank = resp.root_rank;
       single.red_op = resp.red_op;
       single.wire_dtype = resp.wire_dtype;
+      single.priority = entry.sig.priority;
       single.cache_slots.assign(1, -1);
       entry.response = std::move(single);
       cache_by_name_[name] = slot;
@@ -3376,11 +3457,99 @@ void Engine::ApplyCacheUpdates(const ResponseList& list) {
   }
 }
 
-bool Engine::ExecuteCachedResponses(const ResponseList& list,
-                                    bool* executed_any) {
+// Resolve a response's scheduling priority on THIS rank: the
+// coordinator stamped it at build time, cached replays copy it from
+// the replica signature, and worker-side fresh responses received the
+// committed NONZERO values in the frame's trailing priority section —
+// absence means the committed priority was 0.  Never read the local
+// tensor-table entry: a rank that joined a negotiation via a layout
+// PROBE stamped 0 locally while its peers stamped the committed value,
+// and a locally-resolved order would desync the wave/channel pairing
+// across ranks.  Errors and sparse retries stay unknown (-1): they
+// dispatch by response content, outside the priority order.
+int Engine::ResolveResponsePriority(Response& resp) {
+  if (resp.priority >= 0) return resp.priority;
+  if (resp.tensor_names.empty() || resp.type == ResponseType::ERROR ||
+      resp.type == ResponseType::SPARSE_RETRY) {
+    return -1;
+  }
+  if (!resp.participants.empty() &&
+      !RankInParticipants(resp.participants)) {
+    return -1;  // ghost ride: dispatch placement ignores priority anyway
+  }
+  resp.priority = 0;  // committed zero (nonzero would be in the frame)
+  return resp.priority;
+}
+
+// (priority, name) dispatch order for one cycle.  Three classes, each
+// placeable from CROSS-RANK-IDENTICAL information only (the lists must
+// sort identically on every rank or wave/channel pairing desyncs):
+// errors + sparse retries first (local finishes, no wire — they cannot
+// block anything), full-commit responses sorted by (priority, first
+// name) — priorities validated equal everywhere — and backup-worker
+// partial commits last in arrival order (a ghost rank cannot know their
+// priority, so the rule must not depend on it).
+void Engine::OrderResponsesByPriority(std::vector<Response>& responses) {
+  std::vector<Response> front, mid, back;
+  for (auto& r : responses) {
+    if (r.type == ResponseType::ERROR ||
+        r.type == ResponseType::SPARSE_RETRY) {
+      front.push_back(std::move(r));
+    } else if (!r.participants.empty()) {
+      back.push_back(std::move(r));
+    } else {
+      mid.push_back(std::move(r));
+    }
+  }
+  std::stable_sort(
+      mid.begin(), mid.end(), [](const Response& x, const Response& y) {
+        const int px = x.priority < 0 ? 0 : x.priority;
+        const int py = y.priority < 0 ? 0 : y.priority;
+        if (px != py) return px < py;
+        const std::string& nx =
+            x.tensor_names.empty() ? std::string() : x.tensor_names[0];
+        const std::string& ny =
+            y.tensor_names.empty() ? std::string() : y.tensor_names[0];
+        return nx < ny;
+      });
+  responses.clear();
+  for (auto& r : front) responses.push_back(std::move(r));
+  for (auto& r : mid) responses.push_back(std::move(r));
+  for (auto& r : back) responses.push_back(std::move(r));
+}
+
+// Dispatch-order priority inversions for one cycle (`first` dispatches
+// before `second`): a committed response whose priority is strictly
+// more urgent (smaller) than one already dispatched counts once.
+// Deterministic — dispatch-LIST order, not wall clock — so reruns of
+// the same world read the same value; 0 by construction once the
+// banded ordering is on.
+void Engine::CountPriorityInversions(const std::vector<Response>& first,
+                                     const std::vector<Response>& second) {
+  int max_seen = -1;
+  int64_t inversions = 0;
+  auto scan = [&](const std::vector<Response>& rs) {
+    for (const auto& r : rs) {
+      if (r.type == ResponseType::ERROR ||
+          r.type == ResponseType::SPARSE_RETRY ||
+          !r.participants.empty() || r.priority < 0) {
+        continue;
+      }
+      if (max_seen >= 0 && r.priority < max_seen) ++inversions;
+      if (r.priority > max_seen) max_seen = r.priority;
+    }
+  };
+  scan(first);
+  scan(second);
+  if (inversions > 0) priority_inversions_.fetch_add(inversions);
+}
+
+bool Engine::BuildCachedResponses(const ResponseList& list,
+                                  std::vector<Response>* out) {
+  out->clear();
   if (list.cached_slots.empty()) return true;
   AssertBackgroundThread();
-  std::vector<Response> cached;
+  std::vector<Response>& cached = *out;
   cached.reserve(list.cached_slots.size());
   for (uint32_t slot : list.cached_slots) {
     auto it = cache_entries_.find(slot);
@@ -3398,6 +3567,7 @@ bool Engine::ExecuteCachedResponses(const ResponseList& list,
     pending_cache_hits_.erase(slot);
     timeline_.NegotiateCached(it->second.response.tensor_names[0]);
     Response resp = it->second.response;
+    resp.priority = it->second.sig.priority;
     // Backup-worker partial commit on the cached path: graft the
     // cycle's committed participant set onto the replayed response, and
     // the payload geometry from the replica signature (a skipped rank
@@ -3414,12 +3584,43 @@ bool Engine::ExecuteCachedResponses(const ResponseList& list,
     cached.push_back(std::move(resp));
   }
   // Deterministic across ranks: identical slot order (from the frame) and
-  // identical per-tensor dtypes/sizes (signature-agreed) ⇒ identical
-  // fusion ⇒ identical ring execution order (and identical wave/channel
-  // assignment in ExecuteResponses).
+  // identical per-tensor dtypes/sizes/priorities (signature-agreed) ⇒
+  // identical ordering ⇒ identical fusion ⇒ identical ring execution
+  // order (and identical wave/channel assignment in ExecuteResponses).
+  // With bands on, both ends re-order the replays by (priority, name)
+  // from their replica signatures before fusing.
+  if (priority_bands_.load() > 0) OrderResponsesByPriority(cached);
   FuseResponses(cached);
-  *executed_any = true;
-  ExecuteResponses(cached);
+  return true;
+}
+
+// One cycle's full dispatch: fresh responses + cached replays.  Bands
+// off: the legacy order exactly (fresh in frame order, then cached in
+// ascending-slot order) — bit-identical to the pre-priority engine,
+// with the inversions counter still observing what banded ordering
+// WOULD have fixed.  Bands on: one merged (priority, name)-ordered
+// dispatch, so a cached slot can neither head-of-line-block nor be
+// blocked by an urgent fresh response.
+bool Engine::DispatchCycleResponses(ResponseList& list,
+                                    bool* executed_any) {
+  std::vector<Response> cached;
+  if (!BuildCachedResponses(list, &cached)) return false;
+  for (auto& resp : list.responses) ResolveResponsePriority(resp);
+  *executed_any = !list.responses.empty() || !cached.empty();
+  if (priority_bands_.load() > 0) {
+    std::vector<Response> all;
+    all.reserve(list.responses.size() + cached.size());
+    for (auto& r : list.responses) all.push_back(std::move(r));
+    for (auto& r : cached) all.push_back(std::move(r));
+    list.responses.clear();
+    OrderResponsesByPriority(all);
+    CountPriorityInversions(all, {});
+    ExecuteResponses(all);
+  } else {
+    CountPriorityInversions(list.responses, cached);
+    ExecuteResponses(list.responses);
+    ExecuteResponses(cached);
+  }
   return true;
 }
 
@@ -3634,6 +3835,13 @@ ResponseList Engine::CoordinatorStep(std::vector<RequestList>& lists) {
     out.responses.push_back(std::move(resp));
   }
 
+  // Priority scheduling (HOROVOD_PRIORITY_BANDS > 0): commit the
+  // cycle's responses in (priority, name) order instead of arrival
+  // order, so a front-layer gradient that negotiated late in the cycle
+  // still dispatches ahead of the tail — the ByteScheduler insight at
+  // the coordinator's seam.  Bands off: arrival order, bit-identical to
+  // the pre-priority engine.
+  if (priority_bands_.load() > 0) OrderResponsesByPriority(out.responses);
   FuseResponses(out.responses);
   return out;
 }
@@ -3680,6 +3888,12 @@ Response Engine::BuildResponse(const std::string& name) {
   if (wire_ref == nullptr) {
     wire_ref = knob_ref != nullptr ? knob_ref : &first;
   }
+  // Committed scheduling priority: the first non-probe request's value
+  // (frontends stamp identically from registration order; probes adopt
+  // the committed one like they adopt the wire).  Validated cross-rank
+  // below, like dtype/wire.
+  const Request* prio_ref = knob_ref != nullptr ? knob_ref : &first;
+  resp.priority = prio_ref->priority;
 
   for (int r = 1; r < size_; ++r) {
     const Request& q = info.requests[r];
@@ -3725,6 +3939,20 @@ Response Engine::BuildResponse(const std::string& name) {
           << WireDtypeName(q.wire_dtype) << " for tensor " << name
           << " (set HOROVOD_WIRE_DTYPE identically on every rank, or use "
              "the same per-tensor override).";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+    // Scheduling priority is cross-rank metadata like the dtype: the
+    // committed response order derives from it, so disagreeing stamps
+    // must fail cleanly here — never split the dispatch order.  Probes
+    // adopt the committed value (they never stamped one meaningfully).
+    if (!q.probe && q.priority != prio_ref->priority) {
+      err << "Mismatched priorities: rank " << prio_ref->request_rank
+          << " stamped priority " << prio_ref->priority << " but rank "
+          << r << " stamped " << q.priority << " for tensor " << name
+          << " (pass the same priority= on every rank — frontends "
+             "stamping from registration order do this automatically).";
       resp.type = ResponseType::ERROR;
       resp.error_message = err.str();
       return resp;
@@ -3927,13 +4155,35 @@ Response Engine::BuildPartialResponse(
       return resp;
     }
   }
-  resp.type = ResponseType::ALLREDUCE;
-  resp.red_op = ReduceOp::SUM;
-  resp.wire_dtype = wire_ref->wire_dtype;
+  resp.priority = first.priority;
   int64_t elems = 1;
   for (auto d : first.shape) elems *= d;
   resp.partial_elems = elems;
   resp.partial_dtype = static_cast<uint8_t>(first.dtype);
+  if (first.type == RequestType::REDUCESCATTER) {
+    // Partial reduce-scatter: same committed shard geometry as the full
+    // path (largest-first dim-0 split over the WHOLE world — ghosts
+    // drive the full-world cascade, so the geometry never shrinks).
+    if (first.shape.empty()) {
+      err << "reducescatter requires a tensor with at least one "
+             "dimension for tensor " << name << " (partial commit).";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+    resp.type = ResponseType::REDUCESCATTER;
+    resp.red_op = ReduceOp::SUM;
+    resp.wire_dtype = wire_ref->wire_dtype;
+    const int64_t rows = first.shape[0];
+    for (int r = 0; r < size_; ++r) {
+      resp.tensor_sizes.push_back(rows / size_ +
+                                  (r < rows % size_ ? 1 : 0));
+    }
+    return resp;
+  }
+  resp.type = ResponseType::ALLREDUCE;
+  resp.red_op = ReduceOp::SUM;
+  resp.wire_dtype = wire_ref->wire_dtype;
   return resp;
 }
 
@@ -4013,16 +4263,28 @@ void Engine::MaybePartialCommits(ResponseList* out) {
       };
 
   // Full-request pending entries.  Names first: the commit erases them.
+  // Eligibility covers SUM allreduces AND SUM reducescatters (PR 12's
+  // follow-on): an RS ghost contributes the same zero buffer to the
+  // same full-world cascade, and the participants divisor flows through
+  // the handle exactly like the allreduce's.
   std::vector<std::string> names;
   for (auto& kv : message_table_) {
     const PendingInfo& info = kv.second;
     if (info.count <= 0 || info.count >= size_) continue;
     if (now - info.first_seen < grace) continue;
     bool eligible = true;
+    RequestType seen_type = RequestType::ALLREDUCE;
+    bool first_seen_req = true;
     for (int r = 0; r < size_ && eligible; ++r) {
       if (!info.seen[r]) continue;
       const Request& q = info.requests[r];
-      eligible = q.type == RequestType::ALLREDUCE &&
+      if (first_seen_req) {
+        seen_type = q.type;
+        first_seen_req = false;
+      }
+      eligible = (q.type == RequestType::ALLREDUCE ||
+                  q.type == RequestType::REDUCESCATTER) &&
+                 q.type == seen_type &&
                  q.red_op == ReduceOp::SUM && !q.probe;
     }
     if (eligible) names.push_back(kv.first);
@@ -4092,7 +4354,8 @@ void Engine::MaybePartialCommits(ResponseList* out) {
     if (!quorum_ready(std::move(vt))) continue;
     auto ce = cache_entries_.find(kv.first);
     if (ce == cache_entries_.end()) continue;  // defensive
-    if (ce->second.response.type != ResponseType::ALLREDUCE ||
+    if ((ce->second.response.type != ResponseType::ALLREDUCE &&
+         ce->second.response.type != ResponseType::REDUCESCATTER) ||
         ce->second.response.red_op != ReduceOp::SUM) {
       continue;
     }
@@ -4147,6 +4410,18 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
   // consistent regardless.
   const int64_t fusion_threshold = fusion_threshold_.load();
   if (fusion_threshold <= 0) return;
+  // Priority bands: fusion only merges within a band (a 64 MB fused
+  // buffer of tail gradients must never swallow an urgent front-layer
+  // tensor), and each band may carry its own autotuner-learned fusion
+  // threshold (the per-band ladder).  Bands off: one global threshold,
+  // the legacy merge exactly.
+  const int64_t bands = priority_bands_.load();
+  auto band_threshold = [&](const Response& r) -> int64_t {
+    if (bands <= 0) return fusion_threshold;
+    const int64_t lad = fusion_ladder(
+        static_cast<int>(ResponseBand(r)));
+    return lad > 0 ? lad : fusion_threshold;
+  };
   auto entry_bytes = [this](const std::string& name) -> int64_t {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = tensor_table_.find(name);
@@ -4174,11 +4449,14 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
         fused.back().type == ResponseType::ALLREDUCE &&
         fused.back().red_op == resp.red_op &&
         fused.back().wire_dtype == resp.wire_dtype &&
+        (bands <= 0 ||
+         ResponseBand(fused.back()) == ResponseBand(resp)) &&
         entry_dtype(fused.back().tensor_names[0]) ==
             entry_dtype(resp.tensor_names[0])) {
       int64_t total = 0;
       for (auto& n : fused.back().tensor_names) total += entry_bytes(n);
-      if (total + entry_bytes(resp.tensor_names[0]) <= fusion_threshold) {
+      if (total + entry_bytes(resp.tensor_names[0]) <=
+          band_threshold(fused.back())) {
         fused.back().tensor_names.push_back(resp.tensor_names[0]);
         fused.back().cache_slots.push_back(resp.cache_slots[0]);
         continue;
@@ -4250,25 +4528,51 @@ void Engine::ExecuteResponses(std::vector<Response>& responses) {
     last_exec_time_ = std::chrono::steady_clock::now();
     return;
   }
-  for (size_t base = 0; base < responses.size();
-       base += static_cast<size_t>(C)) {
-    const int wave =
-        static_cast<int>(std::min<size_t>(C, responses.size() - base));
+  // Band-ordered wave dispatch (HOROVOD_PRIORITY_BANDS > 0): a wave
+  // never spans a band boundary — a low-priority 64 MB fusion buffer
+  // cannot co-schedule with (and therefore head-of-line-block) a more
+  // urgent response, which instead dispatches in its own earlier wave
+  // with the full channel fan-out when it rides alone.  Partial
+  // (backup-worker) responses always ride alone: their priority is
+  // unknowable on ghost ranks, and the boundary rule must derive from
+  // the response content every rank can see.  Bands off: fixed waves of
+  // C in list order, the legacy grouping exactly.
+  const int64_t bands = priority_bands_.load();
+  for (size_t base = 0; base < responses.size();) {
+    int wave = static_cast<int>(
+        std::min<size_t>(C, responses.size() - base));
+    if (bands > 0) {
+      if (!responses[base].participants.empty()) {
+        wave = 1;
+      } else {
+        const int64_t b0 = ResponseBand(responses[base]);
+        int w = 1;
+        while (w < wave &&
+               responses[base + w].participants.empty() &&
+               ResponseBand(responses[base + w]) == b0) {
+          ++w;
+        }
+        wave = w;
+      }
+    }
+    const size_t wave_base = base;
+    base += static_cast<size_t>(wave);
     if (wave == 1) {
-      // Lone trailing response: give it the full fan-out.
-      PerformResponse(responses[base], ExecCtx{0, fanout, nullptr});
+      // Lone response (trailing, band-isolated, or partial): give it
+      // the full fan-out.
+      PerformResponse(responses[wave_base], ExecCtx{0, fanout, nullptr});
       continue;
     }
     std::vector<int64_t> slice_walls(wave, 0);
     TaskLatch latch(wave - 1);
     for (int j = 1; j < wave; ++j) {
-      pool_.Submit([this, &responses, &slice_walls, base, j, &latch] {
-        PerformResponse(responses[base + j],
+      pool_.Submit([this, &responses, &slice_walls, wave_base, j, &latch] {
+        PerformResponse(responses[wave_base + j],
                         ExecCtx{j, 1, &slice_walls[j]});
         latch.Done();
       });
     }
-    PerformResponse(responses[base], ExecCtx{0, 1, &slice_walls[0]});
+    PerformResponse(responses[wave_base], ExecCtx{0, 1, &slice_walls[0]});
     // Wave barrier: a channel must be quiet before the next wave reuses
     // it, or two responses' streams would interleave on one socket.
     latch.Wait();
@@ -4498,7 +4802,8 @@ void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
   // DrainMessageQueue, never stranded here.
   const bool ghost = !response.participants.empty() &&
                      !RankInParticipants(response.participants);
-  if (ghost && (response.type != ResponseType::ALLREDUCE ||
+  if (ghost && ((response.type != ResponseType::ALLREDUCE &&
+                 response.type != ResponseType::REDUCESCATTER) ||
                 response.partial_elems <= 0)) {
     return;  // partial ERROR (or degenerate): nothing to ghost-run
   }
@@ -4542,6 +4847,16 @@ void Engine::PerformResponse(const Response& response, const ExecCtx& ctx) {
   // flow arrow correctly lands on the ghost's RING span.
   for (const auto& name : response.tensor_names) {
     timeline_.FlowRecv(name, epoch_.load());
+  }
+  // Priority scheduling: annotate which band this response dispatched
+  // in (trace forensics for the overlap work — PRIO_BAND0 is the most
+  // urgent).  Bands off or priority unknown (ghost ride): no marker.
+  if (!response.tensor_names.empty() && response.priority >= 0 &&
+      priority_bands_.load() > 0) {
+    char pm[32];
+    std::snprintf(pm, sizeof(pm), "PRIO_BAND%lld",
+                  static_cast<long long>(ResponseBand(response)));
+    timeline_.Algo(response.tensor_names[0], pm);
   }
   switch (response.type) {
     case ResponseType::ALLREDUCE:
@@ -6421,14 +6736,35 @@ void Engine::ExecReducescatter(const Response& response,
   // full allreduce on a scratch buffer and slices the owned shard —
   // same bits by construction, no wire savings (counted in
   // reducescatter_fallback_count).
-  TensorTableEntry& e = entries[0];
-  timeline_.Start(e.name);
-  const size_t esize = DataTypeSize(e.dtype);
+  // Ghost execution (backup workers): a rank OUTSIDE a partial RS
+  // commit's participant set holds no entry but still drives the
+  // IDENTICAL full-world cascade over a zeroed buffer (zero = the SUM
+  // identity) and discards the shard it nominally owns — the wire
+  // pattern never changes shape, exactly the allreduce ghost-ride
+  // contract.  Geometry comes from the response alone: partial_dtype/
+  // partial_elems + the committed per-rank row split in tensor_sizes.
+  const bool ghost = entries.empty();
+  TensorTableEntry* ep = ghost ? nullptr : &entries[0];
+  const std::string tname = ghost ? response.tensor_names[0] : ep->name;
+  if (!ghost) timeline_.Start(tname);
+  const DataType in_dtype =
+      ghost ? static_cast<DataType>(response.partial_dtype) : ep->dtype;
+  const size_t esize = DataTypeSize(in_dtype);
   int64_t row_elems = 1;
-  for (int d = 1; d < e.shape.ndim(); ++d) row_elems *= e.shape.dim(d);
+  if (ghost) {
+    int64_t rows_total = 0;
+    for (auto v : response.tensor_sizes) rows_total += v;
+    row_elems =
+        rows_total > 0 ? response.partial_elems / rows_total : 1;
+    if (row_elems <= 0) row_elems = 1;
+  } else {
+    for (int d = 1; d < ep->shape.ndim(); ++d) {
+      row_elems *= ep->shape.dim(d);
+    }
+  }
 
-  auto hs = GetHandle(e.handle);
-  if (hs == nullptr) return;
+  auto hs = ghost ? nullptr : GetHandle(ep->handle);
+  if (!ghost && hs == nullptr) return;
 
   // Committed per-rank shard geometry (absolute element offsets).
   std::vector<int64_t> shard_count(size_), shard_off(size_);
@@ -6439,26 +6775,41 @@ void Engine::ExecReducescatter(const Response& response,
     off += shard_count[r];
   }
   const int64_t total = off;
+  // Divisor-correct averaging under partial commits: the frontends
+  // divide the shard by the COMMITTED participant count.
+  const int nparticipants = response.participants.empty()
+      ? size_ : static_cast<int>(response.participants.size());
 
-  const int64_t my_rows = response.tensor_sizes[rank_];
-  hs->result_shape.clear();
-  hs->result_shape.push_back(my_rows);
-  for (int d = 1; d < e.shape.ndim(); ++d) {
-    hs->result_shape.push_back(e.shape.dim(d));
+  if (!ghost) {
+    const int64_t my_rows = response.tensor_sizes[rank_];
+    hs->result_shape.clear();
+    hs->result_shape.push_back(my_rows);
+    for (int d = 1; d < ep->shape.ndim(); ++d) {
+      hs->result_shape.push_back(ep->shape.dim(d));
+    }
   }
 
-  const uint8_t* input = static_cast<const uint8_t*>(e.data);
+  std::vector<uint8_t> ghost_zeros;
+  const uint8_t* input;
+  if (ghost) {
+    ghost_zeros.assign(static_cast<size_t>(total) * esize, 0);
+    input = ghost_zeros.data();
+  } else {
+    input = static_cast<const uint8_t*>(ep->data);
+  }
   if (size_ == 1 || total == 0) {
-    hs->result.assign(
-        input, input + static_cast<size_t>(shard_count[rank_]) * esize);
-    timeline_.End(e.name, e.dtype, e.shape.DebugString());
-    FinishEntry(e, Status::OK());
+    if (!ghost) {
+      hs->result.assign(
+          input, input + static_cast<size_t>(shard_count[rank_]) * esize);
+      timeline_.End(tname, in_dtype, ep->shape.DebugString());
+      FinishEntry(*ep, Status::OK(), nparticipants);
+    }
     return;
   }
 
   // Committed wire format (negotiated + validated like the allreduce's;
   // fp32 payloads only).
-  const WireDtype wire = e.dtype == DataType::FLOAT32
+  const WireDtype wire = in_dtype == DataType::FLOAT32
                              ? response.wire_dtype : WireDtype::FP32;
   const bool quantized = wire == WireDtype::INT8 || wire == WireDtype::FP8;
   const bool half_wire = wire == WireDtype::FP16 || wire == WireDtype::BF16;
@@ -6477,7 +6828,7 @@ void Engine::ExecReducescatter(const Response& response,
   std::vector<uint8_t> scratch;
   std::vector<uint16_t> halfbuf;
   uint8_t* exec_buf;
-  DataType exec_dtype = e.dtype;
+  DataType exec_dtype = in_dtype;
   if (half_wire) {
     halfbuf.resize(static_cast<size_t>(total));
     const float* fp = reinterpret_cast<const float*>(input);
@@ -6512,7 +6863,7 @@ void Engine::ExecReducescatter(const Response& response,
     char wm[16];
     std::snprintf(wm, sizeof(wm), "WIRE_%s", WireDtypeName(wire));
     for (char* c = wm; *c; ++c) *c = static_cast<char>(toupper(*c));
-    timeline_.Algo(e.name, wm);
+    timeline_.Algo(tname, wm);
   }
 
   // Two-level eligibility: host blocks (node-major contiguous grouping)
@@ -6545,27 +6896,27 @@ void Engine::ExecReducescatter(const Response& response,
   bool ok;
   std::string msg;
   auto t0 = std::chrono::steady_clock::now();
-  timeline_.ActivityStart(e.name, "RS");
+  timeline_.ActivityStart(tname, "RS");
   if (!half_path) {
     // Exact-parity fallback: the full allreduce cascade on the staged
     // buffer — the SAME RunAllreduceCascade selection ExecAllreduce
     // runs, so the bitwise anchor can never drift — then slice the
     // owned shard locally.
     reducescatter_fallback_count_.fetch_add(1);
-    timeline_.Algo(e.name, "RS_FALLBACK");
+    timeline_.Algo(tname, "RS_FALLBACK");
     ok = RunAllreduceCascade(exec_buf, total, exec_dtype,
                              response.red_op, wire, quantized, half_wire,
                              UseSmallAlgo(exec_bytes, ctx) && !quantized,
-                             "reducescatter", e.name, ctx, &msg);
+                             "reducescatter", tname, ctx, &msg);
   } else if (two_level_) {
-    timeline_.Algo(e.name, "RS_TWO_LEVEL");
+    timeline_.Algo(tname, "RS_TWO_LEVEL");
     ok = TwoLevelReduceScatter(exec_buf, total, exec_dtype,
                                response.red_op, shard_count, shard_off,
-                               e.name, ctx, half_wire, &msg);
+                               tname, ctx, half_wire, &msg);
   } else if (small) {
     // Star fold + shard scatter: the leader reproduces the ring's exact
     // fold (bit-equal for ANY shard geometry), members get their slices.
-    timeline_.Algo(e.name, "RS_STAR");
+    timeline_.Algo(tname, "RS_STAR");
     ok = StarFoldAllreduce(exec_buf, total, exec_dtype, response.red_op,
                            /*broadcast_result=*/false, &msg);
     if (ok) {
@@ -6584,29 +6935,30 @@ void Engine::ExecReducescatter(const Response& response,
     // rank ends owning segment `rank` — its committed shard, because
     // aligned geometry made the two splits identical — and the fold
     // order per segment is EXACTLY the allreduce's.
-    timeline_.Algo(e.name, "RS_HALF");
+    timeline_.Algo(tname, "RS_HALF");
     std::string err;
     RingSpec spec = FlatRingSpec();
     spec.compressed = half_wire;
     ok = ChanneledRingAllreduce(exec_buf, total, exec_dtype,
-                                response.red_op, spec, ctx, e.name, &err,
+                                response.red_op, spec, ctx, tname, &err,
                                 /*rs_only=*/true);
     if (!ok) {
-      msg = TransportError("reducescatter", e.name, err,
+      msg = TransportError("reducescatter", tname, err,
                            (rank_ + 1) % size_,
                            (rank_ - 1 + size_) % size_);
     }
   }
-  timeline_.ActivityEnd(e.name);
+  timeline_.ActivityEnd(tname);
   reducescatter_ns_.fetch_add(
       std::chrono::duration_cast<std::chrono::nanoseconds>(
           std::chrono::steady_clock::now() - t0)
           .count());
   reducescatter_bytes_.fetch_add(total * static_cast<int64_t>(esize));
   if (!ok) {
-    FinishEntry(e, Status::Aborted(msg));
+    if (!ghost) FinishEntry(*ep, Status::Aborted(msg));
     return;
   }
+  if (ghost) return;  // wire driven; the shard is nobody's result
 
   // Extract the owned shard (converting back from the half staging
   // buffer when the wire was fp16/bf16 — shard only: the rest of the
@@ -6633,8 +6985,8 @@ void Engine::ExecReducescatter(const Response& response,
     memcpy(hs->result.data(), exec_buf + shard_off[rank_] * esize,
            static_cast<size_t>(shard_count[rank_]) * esize);
   }
-  timeline_.End(e.name, e.dtype, e.shape.DebugString());
-  FinishEntry(e, Status::OK());
+  timeline_.End(tname, in_dtype, ep->shape.DebugString());
+  FinishEntry(*ep, Status::OK(), nparticipants);
 }
 
 void Engine::ExecAlltoall(const Response& response,
@@ -6974,7 +7326,8 @@ void Engine::MaybeInjectFault() {
 int64_t Engine::Enqueue(RequestType type, const std::string& name,
                         DataType dtype, const std::vector<int64_t>& shape,
                         void* data, int root_rank, ReduceOp red_op,
-                        bool probe, int wire_dtype) {
+                        bool probe, int wire_dtype, int priority,
+                        bool wire_advisory) {
   MaybeInjectFault();
   if (!initialized_.load() || shutdown_requested_.load() ||
       shut_down_.load()) {
@@ -6995,8 +7348,14 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   }
   // Knob-derived resolutions are advisory (the coordinator commits one
   // format at negotiation): sampling the live knob here inherently
-  // races a TUNE landing on peers — see Request::wire_default.
-  const bool wire_default = wire_dtype < 0;
+  // races a TUNE landing on peers — see Request::wire_default.  An
+  // explicit override may OPT INTO the advisory semantics too
+  // (wire_advisory): the statistics-driven wire policy stamps formats
+  // from per-rank gradient stats, which may legitimately disagree for a
+  // step — the coordinator commits the first value instead of erroring.
+  const bool wire_default = wire_dtype < 0 || wire_advisory;
+  if (priority < 0) priority = 0;
+  if (priority > (1 << 30)) priority = 1 << 30;
   int64_t handle = next_handle_.fetch_add(1);
   auto hs = std::make_shared<HandleState>();
   {
@@ -7013,6 +7372,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   e.red_op = red_op;
   e.wire_dtype = wire;
   e.wire_default = wire_default;
+  e.priority = static_cast<int32_t>(priority);
   e.handle = handle;
   e.enqueue_time = std::chrono::steady_clock::now();
 
@@ -7026,6 +7386,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   q.probe = probe;
   q.wire_dtype = wire;
   q.wire_default = wire_default;
+  q.priority = static_cast<int32_t>(priority);
   q.shape = shape;
 
   {

@@ -73,6 +73,7 @@ enum TelemCounter {
   TC_BACKUP_SKIPS,
   TC_STALE_EPOCH_MSGS,
   TC_STALL_WARNINGS,
+  TC_PRIORITY_INVERSIONS,
   TC_COUNT,
 };
 extern const char* const kTelemCounterNames[TC_COUNT];
@@ -92,6 +93,8 @@ struct TensorTableEntry {
   // Request::wire_default.
   WireDtype wire_dtype = WireDtype::FP32;
   bool wire_default = false;
+  // Scheduling priority (0 = most urgent; see Request::priority).
+  int32_t priority = 0;
   int64_t handle = -1;
   // Enqueue wall-clock: FinishEntry derives the per-collective
   // completion latency (step_time_ns percentiles) from it.
@@ -191,10 +194,18 @@ class Engine {
   // TUNE); >= 0 is a per-tensor override.  Only FLOAT32 allreduces ever
   // wire compressed; everything else is forced to the fp32 wire (i.e.
   // its own dtype's bytes, exactly the pre-compression engine).
+  // `priority` (>= 0; 0 = most urgent, the default) is the scheduling
+  // priority frontends stamp from registration order — see
+  // Request::priority.  `wire_advisory` marks an explicit wire_dtype as
+  // knob-like (Request::wire_default): the coordinator commits the first
+  // value on a cross-rank disagreement instead of erroring — the seam
+  // the statistics-driven wire policy uses, since per-rank gradient
+  // stats may legitimately disagree for a step.
   int64_t Enqueue(RequestType type, const std::string& name, DataType dtype,
                   const std::vector<int64_t>& shape, void* data,
                   int root_rank, ReduceOp red_op = ReduceOp::SUM,
-                  bool probe = false, int wire_dtype = -1);
+                  bool probe = false, int wire_dtype = -1,
+                  int priority = 0, bool wire_advisory = false);
 
   // Execution stats (readable from any thread).  `exec_cycles` counts
   // negotiation cycles that executed at least one response on this rank;
@@ -319,6 +330,25 @@ class Engine {
   // Effective default wire dtype (live-tunable knob #6).
   int wire_dtype() const { return wire_dtype_.load(); }
 
+  // Priority scheduling (HOROVOD_PRIORITY_BANDS, live-tunable knob #7).
+  // `priority_bands` is the committed band WIDTH (band = priority /
+  // width; 0 = off = bit-identical legacy arrival ordering);
+  // `priority_inversions` counts committed responses dispatched after a
+  // strictly less-urgent (higher-priority-number) response of the SAME
+  // cycle — deterministic (dispatch-list order, not wall clock), and by
+  // construction 0 with bands on.  `fusion_ladder(b)` is band b's
+  // effective fusion threshold (0 = fall back to the global knob).
+  int64_t priority_bands() const { return priority_bands_.load(); }
+  int64_t priority_inversions() const {
+    return priority_inversions_.load();
+  }
+  static constexpr int kFusionLadderMax = 8;
+  int64_t fusion_ladder(int band) const {
+    if (band < 0) return 0;
+    if (band >= kFusionLadderMax) band = kFusionLadderMax - 1;
+    return fusion_ladder_[band].load();
+  }
+
   // Straggler-tolerance observability.  `backup_workers` is the
   // committed HOROVOD_BACKUP_WORKERS over-provisioning (rendezvous
   // commits the coordinator's value, like the channel count);
@@ -440,9 +470,14 @@ class Engine {
   // structurally dropped.  Values <= 0 leave the knob unchanged;
   // `commit` marks the search's final config (timeline/observability).
   // Returns 0 queued, -1 when not initialized or not the coordinator.
+  // `priority_bands` < 0 leaves the band width unchanged (0 is real:
+  // bands off); `fusion_ladder` entries <= 0 leave that band's fusion
+  // threshold unchanged (empty ladder = whole ladder unchanged).
   int QueueTune(int64_t chunk_bytes, int64_t fusion_threshold,
                 int64_t cycle_time_ms, int64_t wave_width,
-                int64_t algo_threshold, int64_t wire_dtype, bool commit);
+                int64_t algo_threshold, int64_t wire_dtype,
+                int64_t priority_bands,
+                const std::vector<int64_t>& fusion_ladder, bool commit);
 
   // Why the engine aborted ("" while healthy or after a clean shutdown).
   // Safe to call from any thread: the background thread publishes
@@ -536,11 +571,19 @@ class Engine {
   // responses.  Must run BEFORE the responses execute (execution drains
   // the tensor table the signatures are read from).
   void ApplyCacheUpdates(const ResponseList& list);
-  // Execute the cycle's agreed cached slots from the local replica
-  // (fused like freshly negotiated responses).  Returns false — aborting
-  // the engine — on a replica/protocol inconsistency (an agreed slot this
+  // Build (but do not execute) the cycle's agreed cached slots from the
+  // local replica: replayed single-tensor responses with participants
+  // grafted for partial slots, fused like freshly negotiated responses
+  // (band-aware under priority bands).  Returns false — aborting the
+  // engine — on a replica/protocol inconsistency (an agreed slot this
   // rank does not hold), which would otherwise strand tensors forever.
-  bool ExecuteCachedResponses(const ResponseList& list, bool* executed_any);
+  bool BuildCachedResponses(const ResponseList& list,
+                            std::vector<Response>* out);
+  // One cycle's full dispatch (fresh + cached): legacy fresh-then-cached
+  // order with bands off, one merged (priority, name)-ordered dispatch
+  // with bands on.  Sets *executed_any; returns false on a replica
+  // protocol error (engine aborts).
+  bool DispatchCycleResponses(ResponseList& list, bool* executed_any);
   // Coordinator-side: drop a slot everywhere (idempotent within a cycle).
   void CoordinatorEvictSlot(uint32_t slot, ResponseList* out);
   void ClearCacheState();
@@ -960,11 +1003,15 @@ class Engine {
     // renegotiating — a cached response can never replay a stale wire
     // format.
     WireDtype wire_dtype = WireDtype::FP32;
+    // Priority is signature-relevant too: a priority change must evict
+    // and renegotiate so cached-slot replay always orders (and
+    // band-fuses) by the CURRENT priority on every rank.
+    int32_t priority = 0;
     std::vector<int64_t> shape;
     bool Matches(const Request& q) const {
       return q.type == type && q.dtype == dtype && q.root_rank == root_rank &&
              q.red_op == red_op && q.wire_dtype == wire_dtype &&
-             q.shape == shape;
+             q.priority == priority && q.shape == shape;
     }
   };
   struct CacheEntry {
@@ -1297,6 +1344,42 @@ class Engine {
   // resolved wire dtype and the coordinator validates cross-rank, so a
   // heterogeneous env surfaces as a clean error — never a garbled wire.
   std::atomic<int> wire_dtype_{0};
+  // HOROVOD_PRIORITY_BANDS: priority band WIDTH (band = priority /
+  // width).  0 = off: bit-identical legacy arrival ordering, no wave
+  // splitting, no band fusion gate.  > 0: the coordinator orders each
+  // cycle's responses by (priority, name), fusion only merges within a
+  // band, and waves dispatch in band order.  Committed in the
+  // rendezvous ASSIGN (ordering IS the wire pattern) and live-tunable
+  // thereafter (knob #7).
+  std::atomic<int64_t> priority_bands_{0};
+  // Per-band fusion-threshold ladder (HOROVOD_FUSION_LADDER env /
+  // autotuner-learned): band b's threshold, 0 = fall back to the global
+  // fusion_threshold_.  Bands >= kFusionLadderMax share the last slot.
+  std::atomic<int64_t> fusion_ladder_[kFusionLadderMax] = {};
+  std::atomic<int64_t> priority_inversions_{0};
+  // Resolve a response's scheduling priority on THIS rank: the
+  // coordinator stamped resp.priority at build time; workers received
+  // the committed NONZERO values in the frame's trailing priority
+  // section (absence = committed 0 — never the local entry, whose
+  // stamp differs on a probing rank).  -1 = unknown (ghost rides,
+  // errors, foreign sparse retries).
+  int ResolveResponsePriority(Response& resp);
+  int64_t ResponseBand(const Response& resp) const {
+    const int64_t width = priority_bands_.load();
+    if (width <= 0 || resp.priority < 0) return 0;
+    return resp.priority / width;
+  }
+  // Count dispatch-order priority inversions over one cycle's combined
+  // execution list (`first` dispatches before `second`) and fold them
+  // into priority_inversions_.
+  void CountPriorityInversions(const std::vector<Response>& first,
+                               const std::vector<Response>& second);
+  // Merge this cycle's cached + fresh responses into ONE dispatch list
+  // ordered by (priority, first name) — errors/sparse-retries first
+  // (they execute locally, no wire), partial commits last (their
+  // priority is unknowable on ghost ranks, so the rule must derive from
+  // the response alone).  Only used with priority_bands > 0.
+  static void OrderResponsesByPriority(std::vector<Response>& responses);
   // HOROVOD_SHM_RING_BYTES: per-direction shm ring capacity.
   int64_t shm_ring_bytes_ = 2 << 20;
   // Concurrent-response wave width: how many independent responses of
@@ -1385,6 +1468,8 @@ class Engine {
     int32_t wave_width = 0;
     int64_t algo_threshold = -1;  // < 0: leave unchanged (0 is a real value)
     int32_t wire_dtype = -1;      // < 0: leave unchanged (0 = fp32 is real)
+    int64_t priority_bands = -1;  // < 0: leave unchanged (0 = bands off)
+    std::vector<int64_t> fusion_ladder;  // empty: unchanged; <=0 per band
     bool commit = false;
   };
   std::mutex tune_mu_;

@@ -140,9 +140,30 @@ void SerializeRequestList(const RequestList& list, Writer* w) {
     w->vi(list.fail_rank);
     w->str(list.fail_message);
   }
-  // Fleet-telemetry piggyback: appended ONLY when present, so the
-  // telemetry-off wire is byte-identical to the pre-telemetry protocol
-  // (the parser gates on remaining bytes, not a flag).
+  // Trailing TAGGED sections, each appended ONLY when present, so a
+  // frame without any is byte-identical to the pre-section protocol
+  // (the parser gates on remaining bytes, then dispatches on the tag).
+  //
+  // Tag 2: per-request scheduling priorities — only the NONZERO entries
+  // travel, as (request index, priority) varint pairs parallel to the
+  // `requests` vector, so an all-default frame (every frontend that
+  // never stamps priorities) costs nothing.
+  {
+    uint64_t nonzero = 0;
+    for (const auto& q : list.requests) {
+      if (q.priority != 0) ++nonzero;
+    }
+    if (nonzero > 0) {
+      w->u8(2);
+      w->vu(nonzero);
+      for (size_t i = 0; i < list.requests.size(); ++i) {
+        if (list.requests[i].priority == 0) continue;
+        w->vu(i);
+        w->vu(static_cast<uint64_t>(list.requests[i].priority));
+      }
+    }
+  }
+  // Tag 1: fleet-telemetry piggyback (HOROVOD_TELEMETRY_CYCLES).
   if (!list.telem.empty()) {
     w->u8(1);
     w->vu(list.telem.size());
@@ -169,13 +190,27 @@ bool ParseRequestList(Reader* r, RequestList* out) {
     out->fail_message.clear();
   }
   out->telem.clear();
-  if (r->ok() && r->remaining() > 0) {
-    if (r->u8() != 1) return false;  // unknown trailing section
-    uint64_t n = r->vu();
-    if (n > (1u << 16)) return false;
-    out->telem.resize(n);
-    for (uint64_t i = 0; i < n; ++i) {
-      if (!ParseTelemEntry(r, &out->telem[i])) return false;
+  // Trailing tagged sections (absence is the flag; see the serializer).
+  while (r->ok() && r->remaining() > 0) {
+    uint8_t tag = r->u8();
+    if (tag == 1) {
+      uint64_t n = r->vu();
+      if (n > (1u << 16)) return false;
+      out->telem.resize(n);
+      for (uint64_t i = 0; i < n; ++i) {
+        if (!ParseTelemEntry(r, &out->telem[i])) return false;
+      }
+    } else if (tag == 2) {
+      uint64_t n = r->vu();
+      if (n > (1u << 20)) return false;
+      for (uint64_t i = 0; i < n && r->ok(); ++i) {
+        uint64_t idx = r->vu();
+        uint64_t prio = r->vu();
+        if (idx >= out->requests.size() || prio > (1u << 30)) return false;
+        out->requests[idx].priority = static_cast<int32_t>(prio);
+      }
+    } else {
+      return false;  // unknown trailing section
     }
   }
   return r->ok();
@@ -262,6 +297,9 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
     w->vi(list.tune_wave_width);
     w->vi(list.tune_algo_threshold);
     w->vi(list.tune_wire_dtype);
+    w->vi(list.tune_priority_bands);
+    w->vu(list.tune_fusion_ladder.size());
+    for (auto v : list.tune_fusion_ladder) w->vi(v);
   }
   // Backup-worker partial commits on the cached path: slot → committed
   // participant bitmap.  Empty on every full-commit cycle (one byte).
@@ -269,6 +307,29 @@ void SerializeResponseList(const ResponseList& list, Writer* w) {
   for (const auto& ps : list.partial_slots) {
     w->vu(ps.slot);
     SerializeSlotBitvector(ps.participants, w);
+  }
+  // Trailing TAGGED section (absence is the flag, like the RequestList's
+  // piggybacks): tag 3 = committed response priorities — only the
+  // NONZERO entries travel, as (response index, priority) pairs.  A
+  // rank that joined a negotiation via a layout PROBE stamped priority
+  // 0 locally while its peers stamped the committed value; shipping the
+  // committed priorities keeps the (priority, name) dispatch order —
+  // and with it the wave/channel pairing — identical on every rank.
+  // All-zero (the default) and legacy frames stay byte-identical.
+  {
+    uint64_t nonzero = 0;
+    for (const auto& s : list.responses) {
+      if (s.priority > 0) ++nonzero;
+    }
+    if (nonzero > 0) {
+      w->u8(3);
+      w->vu(nonzero);
+      for (size_t i = 0; i < list.responses.size(); ++i) {
+        if (list.responses[i].priority <= 0) continue;
+        w->vu(i);
+        w->vu(static_cast<uint64_t>(list.responses[i].priority));
+      }
+    }
   }
 }
 
@@ -296,6 +357,13 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
     out->tune_wave_width = static_cast<int32_t>(r->vi());
     out->tune_algo_threshold = r->vi();
     out->tune_wire_dtype = static_cast<int32_t>(r->vi());
+    out->tune_priority_bands = r->vi();
+    uint64_t nl = r->vu();
+    if (nl > 64) return false;  // corrupt frame guard
+    out->tune_fusion_ladder.clear();
+    for (uint64_t i = 0; i < nl && r->ok(); ++i) {
+      out->tune_fusion_ladder.push_back(r->vi());
+    }
   }
   uint64_t nps = r->vu();
   if (nps > (1u << 20)) return false;
@@ -304,6 +372,24 @@ bool ParseResponseList(Reader* r, ResponseList* out) {
     out->partial_slots[i].slot = static_cast<uint32_t>(r->vu());
     if (!ParseSlotBitvector(r, &out->partial_slots[i].participants)) {
       return false;
+    }
+  }
+  // Trailing tagged sections (see the serializer).
+  while (r->ok() && r->remaining() > 0) {
+    uint8_t tag = r->u8();
+    if (tag == 3) {
+      uint64_t n = r->vu();
+      if (n > (1u << 20)) return false;
+      for (uint64_t i = 0; i < n && r->ok(); ++i) {
+        uint64_t idx = r->vu();
+        uint64_t prio = r->vu();
+        if (idx >= out->responses.size() || prio > (1u << 30)) {
+          return false;
+        }
+        out->responses[idx].priority = static_cast<int32_t>(prio);
+      }
+    } else {
+      return false;  // unknown trailing section
     }
   }
   return r->ok();

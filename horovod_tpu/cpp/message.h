@@ -104,6 +104,16 @@ struct Request {
   // next step's signatures converge.  Only explicit overrides keep the
   // strict mismatch error.
   bool wire_default = false;
+  // Scheduling PRIORITY for this tensor (0 = most urgent, the default).
+  // Frontends stamp it from registration order (first-registered ≈ front
+  // layer ≈ needed first by the NEXT step's forward), so with
+  // HOROVOD_PRIORITY_BANDS > 0 the coordinator can order each cycle's
+  // responses by (priority, name) instead of arrival order.  Validated
+  // cross-rank like dtype/wire (probes exempt).  On the wire it travels
+  // in a trailing tagged section of the RequestList carrying only the
+  // NONZERO entries — an all-default frame is byte-identical to the
+  // pre-priority protocol.
+  int32_t priority = 0;
   std::vector<int64_t> shape;
 };
 
@@ -161,7 +171,9 @@ struct RequestList {
   // bytes remain after the PR 12 fields — so HOROVOD_TELEMETRY_CYCLES=0
   // frames are BYTE-IDENTICAL to the pre-telemetry protocol, and an
   // idle telemetry cycle costs nothing at all (no flag byte: absence is
-  // the flag).
+  // the flag).  Trailing sections are TAGGED (one u8 each: 1 = telem,
+  // 2 = request priorities) so independent optional piggybacks compose
+  // without spending bytes on the common all-absent frame.
   std::vector<TelemEntry> telem;
 };
 
@@ -197,6 +209,14 @@ struct Response {
   std::vector<uint32_t> participants;
   int64_t partial_elems = 0;
   uint8_t partial_dtype = 0;
+  // Committed scheduling priority of this (possibly fused) response.
+  // NONZERO values ride the ResponseList's trailing tagged section
+  // (tag 3) so every rank — including one that joined the negotiation
+  // via a layout probe, whose own stamp was 0 — dispatches in the same
+  // committed order; absence on the wire means "committed 0", keeping
+  // the default frame byte-identical to the legacy protocol.  -1 = not
+  // resolved yet (non-executable responses stay -1).
+  int32_t priority = -1;
 };
 
 struct ResponseList {
@@ -253,6 +273,14 @@ struct ResponseList {
   // frame lands; in-flight negotiations keep their requested format, and
   // the signature change evicts affected cache slots naturally.
   int32_t tune_wire_dtype = -1;
+  // Priority band width (HOROVOD_PRIORITY_BANDS, the 7th live-tunable
+  // knob): 0 is a REAL value (bands off = legacy arrival ordering), so
+  // "leave unchanged" is < 0.
+  int64_t tune_priority_bands = -1;
+  // Per-band fusion-threshold ladder (autotuner-learned bucket sizes):
+  // entry b sets band b's fusion threshold; <= 0 leaves that band
+  // unchanged; an EMPTY vector leaves the whole ladder unchanged.
+  std::vector<int64_t> tune_fusion_ladder;
   // Cached slots of this cycle's `cached_slots` that fired as
   // backup-worker PARTIAL commits: slot → committed participant set
   // (the replayed replica response provides the payload geometry from

@@ -99,11 +99,15 @@ def _is_traced(x) -> bool:
 # ---------------------------------------------------------------------------
 
 def allreduce(tensor, *, axis_name="data", op=Average, average=None,
-              compression=Compression.none, name=None):
+              compression=Compression.none, name=None, priority=None):
     """Allreduce. Inside jit/shard_map: one XLA collective over ``axis_name``.
 
     On concrete values: process-level eager allreduce through the runtime
     engine (identity at size()==1, like the reference under ``-np 1``).
+    ``priority`` (host path only; 0 = most urgent) overrides the
+    scheduling priority the priority-banded coordinator
+    (HOROVOD_PRIORITY_BANDS) orders responses by — every rank must pass
+    the same value for a given name.
     """
     if _is_traced(tensor):
         return _cops.allreduce(
@@ -113,7 +117,8 @@ def allreduce(tensor, *, axis_name="data", op=Average, average=None,
     from horovod_tpu.runtime import eager
 
     return eager.allreduce(tensor, op=op, average=average,
-                           compression=compression, name=name)
+                           compression=compression, name=name,
+                           priority=priority)
 
 
 def grouped_allreduce(tensors, *, axis_name="data", op=Average,
@@ -212,7 +217,7 @@ def alltoall(tensor, *, axis_name="seq", split_axis=0, concat_axis=0,
 
 def allreduce_gradients(grads, *, axis_name=None, op=Average,
                         compression=Compression.none,
-                        fusion_threshold_bytes=None):
+                        fusion_threshold_bytes=None, wire_policy=None):
     """Fused allreduce of a gradient pytree over the data axes.
 
     ``axis_name`` may be a name, tuple of names, or None (= every data-like
@@ -225,12 +230,28 @@ def allreduce_gradients(grads, *, axis_name=None, op=Average,
     error-feedback residual per gradient leaf, and the wire-level
     compressors (``Compression.wire_int8`` etc.) negotiate their wire
     dtype per tensor.
+
+    On the host path every leaf is additionally stamped with a
+    scheduling PRIORITY equal to its registration (tree-flatten) order —
+    first-registered ≈ front layer ≈ needed first by the NEXT step's
+    forward — which the priority-banded coordinator
+    (HOROVOD_PRIORITY_BANDS) uses to dispatch urgent gradients first.
+
+    ``wire_policy`` (a :class:`horovod_tpu.runtime.wire_policy.WirePolicy`;
+    default: the env-configured policy when HOROVOD_WIRE_POLICY=1, else
+    off) chooses a per-leaf wire dtype from rolling gradient statistics
+    (int8 for large embedding-shaped grads, fp32 for norm/bias leaves),
+    stamped as ADVISORY per-tensor overrides so per-rank statistics can
+    never split negotiation.
     """
     leaves = jax.tree.leaves(grads)
     if leaves and not _is_traced(leaves[0]):
         from horovod_tpu.ops.compression import TopKCompressor
         from horovod_tpu.runtime import eager
+        from horovod_tpu.runtime import wire_policy as _wp
 
+        if wire_policy is None and _wp.policy_enabled():
+            wire_policy = _wp.default_policy()
         flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
         if isinstance(compression, TopKCompressor):
             # Sparse path: per-leaf residuals keyed by stable tree-path
@@ -249,9 +270,28 @@ def allreduce_gradients(grads, *, axis_name=None, op=Average,
             # response fusion batches same-dtype/same-wire leaves into
             # few ring collectives (a per-leaf synchronous loop would
             # serialize N round trips and defeat fusion entirely).
+            # Priorities = registration order; the wire policy (when on)
+            # stamps advisory per-leaf formats keyed by the same stable
+            # tree-path names the top-k residuals use.  The original
+            # leaves go to grouped_allreduce unchanged, so the default
+            # (policy-off) path is exactly the pre-policy one; with the
+            # policy ON, the statistics cost one extra host fetch per
+            # leaf — the bounded, opt-in price of observing gradients.
+            wire_dtypes = None
+            if wire_policy is not None:
+                import numpy as _np
+
+                wire_dtypes = [
+                    wire_policy.observe_and_choose(
+                        "grad" + (jax.tree_util.keystr(path) or f".{i}"),
+                        _np.asarray(leaf))
+                    for i, (path, leaf) in enumerate(flat)
+                ]
             out = eager.grouped_allreduce(
                 [leaf for _, leaf in flat], op=op,
-                compression=compression, name="grad")
+                compression=compression, name="grad",
+                priorities=list(range(len(flat))),
+                wire_dtypes=wire_dtypes, wire_advisory=True)
         return jax.tree_util.tree_unflatten(treedef, out)
     if axis_name is None:
         axis_name = _mesh.data_axes() or ("data",)

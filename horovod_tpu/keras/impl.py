@@ -83,8 +83,11 @@ def _host_average_many(arrays, name_prefix: str, compression: str = "none",
             sent.append((a.astype(wire), a.dtype))
         else:
             sent.append((a.copy(), None))
-    handles = [eng.enqueue_allreduce(w, name=f"{name_prefix}.{k}")
-               for k, (w, _) in zip(keys, sent)]
+    # Batch position = registration order = scheduling priority for the
+    # priority-banded coordinator (HOROVOD_PRIORITY_BANDS).
+    handles = [eng.enqueue_allreduce(w, name=f"{name_prefix}.{k}",
+                                     priority=i)
+               for i, (k, (w, _)) in enumerate(zip(keys, sent))]
     # Drain EVERY handle before raising (eng.drain hygiene), and divide
     # by the committed PARTICIPANT count — a backup-worker partial
     # commit (HOROVOD_BACKUP_WORKERS) reduces fewer than size

@@ -35,6 +35,7 @@ TELEM_COUNTERS = [
     "shm_bytes_tx", "compressed_bytes_tx",
     "wire_bytes_saved", "backup_skips",
     "stale_epoch_msgs", "stall_warnings",
+    "priority_inversions",
 ]
 
 
@@ -86,6 +87,11 @@ STATS_METRICS: List[Metric] = [
            "buffer-level bytes saved by compressed wire formats"),
     Metric("backup_skips", "horovod_backup_skips_total", "counter",
            "backup-worker partial commits that left this rank out"),
+    Metric("priority_inversions", "horovod_priority_inversions_total",
+           "counter",
+           "committed responses dispatched after a less-urgent response "
+           "of the same cycle (0 by construction with "
+           "HOROVOD_PRIORITY_BANDS on)"),
     Metric("link_reconnects", "horovod_link_reconnects_total", "counter",
            "data-channel edges transparently re-established mid-collective "
            "(link self-healing, HOROVOD_LINK_RETRIES)"),

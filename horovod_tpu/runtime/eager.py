@@ -62,7 +62,8 @@ def _engine_wire(compression) -> Optional[str]:
 
 
 def allreduce(tensor, *, op=Average, average=None,
-              compression=Compression.none, name: Optional[str] = None):
+              compression=Compression.none, name: Optional[str] = None,
+              priority: Optional[int] = None):
     op = _resolve_op(op, average)
     eng = _engine()
     arr = jnp.asarray(tensor)
@@ -98,16 +99,30 @@ def allreduce(tensor, *, op=Average, average=None,
     host = np.ascontiguousarray(np.asarray(wire))
     reduced = eng.allreduce(host, average=(op is Average), name=name,
                             red_op=_WIRE_OPS[op],
-                            wire_dtype=_engine_wire(compression))
+                            wire_dtype=_engine_wire(compression),
+                            priority=priority)
     return compression.decompress(jnp.asarray(reduced), ctx)
 
 
 def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
                       compression=Compression.none,
-                      name: Optional[str] = None):
+                      name: Optional[str] = None,
+                      priorities: Optional[Sequence[int]] = None,
+                      wire_dtypes: Optional[Sequence] = None,
+                      wire_advisory: bool = False):
     """Allreduce many tensors; cross-process they are enqueued together so
     the coordinator fuses them into few ring collectives
-    (reference response fusion, operations.cc:1815-1842)."""
+    (reference response fusion, operations.cc:1815-1842).
+
+    ``priorities`` (one int per tensor, 0 = most urgent) stamps each
+    tensor's scheduling priority for the priority-banded coordinator
+    (HOROVOD_PRIORITY_BANDS); callers stamping from registration order
+    pass ``range(len(tensors))``.  ``wire_dtypes`` (one entry per
+    tensor, None = default) overrides the wire format per leaf — the
+    statistics-driven wire policy's hookup — and ``wire_advisory=True``
+    makes those overrides knob-like (the coordinator commits the first
+    value on a cross-rank disagreement instead of erroring, which
+    per-rank gradient statistics require)."""
     op = _resolve_op(op, average)
     eng = _engine()
     topk = _topk_spec(compression)
@@ -133,16 +148,34 @@ def grouped_allreduce(tensors: Sequence, *, op=Average, average=None,
             "eager cross-process allreduce supports "
             f"SUM/AVERAGE/MIN/MAX/PRODUCT, got {op}"
         )
+    if priorities is not None and len(priorities) != len(tensors):
+        raise ValueError(
+            f"{len(tensors)} tensors but {len(priorities)} priorities")
+    if wire_dtypes is not None and len(wire_dtypes) != len(tensors):
+        raise ValueError(
+            f"{len(tensors)} tensors but {len(wire_dtypes)} wire_dtypes")
     ctxs, hosts = [], []
     for t in tensors:
         wire, ctx = compression.compress(jnp.asarray(t))
         ctxs.append(ctx)
         hosts.append(np.ascontiguousarray(np.asarray(wire)).copy())
     wd = _engine_wire(compression)
+    # Per-leaf wire resolution: an explicit policy decision wins; a None
+    # entry (policy undecided — warmup, mid-size leaf) falls back to the
+    # compression-derived default, never silently to the global knob
+    # (matching the torch frontend's fallback).
+    def leaf_wire(i):
+        if wire_dtypes is not None and wire_dtypes[i] is not None:
+            return wire_dtypes[i], wire_advisory
+        return wd, False
+
     handles = [
         eng.enqueue_allreduce(
             h, None if name is None else f"{name}.{i}",
-            red_op=_WIRE_OPS[op], wire_dtype=wd)
+            red_op=_WIRE_OPS[op],
+            wire_dtype=leaf_wire(i)[0],
+            priority=None if priorities is None else priorities[i],
+            wire_advisory=leaf_wire(i)[1])
         for i, h in enumerate(hosts)
     ]
     # Drain EVERY handle even when one fails (eng.drain: abandoning the

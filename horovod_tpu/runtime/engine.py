@@ -162,6 +162,16 @@ class NativeEngine:
             lib.horovod_enqueue_wire.restype = ctypes.c_int64
         except AttributeError:
             pass  # stale .so: per-tensor wire overrides raise in _enqueue
+        try:
+            lib.horovod_enqueue_priority.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int,
+            ]
+            lib.horovod_enqueue_priority.restype = ctypes.c_int64
+        except AttributeError:
+            pass  # stale .so: priority enqueues raise in _enqueue
         lib.horovod_enqueue_probe.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
@@ -266,6 +276,8 @@ class NativeEngine:
                         "horovod_link_heal_ns_p99",
                         "horovod_link_retries",
                         "horovod_link_heal_timeout_ms",
+                        "horovod_priority_bands",
+                        "horovod_priority_inversions",
                         "horovod_tune_trials"):
                 fn = getattr(lib, sym)
                 fn.argtypes = []
@@ -295,7 +307,8 @@ class NativeEngine:
             lib.horovod_autotune_set.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-                ctypes.c_int,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int, ctypes.c_int,
             ]
             lib.horovod_autotune_set.restype = ctypes.c_int
         except AttributeError:
@@ -366,15 +379,47 @@ class NativeEngine:
 
     # -- async enqueue API --
 
+    def _stamp_priorities(self) -> bool:
+        """Should enqueues carry their stamped priorities on the wire?
+        True with priority bands committed on (the ordering consumes
+        them) or under HOROVOD_PRIORITY_STAMP=1 (bench/tests measure the
+        inversions counter with bands OFF).  False keeps the bands=0
+        wire BYTE-IDENTICAL to the pre-priority protocol — the
+        frontends stamp unconditionally and this one gate decides."""
+        if os.environ.get("HOROVOD_PRIORITY_STAMP", "") not in ("", "0"):
+            return True
+        fn = getattr(self._lib, "horovod_priority_bands", None)
+        if getattr(fn, "restype", None) is not ctypes.c_int64:
+            return False
+        return int(fn()) > 0
+
     def _enqueue(self, op: int, arr: np.ndarray, name: str,
                  root_rank: int = -1, red_op: str = "sum",
-                 wire_dtype: Optional[str] = None) -> int:
+                 wire_dtype: Optional[str] = None,
+                 priority: Optional[int] = None,
+                 wire_advisory: bool = False) -> int:
+        if priority is not None and not self._stamp_priorities():
+            priority = None
         shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
-        if wire_dtype is not None:
-            if wire_dtype not in WIRE_DTYPES:
-                raise ValueError(
-                    f"unknown wire_dtype {wire_dtype!r} "
-                    f"(want one of {sorted(WIRE_DTYPES)})")
+        if wire_dtype is not None and wire_dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"unknown wire_dtype {wire_dtype!r} "
+                f"(want one of {sorted(WIRE_DTYPES)})")
+        if priority is not None or wire_advisory:
+            fn = getattr(self._lib, "horovod_enqueue_priority", None)
+            if getattr(fn, "restype", None) is not ctypes.c_int64:
+                raise RuntimeError(
+                    "libhorovod_core.so predates per-tensor priorities — "
+                    "rebuild it with `make -C horovod_tpu/cpp`")
+            handle = fn(
+                op, name.encode(), _dtype_code(arr.dtype), arr.ndim, shape,
+                arr.ctypes.data_as(ctypes.c_void_p), root_rank,
+                _RED_OPS[red_op],
+                -1 if wire_dtype is None else WIRE_DTYPES[wire_dtype],
+                1 if wire_advisory else 0,
+                0 if priority is None else max(0, int(priority)),
+            )
+        elif wire_dtype is not None:
             fn = getattr(self._lib, "horovod_enqueue_wire", None)
             if getattr(fn, "restype", None) is not ctypes.c_int64:
                 raise RuntimeError(
@@ -405,15 +450,24 @@ class NativeEngine:
     def enqueue_allreduce(self, arr: np.ndarray,
                           name: Optional[str] = None,
                           red_op: str = "sum",
-                          wire_dtype: Optional[str] = None) -> int:
+                          wire_dtype: Optional[str] = None,
+                          priority: Optional[int] = None,
+                          wire_advisory: bool = False) -> int:
         """In-place allreduce of a contiguous array (``red_op``:
         sum/min/max/prod).  ``wire_dtype`` (fp32/fp16/bf16/int8/fp8)
         overrides the HOROVOD_WIRE_DTYPE wire format for THIS tensor —
         fp32 payloads only; every rank must request the same format or
-        negotiation fails cleanly.  Returns handle."""
+        negotiation fails cleanly (``wire_advisory=True`` relaxes that:
+        the coordinator commits the first value instead — the seam the
+        statistics-driven wire policy uses).  ``priority`` (>= 0; 0 =
+        most urgent, the default) is the scheduling priority the
+        priority-banded coordinator orders responses by
+        (HOROVOD_PRIORITY_BANDS); every rank must stamp the same value.
+        Returns handle."""
         return self._enqueue(
             _OP_ALLREDUCE, arr, self._auto_name("allreduce", name),
-            red_op=red_op, wire_dtype=wire_dtype)
+            red_op=red_op, wire_dtype=wire_dtype, priority=priority,
+            wire_advisory=wire_advisory)
 
     def enqueue_allgather(self, arr: np.ndarray,
                           name: Optional[str] = None) -> int:
@@ -448,15 +502,17 @@ class NativeEngine:
     def enqueue_reducescatter(self, arr: np.ndarray,
                               name: Optional[str] = None,
                               red_op: str = "sum",
-                              wire_dtype: Optional[str] = None) -> int:
+                              wire_dtype: Optional[str] = None,
+                              priority: Optional[int] = None) -> int:
         """Reduce across ranks (``red_op``: sum/min/max/prod), keep this
         rank's dim-0 slice (rows split as evenly as possible, earlier ranks
         take the remainder).  ``wire_dtype`` rides the allreduce codec
         seam (fp32 payloads only): fp16/bf16 run the half-staged RS half,
-        int8/fp8 take the exact-parity fallback."""
+        int8/fp8 take the exact-parity fallback.  ``priority`` as in
+        :meth:`enqueue_allreduce`."""
         return self._enqueue(
             _OP_REDUCESCATTER, arr, self._auto_name("reducescatter", name),
-            red_op=red_op, wire_dtype=wire_dtype)
+            red_op=red_op, wire_dtype=wire_dtype, priority=priority)
 
     def enqueue_alltoall(self, arr: np.ndarray,
                          name: Optional[str] = None) -> int:
@@ -517,11 +573,11 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_link_reconnects",
+        if getattr(getattr(self._lib, "horovod_priority_inversions",
                            None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the link self-healing "
+                "libhorovod_core.so predates the priority-scheduling "
                 "counters (and possibly earlier counter families) — "
                 "rebuild it with `make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
@@ -596,6 +652,14 @@ class NativeEngine:
             "link_heal_ns_p50": self._lib.horovod_link_heal_ns_p50(),
             "link_heal_ns_p99": self._lib.horovod_link_heal_ns_p99(),
             "local_sgd_syncs": self._lib.horovod_local_sgd_syncs(),
+            # Priority scheduling (HOROVOD_PRIORITY_BANDS): committed
+            # responses dispatched after a LESS-urgent response of the
+            # same cycle — deterministic (dispatch-list order), nonzero
+            # only when priorities are stamped and bands are off, and 0
+            # by construction with bands on (the overlap ci gate
+            # asserts it on the real-model loop).
+            "priority_inversions":
+                self._lib.horovod_priority_inversions(),
             "data_bytes_tx": self._lib.horovod_data_bytes_tx(),
             "data_bytes_rx": self._lib.horovod_data_bytes_rx(),
             "reduce_ns": self._lib.horovod_reduce_ns(),
@@ -681,6 +745,9 @@ class NativeEngine:
                 "link_retries": self._lib.horovod_link_retries(),
                 "link_heal_timeout_ms":
                     self._lib.horovod_link_heal_timeout_ms(),
+                # Priority band width (0 = off: legacy arrival ordering
+                # bit-for-bit; committed at rendezvous, live-tunable).
+                "priority_bands": self._lib.horovod_priority_bands(),
                 # Fleet telemetry cadence (0 = off: control frames are
                 # byte-identical to the pre-telemetry wire).
                 "telemetry_cycles": self._lib.horovod_telemetry_cycles(),
@@ -768,27 +835,35 @@ class NativeEngine:
     def autotune_set(self, *, chunk_bytes: int = 0,
                      fusion_threshold: int = 0, cycle_time_ms: int = 0,
                      wave_width: int = 0, algo_threshold: int = -1,
-                     wire_dtype: int = -1, commit: bool = False) -> bool:
+                     wire_dtype: int = -1, priority_bands: int = -1,
+                     fusion_ladder=None, commit: bool = False) -> bool:
         """Queue a TUNE proposal (coordinator only): the engine
         broadcasts it in the next cycle's epoch-stamped frame and every
         rank applies it between cycles.  Values <= 0 leave that knob
-        unchanged — except ``algo_threshold`` and ``wire_dtype``, where
-        0 is a real value (star path off / fp32 wire) and "leave
-        unchanged" is < 0.  Returns False when the engine refused (not
-        initialized, not the coordinator, or a stale prebuilt .so)."""
+        unchanged — except ``algo_threshold``, ``wire_dtype`` and
+        ``priority_bands``, where 0 is a real value (star path off /
+        fp32 wire / bands off) and "leave unchanged" is < 0.
+        ``fusion_ladder`` (sequence) sets band b's fusion threshold
+        where the entry is > 0 (the autotuner's per-band bucket sizes).
+        Returns False when the engine refused (not initialized, not the
+        coordinator, or a stale prebuilt .so)."""
         fn = getattr(self._lib, "horovod_autotune_set", None)
         if getattr(fn, "restype", None) is not ctypes.c_int:
             return False
         # A stale prebuilt .so still EXPORTS horovod_autotune_set with
-        # the old 6-arg signature — passing 7 args would land wire_dtype
-        # in its `commit` slot (-1 is truthy: every trial committed).
-        # Gate on a symbol that only exists alongside the 7-arg version.
-        if getattr(getattr(self._lib, "horovod_wire_dtype", None),
+        # an older, shorter signature — extra args would land in the
+        # wrong slots.  Gate on a symbol that only exists alongside the
+        # priority-era signature (same discipline as the wire_dtype
+        # extension before it).
+        if getattr(getattr(self._lib, "horovod_priority_bands", None),
                    "restype", None) is not ctypes.c_int64:
             return False
+        ladder = [int(v) for v in (fusion_ladder or [])]
+        arr = (ctypes.c_int64 * max(1, len(ladder)))(*(ladder or [0]))
         return fn(int(chunk_bytes), int(fusion_threshold),
                   int(cycle_time_ms), int(wave_width), int(algo_threshold),
-                  int(wire_dtype), 1 if commit else 0) == 0
+                  int(wire_dtype), int(priority_bands),
+                  arr, len(ladder), 1 if commit else 0) == 0
 
     # -- handle API --
 
@@ -884,12 +959,16 @@ class NativeEngine:
     def allreduce(self, tensor, *, average: bool = False,
                   name: Optional[str] = None,
                   red_op: str = "sum",
-                  wire_dtype: Optional[str] = None) -> np.ndarray:
+                  wire_dtype: Optional[str] = None,
+                  priority: Optional[int] = None,
+                  wire_advisory: bool = False) -> np.ndarray:
         arr = np.ascontiguousarray(tensor).copy()
         info: dict = {}
         out = self.synchronize(
             self.enqueue_allreduce(arr, name, red_op,
-                                   wire_dtype=wire_dtype), info)
+                                   wire_dtype=wire_dtype,
+                                   priority=priority,
+                                   wire_advisory=wire_advisory), info)
         if not average:
             return out
         return self._apply_average(out, info.get("participants") or None)

@@ -1,0 +1,168 @@
+"""Per-tensor wire-dtype POLICY driven by gradient statistics.
+
+The PR 8 wire-compression machinery negotiates one wire format per
+tensor (``HOROVOD_WIRE_DTYPE`` global knob, or a per-tensor override).
+A single global format is the wrong trade for real models: large
+embedding-table gradients tolerate int8's per-chunk-scaled quantization
+essentially for free (huge element counts, smooth magnitude
+distributions), while norm/bias leaves are tiny (compressing them saves
+nothing) and numerically load-bearing (they should stay fp32).
+
+This module turns per-leaf rolling statistics into a DETERMINISTIC
+per-tensor wire choice, stamped through the existing per-tensor
+``wire_dtype`` override so the PR 8 negotiation/validation machinery is
+reused unchanged:
+
+* every leaf keeps a rolling (EWMA) abs-max and RMS of its gradient;
+* 0/1-D leaves (biases, norms, scalars) and leaves below
+  ``HOROVOD_WIRE_POLICY_MIN_ELEMS`` elements always stay ``fp32``;
+* large multi-dim fp32 leaves (>= ``HOROVOD_WIRE_POLICY_MIN_ELEMS``
+  elements — embedding/projection-shaped) switch to ``int8`` once the
+  warmup has seen ``HOROVOD_WIRE_POLICY_WARMUP`` steps AND the observed
+  dynamic range ``abs_max / rms`` stays under
+  ``HOROVOD_WIRE_POLICY_RATIO`` (per-chunk scales absorb smooth ranges;
+  a spiky leaf — rare huge outliers over a near-zero body — would lose
+  them to quantization, so it stays fp32);
+* everything else keeps the engine default.
+
+Cross-rank safety: the statistics are PER-RANK, so two ranks can
+legitimately disagree the step a leaf crosses the threshold.  Policy
+wires are therefore stamped as ADVISORY overrides
+(``Request::wire_default`` on the wire): the coordinator commits the
+first value it sees instead of raising the strict mismatch error, every
+rank executes the committed format, and the decisions converge within a
+step — the exact mechanism PR 10 introduced for knob-derived wires
+racing a live TUNE.
+
+Enable with ``HOROVOD_WIRE_POLICY=1`` (the jax
+``allreduce_gradients``/``DistributedOptimizer`` host path picks it up
+automatically), or construct a :class:`WirePolicy` and pass it
+explicitly.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["WirePolicy", "policy_enabled", "default_policy",
+           "reset_default_policy"]
+
+
+def _env_int(name: str, dflt: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else dflt
+    except ValueError:
+        return dflt
+
+
+def _env_float(name: str, dflt: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else dflt
+    except ValueError:
+        return dflt
+
+
+def policy_enabled(environ=os.environ) -> bool:
+    return environ.get("HOROVOD_WIRE_POLICY", "") not in ("", "0")
+
+
+class _LeafStats:
+    __slots__ = ("abs_max", "rms", "steps")
+
+    def __init__(self):
+        self.abs_max = 0.0
+        self.rms = 0.0
+        self.steps = 0
+
+
+class WirePolicy:
+    """Deterministic per-leaf wire-dtype rule over rolling statistics.
+
+    ``observe_and_choose(name, arr)`` updates the leaf's rolling abs-max
+    / RMS and returns the wire dtype to stamp (``"int8"``, ``"fp32"``,
+    or ``None`` = engine default).  Decisions are pure functions of the
+    observed history — same gradients, same choices — and are meant to
+    be stamped ADVISORY (see the module docstring).
+    """
+
+    def __init__(self, *, min_elems: Optional[int] = None,
+                 ratio: Optional[float] = None,
+                 warmup: Optional[int] = None,
+                 decay: float = 0.9):
+        self.min_elems = (_env_int("HOROVOD_WIRE_POLICY_MIN_ELEMS", 65536)
+                          if min_elems is None else int(min_elems))
+        self.ratio = (_env_float("HOROVOD_WIRE_POLICY_RATIO", 64.0)
+                      if ratio is None else float(ratio))
+        self.warmup = (_env_int("HOROVOD_WIRE_POLICY_WARMUP", 3)
+                       if warmup is None else int(warmup))
+        self.decay = float(decay)
+        self._stats: Dict[str, _LeafStats] = {}
+        #: name -> last stamped wire ("int8"/"fp32"/None); observability.
+        self.decisions: Dict[str, Optional[str]] = {}
+
+    def observe_and_choose(self, name: str,
+                           arr: np.ndarray) -> Optional[str]:
+        arr = np.asarray(arr)
+        # Non-fp32 payloads never wire-compress (the engine forces fp32
+        # wire for them anyway); skip the bookkeeping too.
+        if arr.dtype != np.float32:
+            self.decisions[name] = None
+            return None
+        # Norm/bias/scalar leaves (any 0/1-D leaf) and small leaves
+        # (below min_elems, any rank): tiny and/or numerically
+        # load-bearing — pin them to the uncompressed wire regardless of
+        # the global knob.  (A live HOROVOD_WIRE_DTYPE=int8 would
+        # otherwise drag them down with everything else.)
+        if arr.ndim <= 1 or arr.size < self.min_elems:
+            self.decisions[name] = "fp32"
+            return "fp32"
+        st = self._stats.get(name)
+        if st is None:
+            st = self._stats[name] = _LeafStats()
+        a = float(np.max(np.abs(arr))) if arr.size else 0.0
+        r = float(math.sqrt(float(np.mean(np.square(arr))))) \
+            if arr.size else 0.0
+        if st.steps == 0:
+            st.abs_max, st.rms = a, r
+        else:
+            st.abs_max = self.decay * st.abs_max + (1 - self.decay) * a
+            st.rms = self.decay * st.rms + (1 - self.decay) * r
+        st.steps += 1
+        wire: Optional[str] = None
+        if (arr.ndim >= 2 and arr.size >= self.min_elems
+                and st.steps > self.warmup and st.rms > 0.0
+                and st.abs_max / st.rms <= self.ratio):
+            # Embedding/projection-shaped, statistically smooth: the
+            # per-chunk-scaled int8 wire quarters its bytes at fp32-
+            # parity convergence (gated in ci).
+            wire = "int8"
+        self.decisions[name] = wire
+        return wire
+
+    def reset(self) -> None:
+        self._stats.clear()
+        self.decisions.clear()
+
+
+_DEFAULT: Optional[WirePolicy] = None
+
+
+def default_policy() -> WirePolicy:
+    """The process-wide policy instance (env-configured)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = WirePolicy()
+    return _DEFAULT
+
+
+def reset_default_policy() -> None:
+    """Drop accumulated statistics (tests; engine restarts keep them —
+    the statistics describe the MODEL, not the world incarnation)."""
+    global _DEFAULT
+    _DEFAULT = None

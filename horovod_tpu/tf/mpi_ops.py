@@ -88,7 +88,8 @@ def _np(t: tf.Tensor) -> np.ndarray:
 # long test session (observed: `_allreduce(x, name=...)` dispatching to
 # the converted `_np`), breaking tf.function-traced training loops.
 @tf.autograph.experimental.do_not_convert
-def _allreduce(tensor, name: Optional[str] = None, parts_out=None):
+def _allreduce(tensor, name: Optional[str] = None, parts_out=None,
+               priority: Optional[int] = None):
     """Sum ``tensor`` over all processes (reference mpi_ops.py:77-90).
 
     Same shape/dtype on every rank for a given name; differentiable
@@ -99,6 +100,11 @@ def _allreduce(tensor, name: Optional[str] = None, parts_out=None):
     caller falls back to size).  Divisor-correct averaging under
     backup-worker partial commits (HOROVOD_BACKUP_WORKERS) divides by
     it instead of blindly by size.
+
+    ``priority`` (0 = most urgent) is the scheduling priority the
+    priority-banded coordinator (HOROVOD_PRIORITY_BANDS) orders
+    responses by; the grouped builder stamps it from batch position
+    (registration order).
     """
     op_name = _auto_name("allreduce", name)
     # Written by the host call, read by the participants py_function
@@ -116,7 +122,8 @@ def _allreduce(tensor, name: Optional[str] = None, parts_out=None):
             arr = _np(xt)
             info = {}
             out = eng.synchronize(
-                eng.enqueue_allreduce(arr, name=op_name), info)
+                eng.enqueue_allreduce(arr, name=op_name,
+                                      priority=priority), info)
             parts_cell[0] = int(info.get("participants") or 0)
             return out
 
@@ -171,8 +178,11 @@ def _grouped_allreduce(tensors, names, parts_out=None):
                     parts_cells[i] = 1
                 return [x.numpy() for x in xts]
             arrs = [_np(x) for x in xts]
-            handles = [eng.enqueue_allreduce(a, name=n)
-                       for a, n in zip(arrs, names)]
+            # Batch position = registration order = scheduling priority
+            # (the priority-banded coordinator dispatches the
+            # first-registered — front-layer — gradients first).
+            handles = [eng.enqueue_allreduce(a, name=n, priority=i)
+                       for i, (a, n) in enumerate(zip(arrs, names))]
             # eng.drain: every handle finishes even when one fails (an
             # abandoned handle leaks its buffer and leaves the name in
             # flight for the next step's batch).

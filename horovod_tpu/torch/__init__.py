@@ -98,6 +98,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._local_sgd = (LocalSGD(self._local_sgd_steps,
                                     compression=compression)
                            if self._local_sgd_steps > 1 else None)
+        # Statistics-driven per-leaf wire policy (HOROVOD_WIRE_POLICY=1):
+        # int8 for large embedding-shaped grads, fp32 for norm/bias
+        # leaves, stamped advisory (see runtime/wire_policy.py).
+        from horovod_tpu.runtime import wire_policy as _wp
+
+        self._wire_policy = (_wp.default_policy()
+                             if _wp.policy_enabled() else None)
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -125,6 +132,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 f"the optimizer has {len(all_params)}; provide names for all"
             )
         self._param_names = {id(v): k for k, v in named_parameters}
+        # Registration order IS the scheduling priority (0 = first
+        # registered ≈ front layer ≈ needed first by the next forward):
+        # backward produces these gradients LAST, but the priority-
+        # banded coordinator (HOROVOD_PRIORITY_BANDS) dispatches them
+        # first so step N+1's forward never waits on step N's tail.
+        self._param_priority = {
+            id(v): i for i, (_k, v) in enumerate(named_parameters)
+        }
 
         self._handles: dict = {}
         self._grad_accs = []
@@ -177,17 +192,32 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # gradient leaf, epoch-stamped in runtime.sparse).
             return ("topk", p)
         # Engine-wire compression (Compression.wire_*): the tensor stays
-        # fp32; the engine quantizes on the ring.
+        # fp32; the engine quantizes on the ring.  The statistics-driven
+        # wire policy (HOROVOD_WIRE_POLICY=1) refines the format per
+        # leaf, stamped ADVISORY so per-rank statistics cannot split
+        # negotiation.
         wire = getattr(self._compression, "engine_wire_dtype", None)
+        advisory = False
+        if self._wire_policy is not None and name is not None and \
+                p.grad.is_floating_point() and not p.grad.is_sparse:
+            chosen = self._wire_policy.observe_and_choose(
+                name, p.grad.detach().cpu().numpy())
+            if chosen is not None:
+                wire = chosen
+                advisory = True
         tensor_compressed, ctx = self._compression.compress(p.grad.data)
+        priority = self._param_priority.get(id(p))
         if tensor_compressed.data_ptr() == p.grad.data.data_ptr():
             # In-place reduce directly into .grad when uncompressed.
             handle = allreduce_async_(tensor_compressed, average=True,
-                                      name=name, wire_dtype=wire)
+                                      name=name, wire_dtype=wire,
+                                      priority=priority,
+                                      wire_advisory=advisory)
         else:
             handle = allreduce_async_(
                 tensor_compressed.contiguous(), average=True, name=name,
-                wire_dtype=wire)
+                wire_dtype=wire, priority=priority,
+                wire_advisory=advisory)
         return handle, tensor_compressed, ctx
 
     def _sparse_allgather_async(self, p, name):
@@ -391,10 +421,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
 class _ShardedOptimizer:
     """ZeRO-1 sharded optimizer (``DistributedOptimizer(sharded=True)``).
 
-    Flattens the (single) param group into one fp32 master vector, keeps
-    THIS rank's shard of it (and an inner optimizer instance of the
-    user's class over just that shard — ~1/N of the optimizer-state and
-    master-weight memory), and steps via the engine's collective halves:
+    Flattens EACH param group into its own fp32 master vector, keeps
+    THIS rank's shard of each (and ONE inner optimizer instance of the
+    user's class spanning the master shards, one inner group per user
+    group — ~1/N of the optimizer-state and master-weight memory), and
+    steps via the engine's collective halves per group:
 
         reducescatter(flat fp32 grads)   # half an allreduce's bytes
         inner.step() on the owned shard  # elementwise optimizer math
@@ -422,12 +453,6 @@ class _ShardedOptimizer:
 
         from horovod_tpu.runtime.sharded import FlatSharder
 
-        if len(optimizer.param_groups) != 1:
-            raise ValueError(
-                "sharded=True supports a single param group (shards are "
-                "slices of ONE flat vector; per-group hyperparameters "
-                "would cross shard boundaries) — merge groups or keep "
-                "the unsharded optimizer")
         wire = getattr(compression, "engine_wire_dtype", None)
         self._wire = wire if wire in ("fp16", "bf16", "int8", "fp8") \
             else None
@@ -437,32 +462,59 @@ class _ShardedOptimizer:
                 "sharded=True reduces gradients with reducescatter; the "
                 "top-k sparse path has no scatter half — use a wire "
                 "compressor (Compression.wire_bf16 etc.) instead")
-        self._params = list(optimizer.param_groups[0]["params"])
-        self._shapes = [tuple(p.shape) for p in self._params]
-        self._numels = [p.numel() for p in self._params]
-        n = int(sum(self._numels))
-        self._sharder = FlatSharder(n, np.float32, name="zero.torch")
-        # fp32 master shard: the ONLY full-precision copy of this slice
-        # in the world (ZeRO's master-weight sharding).
-        with torch.no_grad():
-            flat = torch.cat([
-                p.detach().to(torch.float32).reshape(-1)
-                for p in self._params
-            ]) if self._params else torch.zeros(0)
-            self._master = flat[
-                self._sharder.offset:
-                self._sharder.offset + self._sharder.count].clone()
-        defaults = {k: v for k, v in optimizer.param_groups[0].items()
-                    if k != "params"}
-        self._shard_opt = type(optimizer)([self._master], **defaults)
+        # Each param group shards INDEPENDENTLY: its own flat vector,
+        # its own FlatSharder (distinct collective names by construction
+        # order), its own fp32 master shard — so per-group
+        # hyperparameters (lr, weight decay, momentum) never cross a
+        # shard boundary, and LR schedulers keep their per-group
+        # semantics on shard_optimizer.param_groups.
+        self._groups = []
+        shard_groups = []
+        for gi, group in enumerate(optimizer.param_groups):
+            params = list(group["params"])
+            numels = [p.numel() for p in params]
+            n = int(sum(numels))
+            sharder = FlatSharder(n, np.float32,
+                                  name=f"zero.torch.g{gi}")
+            # fp32 master shard: the ONLY full-precision copy of this
+            # slice in the world (ZeRO's master-weight sharding).
+            with torch.no_grad():
+                flat = torch.cat([
+                    p.detach().to(torch.float32).reshape(-1)
+                    for p in params
+                ]) if params else torch.zeros(0)
+                master = flat[
+                    sharder.offset:
+                    sharder.offset + sharder.count].clone()
+            self._groups.append({
+                "params": params,
+                "shapes": [tuple(p.shape) for p in params],
+                "numels": numels,
+                "sharder": sharder,
+                "master": master,
+            })
+            defaults = {k: v for k, v in group.items() if k != "params"}
+            shard_groups.append({**defaults, "params": [master]})
+        # ONE inner optimizer instance spanning every group's master
+        # shard: torch optimizers accept per-group dicts, so group
+        # hyperparameters ride through unchanged and one .step() covers
+        # the whole model.
+        self._shard_opt = type(optimizer)(shard_groups)
         #: The shard optimizer's groups — LR schedulers mutate the
-        #: hyperparameters that actually drive the update.
+        #: hyperparameters that actually drive the update (one group
+        #: here per user group, same order).
         self.param_groups = self._shard_opt.param_groups
 
     @property
     def sharder(self):
-        """The flat partitioner (shard offset/count, world anchor)."""
-        return self._sharder
+        """Group 0's flat partitioner (shard offset/count, world anchor)
+        — kept for back-compat; per-group access via :attr:`sharders`."""
+        return self._groups[0]["sharder"] if self._groups else None
+
+    @property
+    def sharders(self):
+        """Every group's flat partitioner, in group order."""
+        return [g["sharder"] for g in self._groups]
 
     @property
     def shard_optimizer(self):
@@ -475,7 +527,9 @@ class _ShardedOptimizer:
     def state_bytes(self) -> int:
         """Bytes of per-rank optimizer state + master weights (the ~1/N
         memory claim, measured: tests assert it)."""
-        total = self._master.numel() * self._master.element_size()
+        total = 0
+        for g in self._groups:
+            total += g["master"].numel() * g["master"].element_size()
         for st in self._shard_opt.state.values():
             for v in st.values():
                 if torch.is_tensor(v):
@@ -483,15 +537,18 @@ class _ShardedOptimizer:
         return total
 
     def zero_grad(self, set_to_none: bool = True):
-        for p in self._params:
-            if set_to_none:
-                p.grad = None
-            elif p.grad is not None:
-                p.grad.detach_()
-                p.grad.zero_()
+        for g in self._groups:
+            for p in g["params"]:
+                if set_to_none:
+                    p.grad = None
+                elif p.grad is not None:
+                    p.grad.detach_()
+                    p.grad.zero_()
 
     def step(self, closure=None):
         import numpy as np
+
+        from horovod_tpu.runtime.engine import note_sharded_step
 
         loss = closure() if closure is not None else None
 
@@ -504,63 +561,90 @@ class _ShardedOptimizer:
             return np.ascontiguousarray(
                 g.detach().to(torch.float32).reshape(-1).numpy())
 
-        flat_g = np.concatenate([
-            flat_grad(p, numel)
-            for p, numel in zip(self._params, self._numels)
-        ]) if self._params else np.zeros(0, dtype=np.float32)
-
-        def local_update(shard_g):
-            self._master.grad = torch.from_numpy(
+        # Phase 1: every group's gradient reduce-scatter lands on its
+        # master shard's .grad — all reductions complete before any
+        # update, so ONE inner .step() then covers every group (torch
+        # optimizers skip grad-less params, but here none are).
+        for g in self._groups:
+            flat_g = np.concatenate([
+                flat_grad(p, numel)
+                for p, numel in zip(g["params"], g["numels"])
+            ]) if g["params"] else np.zeros(0, dtype=np.float32)
+            shard_g = g["sharder"].reduce_grads(
+                flat_g, average=True, wire_dtype=self._wire)
+            g["master"].grad = torch.from_numpy(
                 np.ascontiguousarray(shard_g))
-            self._shard_opt.step()
-            self._master.grad = None
-            # Ship the UPDATED master shard itself (not a delta): the
-            # allgather is lossless, so every rank reconstructs the
-            # identical new flat master.
-            return self._master.detach().numpy()
-
-        full = self._sharder.step(flat_g, local_update, average=True,
-                                  wire_dtype=self._wire)
-        with torch.no_grad():
-            off = 0
-            for p, numel, shape in zip(self._params, self._numels,
-                                       self._shapes):
-                chunk = torch.from_numpy(
-                    np.ascontiguousarray(full[off:off + numel]))
-                p.data.copy_(chunk.reshape(shape).to(p.dtype))
-                off += numel
+        self._shard_opt.step()
+        # Phase 2: ship each group's UPDATED master shard (not a delta —
+        # the allgather is lossless, so every rank reconstructs the
+        # identical new flat master) and copy it back into the params.
+        for g in self._groups:
+            g["master"].grad = None
+            full = g["sharder"].gather_updates(
+                g["master"].detach().numpy())
+            with torch.no_grad():
+                off = 0
+                for p, numel, shape in zip(g["params"], g["numels"],
+                                           g["shapes"]):
+                    chunk = torch.from_numpy(
+                        np.ascontiguousarray(full[off:off + numel]))
+                    p.data.copy_(chunk.reshape(shape).to(p.dtype))
+                    off += numel
+        note_sharded_step()
         return loss
 
     def state_dict(self):
-        """Shard-LOCAL state (each rank saves its own shard — see
-        docs/checkpointing.md for the sharded save/restore recipe)."""
+        """Shard-LOCAL state (each rank saves its own shards — see
+        docs/checkpointing.md for the sharded save/restore recipe).
+        Per-group geometry rides along so a reload at a different world
+        size / group layout fails loudly."""
         return {
             "shard_opt": self._shard_opt.state_dict(),
-            "master": self._master.detach().cpu(),
-            "shard": {"offset": self._sharder.offset,
-                      "count": self._sharder.count,
-                      "n": self._sharder.n,
-                      "size": self._sharder.size},
+            "groups": [
+                {
+                    "master": g["master"].detach().cpu(),
+                    "shard": {"offset": g["sharder"].offset,
+                              "count": g["sharder"].count,
+                              "n": g["sharder"].n,
+                              "size": g["sharder"].size},
+                }
+                for g in self._groups
+            ],
         }
 
     def load_state_dict(self, sd):
         from horovod_tpu.runtime.sharded import ShardResizeError
 
-        meta = sd.get("shard", {})
-        if (meta.get("n") != self._sharder.n or
-                meta.get("size") != self._sharder.size or
-                meta.get("offset") != self._sharder.offset):
+        # PR 12's single-group format carried top-level master/shard;
+        # accept it for a single-group optimizer.
+        groups_sd = sd.get("groups")
+        if groups_sd is None and "master" in sd:
+            groups_sd = [{"master": sd["master"],
+                          "shard": sd.get("shard", {})}]
+        if groups_sd is None or len(groups_sd) != len(self._groups):
             raise ShardResizeError(
-                "sharded checkpoint was written for shard "
-                f"{meta.get('offset')}+{meta.get('count')} of "
-                f"{meta.get('n')} at world size {meta.get('size')}, but "
-                f"this optimizer owns {self._sharder.offset}+"
-                f"{self._sharder.count} of {self._sharder.n} at size "
-                f"{self._sharder.size}; restore at the original world "
-                "size or rebuild from a full checkpoint (docs/zero.md)")
+                "sharded checkpoint holds "
+                f"{0 if groups_sd is None else len(groups_sd)} param "
+                f"group(s) but this optimizer has {len(self._groups)}; "
+                "the group layout must match the checkpoint's "
+                "(docs/zero.md)")
+        for gi, (g, gsd) in enumerate(zip(self._groups, groups_sd)):
+            meta = gsd.get("shard", {})
+            sh = g["sharder"]
+            if (meta.get("n") != sh.n or meta.get("size") != sh.size or
+                    meta.get("offset") != sh.offset):
+                raise ShardResizeError(
+                    f"sharded checkpoint group {gi} was written for "
+                    f"shard {meta.get('offset')}+{meta.get('count')} of "
+                    f"{meta.get('n')} at world size {meta.get('size')}, "
+                    f"but this optimizer owns {sh.offset}+{sh.count} of "
+                    f"{sh.n} at size {sh.size}; restore at the original "
+                    "world size or rebuild from a full checkpoint "
+                    "(docs/zero.md)")
         self._shard_opt.load_state_dict(sd["shard_opt"])
         with torch.no_grad():
-            self._master.copy_(sd["master"].to(torch.float32))
+            for g, gsd in zip(self._groups, groups_sd):
+                g["master"].copy_(gsd["master"].to(torch.float32))
 
 
 def DistributedOptimizer(optimizer, named_parameters=None,

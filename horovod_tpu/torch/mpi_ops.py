@@ -124,15 +124,25 @@ def _div_in_place(t: torch.Tensor, n: int) -> torch.Tensor:
 
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
                      name: Optional[str] = None,
-                     wire_dtype: Optional[str] = None) -> int:
+                     wire_dtype: Optional[str] = None,
+                     priority: Optional[int] = None,
+                     wire_advisory: bool = False) -> int:
     """In-place async sum/average over all processes.  ``wire_dtype``
     (fp32/fp16/bf16/int8/fp8) overrides the engine's HOROVOD_WIRE_DTYPE
-    wire format for this tensor (fp32 payloads only)."""
+    wire format for this tensor (fp32 payloads only;
+    ``wire_advisory=True`` lets the coordinator commit the first value
+    on a cross-rank disagreement — the gradient-statistics wire policy's
+    contract).  ``priority`` (0 = most urgent) is the scheduling
+    priority the priority-banded coordinator (HOROVOD_PRIORITY_BANDS)
+    orders responses by — the DistributedOptimizer stamps it from
+    parameter registration order."""
     eng = _engine()
     if eng is None:
         return _local_handle(tensor)  # sum over 1 rank = identity
     view = _np_view(tensor)
-    handle = eng.enqueue_allreduce(view, name, wire_dtype=wire_dtype)
+    handle = eng.enqueue_allreduce(view, name, wire_dtype=wire_dtype,
+                                   priority=priority,
+                                   wire_advisory=wire_advisory)
 
     def post(t, _out, info=None):
         if not average:

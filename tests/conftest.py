@@ -62,6 +62,12 @@ def pytest_configure(config):
         "linkheal: link self-healing tests (transparent data-channel "
         "reconnect under injected conn-reset/recv-stall faults); ci.sh "
         "runs them in the link-heal gate under a hard timeout")
+    config.addinivalue_line(
+        "markers",
+        "priority: priority-scheduled communication tests "
+        "(HOROVOD_PRIORITY_BANDS ordering/fusion/wave contracts); ci.sh "
+        "runs them in the overlap gate under a hard timeout (main sweep "
+        "excludes the marker, tier-1 still runs them)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,214 @@
+"""Worker body for the priority-scheduling multi-process tests.
+
+Backprop-overlapped, priority-scheduled communication
+(``HOROVOD_PRIORITY_BANDS``): frontends stamp per-tensor priorities from
+registration order, the coordinator orders each cycle's responses by
+(priority, name) instead of arrival order, fusion only merges within a
+band, and the wave scheduler dispatches waves in band order.  The
+deterministic instrument is the ``priority_inversions`` counter — a
+committed response dispatched after a LESS-urgent response of the same
+cycle — which must read 0 with bands on.
+
+Run as ``python priority_worker.py <scenario>`` with identity in
+HOROVOD_RANK/HOROVOD_SIZE/HOROVOD_COORDINATOR (see test_priority.py).
+Deliberately jax-free, like native_worker.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import (  # noqa: E402
+    HorovodInternalError,
+    get_engine,
+)
+
+
+def _burst(eng, step, nt=8, reverse=True, prefix="pr", elems=256):
+    """Enqueue nt distinct-name fp32 tensors whose PRIORITY runs
+    OPPOSITE to the enqueue order when ``reverse`` (the backprop shape:
+    the most urgent — front-layer — gradient materializes last), then
+    drain.  Returns the outputs in priority order (0 first)."""
+    handles = []
+    for j in range(nt):
+        prio = (nt - 1 - j) if reverse else j
+        x = np.full((elems + prio,), float(basics.rank() + 1 + prio),
+                    dtype=np.float32)
+        handles.append((prio, eng.enqueue_allreduce(
+            x, name=f"{prefix}.{step}.p{prio}", priority=prio)))
+    outs = [None] * nt
+    infos = [None] * nt
+    for prio, h in handles:
+        info = {}
+        outs[prio] = eng.synchronize(h, info)
+        infos[prio] = info
+    return outs
+
+
+def scenario_inversions_zero(rank, size, eng):
+    # Bands ON: reverse-priority bursts over many steps must dispatch
+    # with ZERO inversions — the committed (priority, name) ordering at
+    # the coordinator plus the band-ordered waves — and the values stay
+    # exact.
+    assert eng.stats()["config"]["priority_bands"] == 1, \
+        eng.stats()["config"]
+    steps = 10
+    for s in range(steps):
+        outs = _burst(eng, s, reverse=True)
+        for prio, out in enumerate(outs):
+            expect = sum(r + 1 + prio for r in range(size))
+            assert np.array_equal(
+                out, np.full_like(out, np.float32(expect))), (s, prio)
+    st = eng.stats()
+    assert st["priority_inversions"] == 0, st["priority_inversions"]
+    print(f"INVERSIONS_ZERO_OK rank={rank}", flush=True)
+
+
+def scenario_inversions_observed(rank, size, eng):
+    # Bands OFF with HOROVOD_PRIORITY_STAMP=1 (the instrumentation
+    # escape hatch): the legacy arrival ordering dispatches the urgent
+    # tensors late, and the counter OBSERVES it — the motivation metric
+    # the bench reports.  Fusion is disabled so each tensor is its own
+    # response (a fused batch is one dispatch, hence no inversion).
+    assert eng.stats()["config"]["priority_bands"] == 0, \
+        eng.stats()["config"]
+    for s in range(10):
+        _burst(eng, s, reverse=True)
+    st = eng.stats()
+    assert st["priority_inversions"] > 0, (
+        "legacy arrival ordering never inverted a reverse-priority "
+        "burst — the counter is not observing", st["priority_inversions"])
+    print(f"INVERSIONS_OBSERVED_OK rank={rank} "
+          f"inv={st['priority_inversions']}", flush=True)
+
+
+def scenario_bands_parity(rank, size, eng):
+    # Ordering is VALUE-NEUTRAL: the same deterministic per-rank corpus
+    # run under bands=1 and bands=0 (shutdown + re-init, the
+    # channels_parity idiom) must produce BITWISE identical results —
+    # scheduling changes when things run, never what they compute.
+    def corpus(tag):
+        rng = np.random.default_rng(17 + rank)
+        outs = []
+        for s in range(3):
+            handles = []
+            for j in range(6):
+                x = rng.standard_normal(97 + 31 * j).astype(np.float32)
+                handles.append(eng.enqueue_allreduce(
+                    x, name=f"bp.{tag}.{s}.{j}", priority=5 - j))
+            outs.extend(eng.synchronize(h) for h in handles)
+            # A couple of non-allreduce ops ride along (never banded
+            # into fusions, ordering still deterministic).
+            outs.append(eng.allgather(
+                np.full((rank + 1, 2), float(rank), np.float32),
+                name=f"bp.{tag}.{s}.ag"))
+        return outs
+
+    assert eng.stats()["config"]["priority_bands"] == 1
+    on = corpus("on")
+    inv_on = eng.stats()["priority_inversions"]
+    assert inv_on == 0, inv_on
+    basics.shutdown()
+    os.environ["HOROVOD_PRIORITY_BANDS"] = "0"
+    basics.init()
+    assert eng.stats()["config"]["priority_bands"] == 0
+    off = corpus("off")
+    for i, (a, b) in enumerate(zip(on, off)):
+        assert a.dtype == b.dtype and a.shape == b.shape, (i, a.shape)
+        assert a.tobytes() == b.tobytes(), (
+            f"case {i}: bands=1 differs from bands=0")
+    print(f"BANDS_PARITY_OK rank={rank}", flush=True)
+
+
+def scenario_cached_order(rank, size, eng):
+    # Cached-path order preservation: a steady-state loop (same names
+    # every step → cache slots) must keep inversions at 0 with bands on,
+    # stay bitwise DETERMINISTIC across same-world re-runs of the same
+    # inputs, and actually ride the cache (hit rate).
+    steps = 20
+    runs = []
+    for repeat in range(2):
+        outs = []
+        for s in range(steps):
+            handles = []
+            for j in range(5):
+                prio = 4 - j
+                x = np.full((128,), float((rank + 1) * (j + 1)),
+                            dtype=np.float32)
+                handles.append(eng.enqueue_allreduce(
+                    x, name=f"co.p{prio}", priority=prio))
+            outs.extend(eng.synchronize(h) for h in handles)
+        runs.append(outs)
+    for i, (a, b) in enumerate(zip(*runs)):
+        assert a.tobytes() == b.tobytes(), f"rerun diverged at {i}"
+    st = eng.stats()
+    assert st["priority_inversions"] == 0, st["priority_inversions"]
+    assert st["cache_hits"] >= (2 * steps - 4) * 5 * 0.8, st["cache_hits"]
+    print(f"CACHED_ORDER_OK rank={rank} hits={st['cache_hits']}",
+          flush=True)
+
+
+def scenario_priority_mismatch(rank, size, eng):
+    # Ranks disagreeing on a tensor's stamped priority must get the
+    # clean negotiated error naming the values — never a silent
+    # dispatch-order split.
+    try:
+        eng.allreduce(np.zeros(8, np.float32), name="bad_prio",
+                      priority=3 if rank == 0 else 7)
+        if size == 1:
+            return
+    except HorovodInternalError as e:
+        assert "Mismatched priorities" in str(e), str(e)
+        return
+    raise AssertionError("expected HorovodInternalError")
+
+
+def scenario_band_fusion(rank, size, eng):
+    # Fusion only merges within a band: 6 same-dtype tensors in 3 bands
+    # (width 2) fuse into >= 3 responses, never one — observed via the
+    # responses counter (tensors/responses < 6/1) — and values hold.
+    st0 = eng.stats()
+    handles = []
+    for j in range(6):
+        x = np.full((64,), float(rank + 1 + j), dtype=np.float32)
+        handles.append(eng.enqueue_allreduce(
+            x, name=f"bf.{j}", priority=j))
+    for j, h in enumerate(handles):
+        out = eng.synchronize(h)
+        expect = sum(r + 1 + j for r in range(size))
+        assert np.array_equal(out, np.full((64,), np.float32(expect))), j
+    d = eng.stats_delta(st0)
+    # Band width 2 ⇒ priorities {0,1},{2,3},{4,5} ⇒ at least 3 fused
+    # responses (cycle splits can only increase the count).
+    assert d["responses"] >= 3, d["responses"]
+    assert d["tensors"] == 6, d["tensors"]
+    assert eng.stats()["priority_inversions"] == 0
+    print(f"BAND_FUSION_OK rank={rank} responses={d['responses']}",
+          flush=True)
+
+
+SCENARIOS = {
+    "inversions_zero": scenario_inversions_zero,
+    "inversions_observed": scenario_inversions_observed,
+    "bands_parity": scenario_bands_parity,
+    "cached_order": scenario_cached_order,
+    "priority_mismatch": scenario_priority_mismatch,
+    "band_fusion": scenario_band_fusion,
+}
+
+
+def main():
+    scenario = sys.argv[1] if len(sys.argv) > 1 else "inversions_zero"
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    SCENARIOS[scenario](rank, size, eng)
+    basics.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -215,6 +215,102 @@ def scenario_backup_auto_arms(rank, size, eng):
     print(f"BACKUP_AUTO_ARMS_OK rank={rank} skips={skips}", flush=True)
 
 
+def scenario_backup_rs(rank, size, eng):
+    # Backup-worker PARTIAL COMMIT of a SUM reducescatter (the PR 12
+    # follow-on): k=1 with a permanently slow last rank — every step
+    # commits without it.  Each rank contributes 2**rank, so the reduced
+    # shard VALUE is a participant bitmask: fast ranks must see exactly
+    # (2**size - 1) - 2**straggler (the ghost's zero buffer contributed
+    # nothing), the straggler gets the clean StepSkipped status, and the
+    # participants divisor rides the handle like the allreduce's.
+    import time
+
+    from horovod_tpu.runtime.engine import StepSkipped
+
+    straggler = size - 1
+    rows = size + 1  # uneven shards: rank 0 owns 2 rows
+    expect_mask = float(2 ** size - 1 - 2 ** straggler)
+    steps = 4
+    skipped = 0
+    for s in range(steps):
+        x = np.full((rows, 3), float(2 ** rank), dtype=np.float32)
+        info = {}
+        try:
+            out = eng.synchronize(
+                eng.enqueue_reducescatter(x, name=f"brs.{s}"), info)
+        except StepSkipped:
+            skipped += 1
+            assert rank == straggler, (rank, s)
+            continue
+        assert rank != straggler, f"straggler joined step {s}"
+        assert info.get("participants") == size - 1, info
+        my_rows = rows // size + (1 if rank < rows % size else 0)
+        assert out.shape == (my_rows, 3), out.shape
+        assert np.array_equal(
+            out, np.full((my_rows, 3), np.float32(expect_mask))), (
+            s, out.ravel()[:2], expect_mask)
+    st = eng.stats()
+    if rank == straggler:
+        assert skipped == steps, (skipped, steps)
+        assert st["backup_skips"] == steps, st["backup_skips"]
+    else:
+        assert skipped == 0 and st["backup_skips"] == 0, st["backup_skips"]
+    # MAX allreduce = full-world barrier even under k>0: drains the
+    # straggler's banked skip tokens before shutdown.
+    time.sleep(0.1)
+    out = eng.allreduce(np.full((4,), float(rank + 1), np.float32),
+                        red_op="max", name="brs.done")
+    assert np.array_equal(out, np.full((4,), np.float32(size))), out[0]
+    print(f"BACKUP_RS_OK rank={rank} skipped={skipped}", flush=True)
+
+
+def scenario_backup_rs_cached(rank, size, eng):
+    # Partial RS commit on the CACHED negotiation path: warm the slot
+    # with full steps, make the last rank slow for exactly one step
+    # (one-shot slow fault), and verify the partial_slots commit replays
+    # the replica with the participant bitmask — then full strength
+    # returns.
+    import time
+
+    from horovod_tpu.runtime.engine import StepSkipped
+
+    straggler = size - 1
+    rows = size * 2
+    full_mask = float(2 ** size - 1)
+    part_mask = full_mask - 2 ** straggler
+    slow_step = 6
+    steps = 12
+    partials, skipped = [], 0
+    for s in range(steps):
+        x = np.full((rows, 2), float(2 ** rank), dtype=np.float32)
+        info = {}
+        try:
+            out = eng.synchronize(
+                eng.enqueue_reducescatter(x, name="brsc"), info)
+        except StepSkipped:
+            skipped += 1
+            assert rank == straggler and s == slow_step, (rank, s)
+            continue
+        n = info.get("participants") or size
+        if n < size:
+            partials.append(s)
+            assert np.array_equal(
+                out, np.full((2, 2), np.float32(part_mask))), (s, out)
+            time.sleep(0.8)  # let the one-shot straggler catch up
+        else:
+            assert np.array_equal(
+                out, np.full((2, 2), np.float32(full_mask))), (s, out)
+    st = eng.stats()
+    if rank == straggler:
+        assert skipped == 1 and st["backup_skips"] == 1, (
+            skipped, st["backup_skips"])
+    else:
+        assert partials == [slow_step], partials
+    # The steady state really rode the cached path.
+    assert st["cache_hits"] >= steps - 3, st["cache_hits"]
+    print(f"BACKUP_RS_CACHED_OK rank={rank}", flush=True)
+
+
 SCENARIOS = {
     "parity": scenario_parity,
     "cached": scenario_cached,
@@ -222,6 +318,8 @@ SCENARIOS = {
     "bytes": scenario_bytes,
     "backup_auto": scenario_backup_auto,
     "backup_auto_arms": scenario_backup_auto_arms,
+    "backup_rs": scenario_backup_rs,
+    "backup_rs_cached": scenario_backup_rs_cached,
 }
 
 

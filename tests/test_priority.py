@@ -1,0 +1,145 @@
+"""Backprop-overlapped, priority-scheduled communication
+(``HOROVOD_PRIORITY_BANDS`` + per-tensor priorities).
+
+The contract, judged like every prior scheduling PR on deterministic
+counters and bitwise equalities — never wall time:
+
+* bands=0 (the default) is BIT-IDENTICAL to the pre-priority engine:
+  the full dtype/op parity corpus at 2 AND 4 ranks over shm and TCP
+  (the existing channel/shm/wire parity suites run the same unchanged
+  protocol; the dedicated scenario here additionally proves bands=1
+  itself never changes a value);
+* with bands on, reverse-priority bursts (the backprop shape) dispatch
+  with priority_inversions == 0, same-world re-runs are bitwise
+  deterministic, and the cached negotiation path preserves the order;
+* fusion only merges within a band;
+* a cross-rank priority disagreement is a clean negotiated error.
+"""
+
+import os
+
+import pytest
+
+from tests.test_native_engine import run_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PRIO_WORKER = os.path.join(REPO, "tests", "priority_worker.py")
+
+pytestmark = pytest.mark.priority
+
+#: Fusion off so each tensor is its own response — a fused batch is ONE
+#: dispatch, which would hide the ordering under test.
+_NOFUSE = {"HOROVOD_FUSION_THRESHOLD": "0"}
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_priority_inversions_zero_with_bands(n):
+    """Reverse-priority bursts at 2 AND 4 ranks: the committed
+    (priority, name) ordering + band-ordered waves must dispatch with
+    ZERO inversions, exact values."""
+    run_workers(n, "inversions_zero", timeout=180, worker=PRIO_WORKER,
+                extra_env={"HOROVOD_PRIORITY_BANDS": "1", **_NOFUSE})
+
+
+def test_priority_inversions_observed_with_bands_off():
+    """The counter is a real instrument: under the legacy arrival
+    ordering (bands=0, stamping forced on) the same bursts DO invert."""
+    run_workers(2, "inversions_observed", timeout=120, worker=PRIO_WORKER,
+                extra_env={"HOROVOD_PRIORITY_STAMP": "1", **_NOFUSE})
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_bands_parity_shm(n):
+    """bands=1 vs bands=0 over the default (shm) plane: scheduling
+    changes WHEN responses dispatch, never what they compute — bitwise.
+    Fusion pinned off: banding deliberately changes fusion GROUPING, and
+    a fused buffer's ring segmentation is a different (deterministic)
+    fp reduction order — grouping, not ordering, is the only value
+    seam, so parity is judged with grouping held fixed."""
+    run_workers(n, "bands_parity", timeout=240, worker=PRIO_WORKER,
+                extra_env={"HOROVOD_PRIORITY_BANDS": "1", **_NOFUSE})
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_bands_parity_tcp(n):
+    """The same corpus forced onto pure TCP (HOROVOD_SHM_DISABLE=1) with
+    a multi-channel fan-out: band-split waves must pair channels
+    identically on every rank."""
+    run_workers(n, "bands_parity", timeout=240, worker=PRIO_WORKER,
+                extra_env={"HOROVOD_PRIORITY_BANDS": "1",
+                           "HOROVOD_SHM_DISABLE": "1",
+                           "HOROVOD_NUM_CHANNELS": "3", **_NOFUSE})
+
+
+def test_cached_path_preserves_order():
+    """Steady-state cached negotiation under bands: inversions stay 0,
+    same-world re-runs are bitwise deterministic, hit rate holds."""
+    run_workers(2, "cached_order", timeout=180, worker=PRIO_WORKER,
+                extra_env={"HOROVOD_PRIORITY_BANDS": "1", **_NOFUSE})
+
+
+def test_priority_mismatch_negotiated_error():
+    """Ranks stamping different priorities for one tensor fail with the
+    clean 'Mismatched priorities' error naming both values."""
+    run_workers(2, "priority_mismatch", timeout=120, worker=PRIO_WORKER,
+                extra_env={"HOROVOD_PRIORITY_BANDS": "1"})
+
+
+def test_fusion_respects_band_boundaries():
+    """Width-2 bands split 6 fusable tensors into >= 3 responses."""
+    run_workers(2, "band_fusion", timeout=120, worker=PRIO_WORKER,
+                extra_env={"HOROVOD_PRIORITY_BANDS": "2"})
+
+
+# ---------------------------------------------------------------------------
+# Wire-policy unit rules (single-process; the multi-rank bytes +
+# convergence contract runs in bench --overlap-gate / ci)
+# ---------------------------------------------------------------------------
+
+def test_wire_policy_rules_deterministic():
+    import numpy as np
+
+    from horovod_tpu.runtime.wire_policy import WirePolicy
+
+    pol = WirePolicy(min_elems=1024, ratio=64.0, warmup=2)
+    rng = np.random.default_rng(0)
+    embed = rng.standard_normal((64, 32)).astype(np.float32)  # 2048 elems
+    bias = rng.standard_normal(16).astype(np.float32)
+    # Bias/norm leaves pin to fp32 immediately.
+    assert pol.observe_and_choose("b", bias) == "fp32"
+    # The big smooth leaf compresses only after the warmup.
+    assert pol.observe_and_choose("w", embed) is None
+    assert pol.observe_and_choose("w", embed) is None
+    assert pol.observe_and_choose("w", embed) == "int8"
+    # Deterministic: a fresh policy over the same history decides the
+    # same way.
+    pol2 = WirePolicy(min_elems=1024, ratio=64.0, warmup=2)
+    seq = [pol2.observe_and_choose("w", embed) for _ in range(3)]
+    assert seq == [None, None, "int8"]
+
+
+def test_wire_policy_spiky_leaf_stays_fp32():
+    """A rare-huge-outlier gradient (abs-max >> rms) must never take the
+    int8 wire: per-chunk scales would quantize the body to zero."""
+    import numpy as np
+
+    from horovod_tpu.runtime.wire_policy import WirePolicy
+
+    # A single spike's abs-max/rms saturates at sqrt(N), so the leaf
+    # must be big enough that sqrt(N) clears the ratio threshold.
+    pol = WirePolicy(min_elems=1024, ratio=64.0, warmup=1)
+    spiky = np.full((128, 128), 1e-6, dtype=np.float32)  # sqrt(N) = 128
+    spiky[0, 0] = 100.0
+    for _ in range(5):
+        wire = pol.observe_and_choose("s", spiky)
+    assert wire is None, pol.decisions
+
+
+def test_wire_policy_non_fp32_passthrough():
+    import numpy as np
+
+    from horovod_tpu.runtime.wire_policy import WirePolicy
+
+    pol = WirePolicy(min_elems=4, warmup=0)
+    assert pol.observe_and_choose(
+        "i", np.ones((8, 8), np.int32)) is None

@@ -155,6 +155,34 @@ def test_backup_auto_arms_under_straggler():
 
 
 # ---------------------------------------------------------------------------
+# Backup-worker PARTIAL COMMITS for reduce-scatter (PR 12 follow-on)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.straggler
+def test_backup_rs_partial_commit_skips_straggler():
+    """k=1 with a permanently slow last rank: SUM reducescatters commit
+    without it — fast ranks see exactly the participant bitmask (the
+    ghost's zero buffer contributes nothing), the straggler gets the
+    clean StepSkipped status, and the participants divisor rides the
+    handle like the allreduce's."""
+    run_workers(4, "backup_rs", timeout=180, worker=RS_WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1",
+                           "HOROVOD_BACKUP_GRACE_MS": "50",
+                           "HOROVOD_FAULT_INJECT": "3:*:slow:600"})
+
+
+@pytest.mark.straggler
+def test_backup_rs_partial_commit_on_cached_path():
+    """Partial RS commit via ResponseList.partial_slots: the replica
+    replay grafts the participant bitmask, the skipped rank ghost-rides
+    the full-world cascade, and the cache keeps its hit rate after."""
+    run_workers(4, "backup_rs_cached", timeout=240, worker=RS_WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1",
+                           "HOROVOD_BACKUP_GRACE_MS": "50",
+                           "HOROVOD_FAULT_INJECT": "3:6:slow:600"})
+
+
+# ---------------------------------------------------------------------------
 # Single-process semantics (tier-1, no subprocesses)
 # ---------------------------------------------------------------------------
 
@@ -259,14 +287,72 @@ def test_torch_sharded_lr_scheduler_via_shard_optimizer():
     assert opt.param_groups[0]["lr"] == pytest.approx(0.05)
 
 
-def test_torch_sharded_requires_single_param_group():
+def test_torch_sharded_multi_param_groups():
+    """Each param group shards INDEPENDENTLY (its own flat vector +
+    master shard) and keeps its own hyperparameters: the sharded step
+    must equal the unsharded step per group at size 1."""
+    import numpy as np
     import torch
 
     import horovod_tpu.torch as hvd
 
-    w = torch.nn.Parameter(torch.zeros(4))
-    b = torch.nn.Parameter(torch.zeros(2))
+    torch.manual_seed(0)
+    w = torch.nn.Parameter(torch.randn(8, 3))
+    b = torch.nn.Parameter(torch.randn(5))
     base = torch.optim.SGD([{"params": [w]},
                             {"params": [b], "lr": 0.5}], lr=0.1)
-    with pytest.raises(ValueError, match="single param group"):
-        hvd.DistributedOptimizer(base, sharded=True)
+    opt = hvd.DistributedOptimizer(base, sharded=True)
+    assert len(opt.param_groups) == 2
+    assert opt.param_groups[1]["lr"] == pytest.approx(0.5)
+    w0, b0 = w.detach().clone(), b.detach().clone()
+    w.grad = torch.ones_like(w)
+    b.grad = torch.ones_like(b)
+    opt.step()
+    # Per-group lr applied: group 0 moved by 0.1, group 1 by 0.5.
+    assert np.allclose(w.detach().numpy(), (w0 - 0.1).numpy(), atol=1e-7)
+    assert np.allclose(b.detach().numpy(), (b0 - 0.5).numpy(), atol=1e-7)
+
+
+def test_torch_sharded_multi_group_state_dict_roundtrip():
+    """state_dict round-trips the per-group shard geometry; a layout
+    mismatch raises ShardResizeError instead of corrupting the state."""
+    import torch
+
+    import horovod_tpu.torch as hvd
+    from horovod_tpu.runtime.sharded import ShardResizeError
+
+    def build(groups):
+        return hvd.DistributedOptimizer(
+            torch.optim.SGD(groups, lr=0.1), sharded=True)
+
+    w = torch.nn.Parameter(torch.randn(6, 2))
+    b = torch.nn.Parameter(torch.randn(3))
+    opt = build([{"params": [w]}, {"params": [b], "lr": 0.5}])
+    w.grad = torch.ones_like(w)
+    b.grad = torch.ones_like(b)
+    opt.step()
+    sd = opt.state_dict()
+    assert len(sd["groups"]) == 2
+    assert sd["groups"][0]["shard"]["n"] == 12
+    assert sd["groups"][1]["shard"]["n"] == 3
+
+    w2 = torch.nn.Parameter(torch.zeros(6, 2))
+    b2 = torch.nn.Parameter(torch.zeros(3))
+    opt2 = build([{"params": [w2]}, {"params": [b2], "lr": 0.5}])
+    opt2.load_state_dict(sd)
+    assert torch.equal(opt2._groups[0]["master"],
+                       opt._groups[0]["master"])
+    assert torch.equal(opt2._groups[1]["master"],
+                       opt._groups[1]["master"])
+
+    # Group-count mismatch: loud, typed, no partial mutation.
+    w3 = torch.nn.Parameter(torch.zeros(6, 2))
+    opt3 = build([{"params": [w3]}])
+    with pytest.raises(ShardResizeError, match="group"):
+        opt3.load_state_dict(sd)
+    # Geometry mismatch within a group (different flat length).
+    w4 = torch.nn.Parameter(torch.zeros(5, 2))
+    b4 = torch.nn.Parameter(torch.zeros(3))
+    opt4 = build([{"params": [w4]}, {"params": [b4], "lr": 0.5}])
+    with pytest.raises(ShardResizeError):
+        opt4.load_state_dict(sd)

@@ -1,0 +1,128 @@
+"""Convergence + byte-accounting worker for the statistics-driven wire
+policy (``runtime/wire_policy.py``).
+
+An embedding-heavy toy model (data-parallel multi-output linear
+regression: a 256x256 fp32 weight MATRIX — embedding/projection-shaped —
+plus a 256-long bias) trained under two gradient-exchange modes:
+
+* ``fp32``   — every leaf on the uncompressed wire (the baseline);
+* ``policy`` — the WirePolicy chooses per leaf from rolling abs-max/rms
+  statistics: the big smooth matrix gradient switches to the int8 wire
+  after the warmup, the bias stays pinned fp32.  Choices are stamped
+  ADVISORY, so per-rank statistics can never split negotiation.
+
+Asserted worker-side (the PR 8 convergence-worker pattern):
+
+* the policy run's deterministic ``data_bytes_tx`` is well under the
+  fp32 run's (the big leaf quartered; warmup steps + bias at full
+  width), gated at <= 0.60x with honest headroom;
+* the final loss is at fp32 parity (pinned factor bound);
+* the decisions are the documented ones (matrix -> int8, bias -> fp32).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.common.basics import basics  # noqa: E402
+from horovod_tpu.runtime.engine import get_engine  # noqa: E402
+from horovod_tpu.runtime.wire_policy import WirePolicy  # noqa: E402
+
+DIM = 256
+OUT = 256
+SAMPLES_PER_RANK = 128
+STEPS = int(os.environ.get("HOROVOD_CONV_STEPS", "120"))
+LR = 0.05
+
+
+def make_data(rank: int):
+    rng = np.random.default_rng(4321)
+    w_true = (rng.standard_normal((DIM, OUT)) / np.sqrt(DIM)).astype(
+        np.float32)
+    b_true = rng.standard_normal(OUT).astype(np.float32)
+    rng_r = np.random.default_rng(99 + rank)
+    X = rng_r.standard_normal((SAMPLES_PER_RANK, DIM)).astype(np.float32)
+    y = (X @ w_true + b_true
+         + 0.01 * rng_r.standard_normal((SAMPLES_PER_RANK, OUT))).astype(
+        np.float32)
+    return X, y
+
+
+def global_loss(w, b, shards):
+    num, den = 0.0, 0
+    for X, y in shards:
+        r = X @ w + b - y
+        num += float((r * r).sum())
+        den += r.size
+    return num / den
+
+
+def train(mode: str, eng, rank: int, shards):
+    X, y = shards[rank]
+    w = np.zeros((DIM, OUT), dtype=np.float32)
+    b = np.zeros(OUT, dtype=np.float32)
+    m = len(y)
+    policy = WirePolicy() if mode == "policy" else None
+    for step in range(STEPS):
+        r = X @ w + b - y
+        gw = ((2.0 / m) * (X.T @ r)).astype(np.float32)
+        gb = ((2.0 / m) * r.sum(axis=0)).astype(np.float32)
+        wires = [None, None]
+        if policy is not None:
+            wires = [policy.observe_and_choose("wp.gw", gw),
+                     policy.observe_and_choose("wp.gb", gb)]
+        hw = eng.enqueue_allreduce(gw.copy(), name=f"wp.{mode}.gw",
+                                   wire_dtype=wires[0],
+                                   wire_advisory=wires[0] is not None)
+        hb = eng.enqueue_allreduce(gb.copy(), name=f"wp.{mode}.gb",
+                                   wire_dtype=wires[1],
+                                   wire_advisory=wires[1] is not None)
+        outs, infos, first_err = eng.drain([hw, hb])
+        if first_err is not None:
+            raise first_err
+        n = infos[0].get("participants") or basics.size()
+        w -= LR * (outs[0] / n)
+        b -= LR * (outs[1] / n)
+    if policy is not None:
+        # The documented rule actually fired: the matrix compresses, the
+        # bias is pinned fp32.
+        assert policy.decisions.get("wp.gw") == "int8", policy.decisions
+        assert policy.decisions.get("wp.gb") == "fp32", policy.decisions
+    return w, b
+
+
+def main():
+    basics.init()
+    rank, size = basics.rank(), basics.size()
+    eng = get_engine()
+    shards = [make_data(r) for r in range(size)]
+    losses, tx = {}, {}
+    for mode in ("fp32", "policy"):
+        before = eng.stats()
+        w, b = train(mode, eng, rank, shards)
+        tx[mode] = eng.stats_delta(before)["data_bytes_tx"]
+        losses[mode] = global_loss(w, b, shards)
+    ratio = tx["policy"] / max(1, tx["fp32"])
+    if rank == 0:
+        print(f"WIRE_POLICY fp32_tx={tx['fp32']} policy_tx={tx['policy']} "
+              f"ratio={ratio:.3f} "
+              + " ".join(f"loss_{m}={v:.6f}" for m, v in losses.items()),
+              flush=True)
+    # Byte cut on the deterministic counter: the 256 KB matrix gradient
+    # quarters after the 3-step warmup; the bias and warmup ride full
+    # width — measured ~0.30 at 2 ranks, gated with headroom.
+    assert ratio <= 0.60, (ratio, tx)
+    # fp32-parity convergence (pinned deterministic bounds).
+    assert losses["fp32"] < 0.05, losses
+    assert losses["policy"] <= losses["fp32"] * 3.0 + 0.02, losses
+    # int8 responses really ran on the wire.
+    assert eng.stats()["wire_int8_count"] > 0, eng.stats()["wire_int8_count"]
+    basics.shutdown()
+    print(f"worker rank={rank} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
