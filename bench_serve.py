@@ -18,9 +18,12 @@ under a ``serve_`` prefix, next to the ``engine_`` keys)::
 ``python bench_serve.py --gate`` is the CI serve gate: a short Poisson
 run (2 replicas) that FAILS loudly unless every request completes with
 its full nonzero token count, continuous batching actually overlapped
-(measured batch occupancy > 1), shutdown is clean (router exit 0), and
-nothing leaks — replica processes, the router's listen socket, and
-/dev/shm are checked against their pre-run state.
+(measured batch occupancy > 1), a LIVE WEIGHT PUSH lands mid-load
+(every replica acks epoch 1 and every stream finishes self-consistent
+under whichever epoch stamped its ``done`` — never dropped, never a
+partial token count), shutdown is clean (router exit 0), and nothing
+leaks — replica processes, the router's listen socket, and /dev/shm
+are checked against their pre-run state.
 """
 
 from __future__ import annotations
@@ -99,13 +102,41 @@ def _start_fleet(replicas: int, env_extra=None):
 
 
 def run_load(port: int, *, requests: int, rate_hz: float, seed: int = 0,
-             max_tokens_lo: int = 8, max_tokens_hi: int = 24):
+             max_tokens_lo: int = 8, max_tokens_hi: int = 24,
+             push_at: int = -1):
     """Drive the Poisson open-loop load; returns per-request records and
-    the aggregate dict."""
+    the aggregate dict.  ``push_at >= 0`` fires a live weight push
+    (scaled params, epoch 1, lossless fp32 wire) right after that
+    request index is submitted — from a background thread, so the
+    Poisson clock stays honest."""
     import numpy as np
 
     sys.path.insert(0, REPO)
     from horovod_tpu.serve.server import ServeClient
+
+    push_acks = []
+    if push_at >= 0:
+        # Built BEFORE the clock starts: model init must not distort
+        # the arrival process.
+        from horovod_tpu.checkpoint import WeightPusher
+        from horovod_tpu.serve.config import ServeConfig
+        from horovod_tpu.serve.engine import ModelRunner
+        import jax
+
+        runner = ModelRunner(ServeConfig.from_env(BENCH_ENV))
+        vars2 = jax.tree_util.tree_map(
+            lambda a: (np.asarray(a, np.float32) * 1.25).astype(
+                np.asarray(a).dtype)
+            if np.issubdtype(np.asarray(a).dtype, np.floating)
+            else np.asarray(a),
+            runner.variables)
+
+        def _push():
+            pusher = WeightPusher("127.0.0.1", port, timeout=300)
+            try:
+                push_acks.append(pusher.push(vars2, epoch=1, wire="fp32"))
+            finally:
+                pusher.close()
 
     rng = np.random.default_rng(seed)
     plan = []
@@ -117,6 +148,7 @@ def run_load(port: int, *, requests: int, rate_hz: float, seed: int = 0,
                      int(rng.integers(max_tokens_lo, max_tokens_hi + 1))))
 
     cli = ServeClient("127.0.0.1", port, timeout=600)
+    push_thread = None
     records = {}
     t0 = time.monotonic()
     for i, (due, prompt, n) in enumerate(plan):
@@ -126,6 +158,9 @@ def run_load(port: int, *, requests: int, rate_hz: float, seed: int = 0,
         rid = f"load{i}"
         records[rid] = {"submit": time.monotonic(), "n": n}
         cli.start_generate(rid, prompt, max_tokens=n)
+        if i == push_at:
+            push_thread = threading.Thread(target=_push, daemon=True)
+            push_thread.start()
     for i in range(requests):
         rid = f"load{i}"
         evs = cli.collect(rid, timeout=600)
@@ -176,6 +211,20 @@ def run_load(port: int, *, requests: int, rate_hz: float, seed: int = 0,
             (r.get("scheduler", {}).get("kv_blocks_in_use", 0)
              for r in stats["replicas"]), default=0),
     }
+    if push_at >= 0:
+        if push_thread is not None:
+            push_thread.join(timeout=300)
+        agg["weight_pushes"] = stats["router"].get("weight_pushes", 0)
+        agg["weight_push_acked"] = bool(
+            push_acks and push_acks[0].get("replicas")
+            and all(r.get("applied")
+                    for r in push_acks[0]["replicas"]))
+        agg["replica_weight_epochs"] = [
+            r.get("scheduler", {}).get("weight_epoch")
+            for r in stats["replicas"]]
+        agg["stream_weight_epochs"] = sorted({
+            rec["events"][-1].get("weight_epoch")
+            for rec in records.values() if rec["ok"]})
     return cli, records, agg
 
 
@@ -199,7 +248,10 @@ def _gate() -> int:
     replicas, requests, rate = 2, 24, 6.0
     proc, port, log = _start_fleet(replicas)
     try:
-        cli, records, agg = run_load(port, requests=requests, rate_hz=rate)
+        # push_at: mid-load, so a real set of streams is in flight when
+        # the swap lands (the live-push self-consistency contract).
+        cli, records, agg = run_load(port, requests=requests, rate_hz=rate,
+                                     push_at=requests // 2)
     except Exception:
         proc.kill()
         sys.stdout.write("".join(log[-40:]))
@@ -225,6 +277,18 @@ def _gate() -> int:
                         "batching never overlapped")
     if agg["tokens_per_sec"] <= 0:
         failures.append("zero streamed tokens")
+    if agg.get("weight_pushes") != 1 or not agg.get("weight_push_acked"):
+        failures.append(
+            f"live weight push did not land: pushes="
+            f"{agg.get('weight_pushes')} acked="
+            f"{agg.get('weight_push_acked')}")
+    if agg.get("replica_weight_epochs") != [1] * replicas:
+        failures.append(
+            "replicas not all at the pushed weight epoch: "
+            f"{agg.get('replica_weight_epochs')}")
+    if not set(agg.get("stream_weight_epochs") or []) <= {0, 1}:
+        failures.append(
+            f"mixed-epoch streams: {agg.get('stream_weight_epochs')}")
     if rc != 0:
         failures.append(f"router exited {rc} (unclean shutdown)")
     # Leak checks: give stragglers a moment to be reaped.

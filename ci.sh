@@ -27,7 +27,7 @@ echo "== test suite (8-device virtual CPU mesh) =="
 # Caller args go BEFORE the marker filter so a user-passed -m cannot
 # override it — the fault tests must only ever run under the hard
 # timeout below (a reintroduced hang would otherwise eat the CI budget).
-PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability and not linkheal and not priority"
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability and not linkheal and not priority and not ckpt"
 
 echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # These tests previously WOULD HANG when a rank died mid-collective; the
@@ -221,14 +221,33 @@ PALLAS_AXON_POOL_IPS= timeout -k 15 600 python bench_engine.py --scale-gate
 PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
     python -m pytest tests/scale/ -q -m "scale"
 
+echo "== checkpoint gate (weight plane: durability + resharding + live push, hard timeout) =="
+# Unified weight plane (docs/checkpointing.md): (1) sharded async
+# checkpoints must be crash-consistent — a full-fleet SIGKILL resumes
+# from the newest COMMITTED manifest losing zero committed steps, and
+# the injected mid-shard-write ckpt-kill (fault gate) never tears a
+# set; (2) elastic resharding restore must be BIT-EXACT — jax and torch
+# sharded optimizers trained at world 4 resume at world 2 (and 4) and
+# land on the uninterrupted run's digest; (3) a live WeightPusher push
+# hot-swaps a serving fleet mid-decode under a generation epoch with
+# exact tokens on both sides of the swap, a relaunched replica rejoins
+# at the CURRENT pushed epoch (router frame replay), and --serve-model
+# boots every replica from a checkpoint directory.  The mid-shard-write
+# ckpt-kill durability test carries the fault marker and runs in the
+# fault gate above.  The hard timeout is the hang detector for a
+# wedged commit barrier.
+PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
+    python -m pytest tests/ -q -m "ckpt"
+
 echo "== serve gate (2-replica Poisson load, hard timeout) =="
 # Production-serving regression gate: a short open-loop Poisson run
 # against a 2-replica fleet must complete EVERY request with its full
 # nonzero token stream, show real continuous-batching overlap (measured
-# batch occupancy > 1), and shut down clean — no leaked replica
-# processes, no still-listening router socket, no /dev/shm entries
-# (bench_serve.py --gate checks all of it).  The hard timeout is the
-# hang detector for a wedged scheduler/router.
+# batch occupancy > 1), take a LIVE WEIGHT PUSH mid-load (both replicas
+# ack epoch 1, zero dropped/mixed-epoch streams), and shut down clean —
+# no leaked replica processes, no still-listening router socket, no
+# /dev/shm entries (bench_serve.py --gate checks all of it).  The hard
+# timeout is the hang detector for a wedged scheduler/router.
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
     python bench_serve.py --gate
 

@@ -263,6 +263,21 @@ KNOBS: List[Knob] = [
          "continue at the configured path"),
     Knob("HOROVOD_ELASTIC", "0", lambda raw: str(_int_env(raw, 0)),
          "in-place elastic membership"),
+    Knob("HOROVOD_CHECKPOINT_DIR", "(unset: off)",
+         lambda raw: raw or "(unset: off)",
+         "crash-consistent sharded checkpoint directory: run_elastic "
+         "trainers save async double-buffered shards there and resume "
+         "from the newest complete manifest — across world resizes "
+         "(docs/checkpointing.md; run.py --checkpoint-dir sets it)"),
+    Knob("HOROVOD_CHECKPOINT_INTERVAL_STEPS", "50",
+         lambda raw: str(max(1, _int_env(raw, 50))),
+         "steps between interval-gated checkpoint saves "
+         "(CheckpointWriter.maybe_save)"),
+    Knob("HOROVOD_CHECKPOINT_KEEP", "2",
+         lambda raw: str(max(1, _int_env(raw, 2))),
+         "committed checkpoints retained; older manifests are deleted "
+         "BEFORE their shard dirs so 'manifest => complete set' "
+         "survives a crash mid-cleanup"),
     Knob("HOROVOD_AUTOTUNE", "0", lambda raw: str(_int_env(raw, 0)),
          "online knob search over the live data plane (docs/autotune.md)"),
     Knob("HOROVOD_AUTOTUNE_SEED", "0",

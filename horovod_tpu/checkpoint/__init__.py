@@ -1,0 +1,53 @@
+"""The unified weight plane: crash-consistent sharded async
+checkpoints, elastic resharding restore, and live trainer→serve weight
+push.
+
+Three legs over one vocabulary (replicated pytrees + flat sharded
+vectors under the engine's committed largest-first split):
+
+- :class:`CheckpointWriter` — per-rank double-buffered async shard
+  writes (tmp+rename), a MAX-allreduce commit barrier, and a rank-0
+  step-stamped manifest: a kill at ANY instant leaves either the
+  previous complete checkpoint set or the new one, never a torn mix
+  (writer.py; proven under the ``ckpt-kill`` fault).
+- :class:`CheckpointLoader` — reads a world-N manifest into a world-M
+  process by re-slicing the flat vectors through ``shard_bounds(n, M)``
+  (loader.py); :func:`maybe_restore` wires it into ``run_elastic`` so a
+  relaunched fleet resumes from the last durable step instead of 0.
+- :class:`WeightPusher` — live weight frames over the serve protocol
+  with per-tensor wire policy, hot-swapped by replicas under a
+  generation-epoch stamp (push.py; serve/scheduler.py applies them).
+
+See docs/checkpointing.md for the manifest format and durability
+contract.
+"""
+
+from horovod_tpu.checkpoint.loader import CheckpointLoader
+from horovod_tpu.checkpoint.manifest import (CheckpointError,
+                                             CheckpointIncompleteError,
+                                             latest_manifest)
+from horovod_tpu.checkpoint.stats import (checkpoint_stats,
+                                          note_checkpoint,
+                                          note_checkpoint_restore,
+                                          note_weight_push)
+from horovod_tpu.checkpoint.writer import (CheckpointConfig,
+                                           CheckpointWriter,
+                                           parse_ckpt_kill)
+
+__all__ = [
+    "CheckpointConfig", "CheckpointWriter", "CheckpointLoader",
+    "CheckpointError", "CheckpointIncompleteError", "latest_manifest",
+    "parse_ckpt_kill", "checkpoint_stats", "note_checkpoint",
+    "note_checkpoint_restore", "note_weight_push", "maybe_restore",
+    "jax_capture", "jax_restore", "torch_capture", "torch_restore",
+    "WeightPusher", "encode_leaves", "decode_leaves", "apply_leaves",
+]
+
+from horovod_tpu.checkpoint.elastic import maybe_restore  # noqa: E402
+from horovod_tpu.checkpoint.frontend import (jax_capture,  # noqa: E402
+                                             jax_restore,
+                                             torch_capture,
+                                             torch_restore)
+from horovod_tpu.checkpoint.push import (WeightPusher,  # noqa: E402
+                                         apply_leaves, decode_leaves,
+                                         encode_leaves)

@@ -1,0 +1,84 @@
+"""Disk-restore hook for the elastic driver.
+
+``run_elastic`` calls :func:`maybe_restore` at every (re-)entry when
+``HOROVOD_CHECKPOINT_DIR`` is set, BEFORE ``ElasticState.sync()``:
+
+- rank 0 (the sync authority — its values are what the broadcast
+  imposes on everyone) compares its in-memory progress against the
+  newest complete manifest and decides;
+- the decision is agreed via a MAX-allreduce flag (only rank 0
+  contributes a nonzero value), so a fresh relaunch and a survivor
+  take the same branch;
+- on restore, EVERY rank loads the replicated slots from disk — the
+  subsequent ``sync()`` then broadcasts byte-identical values anyway,
+  making the result independent of who restored from where.
+
+Memory wins when it is ahead: survivors that committed past the last
+durable checkpoint keep their (newer) state and ``sync()`` repairs the
+relaunched rank, exactly as before this plane existed.  Disk wins only
+when rank 0 itself lost progress (full-fleet relaunch, or rank 0 died)
+— the case that used to mean "back to step 0".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu.checkpoint.loader import CheckpointLoader
+from horovod_tpu.checkpoint.manifest import latest_manifest
+from horovod_tpu.checkpoint.stats import note_checkpoint_restore
+from horovod_tpu.runtime import engine_or_none
+from horovod_tpu.runtime.engine import flight_note
+
+__all__ = ["maybe_restore"]
+
+
+def _memory_step(state) -> int:
+    """The state's own notion of progress: an integer ``step`` slot if
+    it has one, else 0 (disk then wins whenever a manifest exists and
+    rank 0 cannot prove it is ahead)."""
+    step = getattr(state, "step", None)
+    if isinstance(step, (bool, np.bool_)):
+        return 0
+    if isinstance(step, (int, np.integer)):
+        return int(step)
+    return 0
+
+
+def maybe_restore(state, directory: str):
+    """Restore ``state``'s slots from the newest complete checkpoint in
+    ``directory`` if (and only if) it is ahead of rank 0's in-memory
+    progress.  Collective when the engine is up (all ranks must call
+    it together — run_elastic does).  Returns the restored step, or
+    ``None`` when memory won / no checkpoint exists."""
+    from horovod_tpu.common.basics import basics
+
+    found = latest_manifest(directory)
+    eng = engine_or_none() if basics.is_initialized() else None
+    disk_step = found[1] if found is not None else -1
+    want = 1 if (found is not None
+                 and disk_step > _memory_step(state)) else 0
+    if eng is not None:
+        # Only rank 0's vote counts (it is the sync() authority); the
+        # MAX over {rank0: want, others: 0} IS rank 0's decision, and
+        # riding allreduce keeps this a single well-named collective.
+        mine = want if basics.rank() == 0 else 0
+        out = eng.allreduce(np.array([mine], dtype=np.float64),
+                            red_op="max", name="ckpt.restore.decide")
+        want = int(out[0])
+    if not want or found is None:
+        return None
+    loader = CheckpointLoader(directory, step=disk_step)
+    try:
+        for k in state._keys:
+            setattr(state, k,
+                    loader.restore_tree(getattr(state, k), k,
+                                        missing="keep"))
+        state.commit()
+    finally:
+        loader.close()
+    note_checkpoint_restore(disk_step)
+    flight_note("ckpt", f"restore step={disk_step} "
+                        f"world={loader.world_size}->"
+                        f"{basics.size() if basics.is_initialized() else 1}")
+    return disk_step

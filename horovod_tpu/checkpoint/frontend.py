@@ -1,0 +1,196 @@
+"""Frontend adapters: jax/optax and torch state ↔ the weight plane.
+
+The writer/loader core speaks (replicated pytrees + flat sharded
+vectors); these helpers translate each frontend's optimizer into that
+vocabulary so BOTH frontends get crash-consistent sharded checkpoints
+and elastic resharding restore from the same code path.
+
+Sharding classification is structural, matching how the optimizers are
+built: under a ``FlatSharder`` every per-element state leaf (optax mu /
+nu / trace, the torch fp32 master, torch momentum buffers) is a 1-D
+vector of exactly ``sharder.count`` elements — those become flat
+sharded entries keyed by their deterministic walk path; everything else
+(step counters, hyperparameters, the replicated model params) rides the
+replicated tree.  Restore runs the SAME walk over a freshly initialized
+state at the new world, so each classification decision is re-derived
+identically — the geometry is never trusted from the old world, only
+``n`` is.
+
+All framework imports are function-local: importing this module pulls
+in neither jax nor torch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.checkpoint.loader import CheckpointLoader
+from horovod_tpu.elastic.state import _walk
+
+__all__ = [
+    "jax_capture", "jax_restore",
+    "torch_capture", "torch_restore",
+]
+
+
+# -- jax / optax --
+
+def jax_capture(opt, params, opt_state, step: int,
+                extra: Optional[dict] = None):
+    """``(state, sharded)`` for ``CheckpointWriter.save`` from a jax
+    ``DistributedOptimizer`` (sharded or not), its state, and the
+    params."""
+    state = {"params": params, "opt_state": opt_state, "step": int(step)}
+    if extra:
+        state.update(extra)
+    sharded: Dict[str, Tuple[np.ndarray, int]] = {}
+    sh = getattr(opt, "_sharder", None)
+    if sh is not None and sh.count > 0:
+
+        def classify(path, leaf):
+            arr = np.asarray(leaf)
+            if arr.ndim == 1 and arr.size == sh.count:
+                sharded[path] = (arr, sh.n)
+            return leaf
+
+        _walk(opt_state, "opt_state", classify)
+    return state, sharded
+
+
+def jax_restore(opt, params_template, loader: CheckpointLoader,
+                step_slot: str = "step"):
+    """``(params, opt_state, step)`` rebuilt at the CURRENT world from a
+    checkpoint written at any world size.  ``opt.init`` anchors the new
+    shard geometry first (the ``ShardResizeError`` recipe); the loader
+    then fills shard-sized leaves from the resliced flat vectors and
+    everything else bit-exactly from the replicated tree."""
+    params = loader.restore_tree(params_template, "params")
+    opt_state = opt.init(params)
+    opt_state = loader.restore_tree(opt_state, "opt_state")
+    step = int(np.asarray(loader.restore_tree(0, step_slot)))
+    return params, opt_state, step
+
+
+# -- torch --
+
+def _torch_shard_groups(opt):
+    """(group, inner-param, sharder) triples of a sharded torch
+    optimizer, or None for a plain/hook-wrapped one."""
+    groups = getattr(opt, "_groups", None)
+    shard_opt = getattr(opt, "_shard_opt", None)
+    if not groups or shard_opt is None:
+        return None
+    out = []
+    for gi, g in enumerate(groups):
+        inner_param = shard_opt.param_groups[gi]["params"][0]
+        out.append((g, inner_param, g["sharder"]))
+    return out
+
+
+def torch_capture(opt, model, step: int, extra: Optional[dict] = None):
+    """``(state, sharded)`` from a torch optimizer (the sharded
+    ZeRO wrapper or any plain optimizer) and its model."""
+    import torch
+
+    model_np = {k: v.detach().cpu().numpy()
+                for k, v in model.state_dict().items()}
+    state = {"model": model_np, "step": int(step)}
+    if extra:
+        state.update(extra)
+    sharded: Dict[str, Tuple[np.ndarray, int]] = {}
+    triples = _torch_shard_groups(opt)
+    if triples is None:
+        # Unsharded: the whole optimizer state is replicated (every rank
+        # holds an identical copy after the averaged allreduce step).
+        state["torch_opt"] = opt.state_dict()
+        return state, sharded
+    scalars: Dict[str, object] = {}
+    for gi, (g, inner_param, sh) in enumerate(triples):
+        sharded[f"zero.master.{gi}"] = (
+            g["master"].detach().cpu().numpy(), sh.n)
+        for key, val in opt._shard_opt.state.get(inner_param, {}).items():
+            if torch.is_tensor(val) and val.numel() == sh.count:
+                sharded[f"zero.opt.{gi}.{key}"] = (
+                    val.detach().cpu().to(torch.float32).numpy(), sh.n)
+            else:
+                scalars[f"{gi}.{key}"] = (
+                    val.item() if torch.is_tensor(val) else val)
+    state["zero_scalars"] = scalars
+    return state, sharded
+
+
+def torch_restore(opt, model, loader: CheckpointLoader,
+                  step_slot: str = "step") -> int:
+    """Fill ``model`` and ``opt`` (built for the CURRENT world) in place
+    from the checkpoint; returns the restored step.  Sharded masters and
+    per-element optimizer state are resliced through the new-world
+    bounds; lazily-created torch state entries are materialized so a
+    restore into a never-stepped optimizer works."""
+    import torch
+
+    model_np = {k: v.detach().cpu().numpy()
+                for k, v in model.state_dict().items()}
+    restored = loader.restore_tree(model_np, "model")
+    model.load_state_dict({
+        k: torch.from_numpy(np.ascontiguousarray(v)).reshape(
+            model.state_dict()[k].shape).to(model.state_dict()[k].dtype)
+        for k, v in restored.items()
+    })
+    triples = _torch_shard_groups(opt)
+    if triples is None:
+        if "torch_opt" in loader.slot_names():
+            sd = loader.restore_tree(opt.state_dict(), "torch_opt")
+            # restore_tree walks the TARGET, and a never-stepped torch
+            # optimizer has an empty per-param state dict — rebuild the
+            # state entries from the saved paths instead, re-tensorizing
+            # buffers (torch kernels call tensor methods on them).
+            pref = "torch_opt.state."
+            st: Dict[int, dict] = {}
+            for p in loader.replicated_paths():
+                if not p.startswith(pref):
+                    continue
+                idx, _, key = p[len(pref):].partition(".")
+                val = np.asarray(loader.read_replicated(p))
+                st.setdefault(int(idx), {})[key] = (
+                    torch.from_numpy(np.ascontiguousarray(val))
+                    if val.ndim else val[()].item())
+            sd["state"] = st
+            opt.load_state_dict(sd)
+        return int(np.asarray(loader.restore_tree(0, step_slot)))
+    scalar_prefix = "zero_scalars."
+    scalars = {p[len(scalar_prefix):]: loader.read_replicated(p)
+               for p in loader.replicated_paths()
+               if p.startswith(scalar_prefix)}
+    for gi, (g, inner_param, sh) in enumerate(triples):
+        with torch.no_grad():
+            g["master"].copy_(torch.from_numpy(np.ascontiguousarray(
+                loader.read_flat(f"zero.master.{gi}", sh.offset,
+                                 sh.count))))
+        opt_keys = [name[len(f"zero.opt.{gi}."):]
+                    for name in loader.sharded_names()
+                    if name.startswith(f"zero.opt.{gi}.")]
+        st = opt._shard_opt.state.setdefault(inner_param, {})
+        for key in opt_keys:
+            st[key] = torch.from_numpy(np.ascontiguousarray(
+                loader.read_flat(f"zero.opt.{gi}.{key}", sh.offset,
+                                 sh.count))).to(g["master"].dtype)
+        for skey, val in scalars.items():
+            sgi, _, key = skey.partition(".")
+            if int(sgi) == gi:
+                st[key] = np.asarray(val).reshape(())[()].item()
+        # Params follow the restored master (ZeRO invariant: the fp32
+        # master is authoritative; replicate it back through the same
+        # allgather the step uses so every rank's params agree even
+        # when the model state_dict predates the master's step).
+        full = sh.gather_updates(g["master"].detach().cpu().numpy())
+        with torch.no_grad():
+            off = 0
+            for p, numel, shape in zip(g["params"], g["numels"],
+                                       g["shapes"]):
+                chunk = torch.from_numpy(
+                    np.ascontiguousarray(full[off:off + numel]))
+                p.data.copy_(chunk.reshape(shape).to(p.dtype))
+                off += numel
+    return int(np.asarray(loader.restore_tree(0, step_slot)))

@@ -1,0 +1,234 @@
+"""Elastic resharding checkpoint loader.
+
+Reads a manifest written at world size N and redistributes into the
+CURRENT world size M — the restore half of the ``ShardResizeError``
+contract: the sharded optimizer refuses to run across a resize, and
+this loader is how the rebuilt optimizer gets its new-world shard.
+
+Sharded vectors: the manifest records the flat length ``n`` and the
+old world's ``(offset, count)`` bounds; :meth:`CheckpointLoader.read_flat`
+computes the new rank's window via ``shard_bounds(n, M)`` and assembles
+it from whichever old shard files overlap (shared-filesystem
+single-host assumption — every rank can read every shard file, which
+is the same assumption the launcher's respawn path already makes).
+
+Replicated pytrees: restored INTO a live target structure (a freshly
+initialized state at the new world) by the same deterministic
+sorted-key walk the writer used, with the scalar-type preservation
+rules of ``ElasticState.sync`` — equal world size resumes are
+bit-identical because every byte round-trips verbatim.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.checkpoint import manifest as mf
+from horovod_tpu.checkpoint.manifest import (CheckpointError,
+                                             CheckpointIncompleteError,
+                                             latest_manifest)
+from horovod_tpu.elastic.state import _walk
+from horovod_tpu.runtime.sharded import shard_bounds
+
+__all__ = ["CheckpointLoader"]
+
+
+class CheckpointLoader:
+    """One complete checkpoint, opened for (re)sharded reads.
+
+    >>> loader = CheckpointLoader(directory)            # newest complete
+    >>> loader = CheckpointLoader(directory, step=200)  # explicit step
+    >>> w = loader.restore_tree(template_params, "params")
+    >>> shard = loader.read_flat("opt_state...mu", offset, count)
+
+    Raises :class:`CheckpointIncompleteError` for a torn/stale set and
+    ``FileNotFoundError`` when the directory holds no complete
+    checkpoint at all.
+    """
+
+    def __init__(self, directory: str, step: Optional[int] = None):
+        self.directory = directory
+        if step is None:
+            found = latest_manifest(directory)
+            if found is None:
+                steps = mf.list_manifest_steps(directory)
+                if steps:
+                    # Manifests exist but none validates: surface the
+                    # refusal loudly instead of a generic not-found.
+                    man = mf.read_manifest(directory, steps[-1])
+                    mf.validate(directory, man)
+                raise FileNotFoundError(
+                    f"no complete checkpoint in {directory}")
+            self.manifest, self.step = found
+        else:
+            self.manifest = mf.read_manifest(directory, step)
+            mf.validate(directory, self.manifest)
+            self.step = int(step)
+        self.epoch = int(self.manifest.get("epoch", 0))
+        self.world_size = int(self.manifest["world_size"])
+        self.meta = dict(self.manifest.get("meta") or {})
+        self._sharded = {e["name"]: e
+                         for e in self.manifest.get("sharded", [])}
+        self._npz_cache: Dict[int, np.lib.npyio.NpzFile] = {}
+        self._replicated: Optional[Dict[str, np.ndarray]] = None
+
+    # -- file plumbing --
+
+    def _shard_npz(self, rank: int):
+        npz = self._npz_cache.get(rank)
+        if npz is None:
+            path = mf.shard_file(self.directory, self.step, rank,
+                                 self.world_size)
+            try:
+                npz = np.load(path)
+            except (OSError, ValueError) as e:
+                raise CheckpointIncompleteError(
+                    f"shard file {path} vanished or is unreadable "
+                    f"mid-restore: {e}") from e
+            self._npz_cache[rank] = npz
+        return npz
+
+    def close(self) -> None:
+        for npz in self._npz_cache.values():
+            npz.close()
+        self._npz_cache.clear()
+
+    # -- sharded vectors --
+
+    def sharded_names(self):
+        return sorted(self._sharded)
+
+    def flat_length(self, name: str) -> int:
+        return int(self._sharded[name]["n"])
+
+    def read_flat(self, name: str, offset: int = 0,
+                  count: Optional[int] = None) -> np.ndarray:
+        """The ``[offset, offset+count)`` window of sharded vector
+        ``name``, assembled from the old-world shard files that overlap
+        it — the resharding read."""
+        entry = self._sharded.get(name)
+        if entry is None:
+            raise KeyError(
+                f"checkpoint step {self.step} has no sharded vector "
+                f"'{name}' (has: {self.sharded_names()})")
+        n = int(entry["n"])
+        if count is None:
+            count = n - offset
+        end = offset + count
+        if not (0 <= offset <= end <= n):
+            raise ValueError(
+                f"window [{offset}, {end}) out of range for n={n}")
+        parts = []
+        for rank, (off, cnt) in enumerate(entry["bounds"]):
+            lo, hi = max(offset, off), min(end, off + cnt)
+            if lo >= hi:
+                continue
+            piece = self._shard_npz(rank)[entry["key"]]
+            parts.append(piece[lo - off:hi - off])
+        if not parts:
+            return np.zeros(0, dtype=np.dtype(entry["dtype"]))
+        out = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        return np.ascontiguousarray(out, dtype=np.dtype(entry["dtype"]))
+
+    def my_flat_shard(self, name: str, rank: int, size: int) -> np.ndarray:
+        """Rank ``rank``-of-``size``'s window of ``name`` under the
+        committed largest-first split at the NEW world size."""
+        off, cnt = shard_bounds(self.flat_length(name), size)[rank]
+        return self.read_flat(name, off, cnt)
+
+    # -- replicated pytrees --
+
+    def _rep_arrays(self) -> Dict[str, np.ndarray]:
+        if self._replicated is None:
+            rep = self.manifest.get("replicated") or {}
+            npz = self._shard_npz(int(rep.get("file_rank", 0)))
+            self._replicated = {
+                path: npz[f"rep.{i}"]
+                for i, path in enumerate(rep.get("paths", []))
+            }
+        return self._replicated
+
+    def replicated_paths(self):
+        return sorted(self._rep_arrays())
+
+    def read_replicated(self, path: str) -> np.ndarray:
+        """The saved replicated array at an exact walk path."""
+        rep = self._rep_arrays()
+        if path not in rep:
+            raise KeyError(
+                f"checkpoint step {self.step} has no replicated leaf "
+                f"'{path}'")
+        return rep[path]
+
+    def slot_names(self):
+        """Top-level slot names present in the checkpoint (replicated
+        paths' first components plus sharded-name roots)."""
+        roots = set()
+        for path in self._rep_arrays():
+            roots.add(path.split(".", 1)[0])
+        for name in self._sharded:
+            roots.add(name.split(".", 1)[0])
+        return sorted(roots)
+
+    def restore_tree(self, target, prefix: str, *,
+                     missing: str = "error"):
+        """Rebuild ``target`` (a live pytree — the freshly initialized
+        state at the CURRENT world) with every leaf replaced by the
+        checkpointed value at the same walk path.
+
+        - a path recorded as a SHARDED vector is filled from
+          :meth:`read_flat` at this rank's new-world bounds (the leaf
+          must be the new-world shard: 1-D, length = new count);
+        - a replicated path adopts the saved array with the scalar-type
+          preservation of ``ElasticState.sync`` (bit-exact resume);
+        - ``missing="error"`` raises on a target leaf the checkpoint
+          never saved; ``missing="keep"`` keeps the target's value
+          (used for world-dependent geometry the caller re-derives).
+        """
+        from horovod_tpu.common.basics import basics
+
+        rank = basics.rank() if basics.is_initialized() else 0
+        size = basics.size() if basics.is_initialized() else 1
+        rep = self._rep_arrays()
+
+        def visit(path, leaf):
+            if path in self._sharded:
+                arr = np.asarray(leaf)
+                off, cnt = shard_bounds(self.flat_length(path),
+                                        size)[rank]
+                if arr.ndim != 1 or arr.size != cnt:
+                    raise CheckpointError(
+                        f"target leaf at '{path}' has shape {arr.shape} "
+                        f"but rank {rank}/{size} owns a ({cnt},) shard "
+                        f"of n={self.flat_length(path)} — was the "
+                        "optimizer rebuilt for the current world?")
+                return self.read_flat(path, off, cnt).astype(
+                    arr.dtype, copy=False).copy()
+            saved = rep.get(path)
+            if saved is None:
+                if missing == "keep":
+                    return leaf
+                raise CheckpointError(
+                    f"checkpoint step {self.step} has no value for "
+                    f"'{path}' (slots: {self.slot_names()})")
+            arr = np.asarray(leaf)
+            if np.asarray(saved).ndim == 0 or arr.ndim == 0:
+                val = np.asarray(saved).reshape(())[()]
+                if isinstance(leaf, bool):
+                    return bool(val)
+                if isinstance(leaf, int):
+                    return int(val)
+                if isinstance(leaf, float):
+                    return float(val)
+                return np.asarray(saved).astype(arr.dtype, copy=False)
+            if np.asarray(saved).shape != arr.shape:
+                raise CheckpointError(
+                    f"shape mismatch at '{path}': checkpoint has "
+                    f"{np.asarray(saved).shape}, target expects "
+                    f"{arr.shape}")
+            return np.asarray(saved).astype(arr.dtype, copy=False).copy()
+
+        return _walk(target, prefix, visit)

@@ -1,0 +1,180 @@
+"""Live trainer→serve weight push: wire codec + pusher client.
+
+The trainer ships its current model tree to the serve router as ONE
+JSON-lines ``weights`` frame; the router fans it out to every replica
+(and replays the latest frame to a relaunched replica, so a rejoin
+never serves boot-time params); each replica hot-swaps between decode
+iterations under a monotonic generation-epoch stamp
+(serve/scheduler.py ``swap_weights``).
+
+Wire policy mirrors the PR 15 per-tensor rules: small / 0-1-D leaves
+(norm scales, biases — the "pinned" class) always ride fp32; bulk
+matrices ride the requested compressed wire (``int8`` absmax-scaled by
+default, ``fp8``/``bf16`` via ml_dtypes, ``fp32`` for lossless pushes).
+Decode always reconstructs float32; the replica casts into its own
+param dtype when swapping.
+
+Deliberately engine-free: the push rides the serve plane's TCP
+protocol, not the collective engine — a trainer can push into a fleet
+it is not a member of.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.checkpoint.stats import note_weight_push
+from horovod_tpu.elastic.state import _walk
+
+__all__ = [
+    "PIN_MIN_ELEMS", "encode_leaves", "decode_leaves", "apply_leaves",
+    "WeightPusher",
+]
+
+#: Leaves below this element count stay fp32 on the wire (the pinned
+#: class of the wire-policy rules: quantization noise on tiny tensors
+#: is all signal, and the bytes saved are nothing).
+PIN_MIN_ELEMS = 2048
+
+_WIRES = ("fp32", "bf16", "fp8", "int8")
+
+
+def _b64(arr: np.ndarray) -> str:
+    return base64.b64encode(np.ascontiguousarray(arr).tobytes()).decode(
+        "ascii")
+
+
+def _unb64(data: str, dtype, shape) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(data),
+                         dtype=dtype).reshape(shape).copy()
+
+
+def encode_leaves(tree, *, wire: str = "int8",
+                  min_elems: int = PIN_MIN_ELEMS) -> List[dict]:
+    """Per-leaf wire frames for every float leaf of ``tree`` (walked in
+    the deterministic sorted-key order, paths rooted at ``w``).
+    Non-float leaves are shipped verbatim (fp32-rule equivalent)."""
+    if wire not in _WIRES:
+        raise ValueError(f"wire {wire!r} not in {_WIRES}")
+    frames: List[dict] = []
+
+    def visit(path, leaf):
+        arr = np.asarray(leaf)
+        frame = {"path": path, "shape": list(arr.shape),
+                 "dtype": str(arr.dtype)}
+        pinned = (not np.issubdtype(arr.dtype, np.floating)
+                  or arr.ndim <= 1 or arr.size < min_elems)
+        w = "fp32" if pinned or wire == "fp32" else wire
+        x = arr.astype(np.float32, copy=False)
+        if w == "fp32":
+            frame.update(wire="fp32", data=_b64(
+                arr if not np.issubdtype(arr.dtype, np.floating) else x))
+            if not np.issubdtype(arr.dtype, np.floating):
+                frame["wire"] = "raw"
+        elif w == "bf16":
+            import ml_dtypes
+
+            frame.update(wire="bf16",
+                         data=_b64(x.astype(ml_dtypes.bfloat16)))
+        elif w == "fp8":
+            import ml_dtypes
+
+            absmax = float(np.max(np.abs(x))) if x.size else 0.0
+            scale = absmax / 448.0 if absmax > 0 else 1.0
+            frame.update(wire="fp8", scale=scale, data=_b64(
+                (x / scale).astype(ml_dtypes.float8_e4m3fn)))
+        else:  # int8 absmax
+            absmax = float(np.max(np.abs(x))) if x.size else 0.0
+            scale = absmax / 127.0 if absmax > 0 else 1.0
+            frame.update(wire="int8", scale=scale, data=_b64(
+                np.clip(np.rint(x / scale), -127, 127).astype(np.int8)))
+        frames.append(frame)
+        return leaf
+
+    _walk(tree, "w", visit)
+    return frames
+
+
+def decode_leaves(frames: List[dict]) -> Dict[str, np.ndarray]:
+    """``{path: array}`` — float wires reconstruct float32, ``raw``
+    keeps the original dtype."""
+    out: Dict[str, np.ndarray] = {}
+    for f in frames:
+        shape = tuple(f["shape"])
+        w = f["wire"]
+        if w == "raw":
+            arr = _unb64(f["data"], np.dtype(f["dtype"]), shape)
+        elif w == "fp32":
+            arr = _unb64(f["data"], np.float32, shape)
+        elif w == "bf16":
+            import ml_dtypes
+
+            arr = _unb64(f["data"], ml_dtypes.bfloat16, shape).astype(
+                np.float32)
+        elif w == "fp8":
+            import ml_dtypes
+
+            arr = _unb64(f["data"], ml_dtypes.float8_e4m3fn,
+                         shape).astype(np.float32) * f.get("scale", 1.0)
+            arr = arr.astype(np.float32)
+        elif w == "int8":
+            arr = (_unb64(f["data"], np.int8, shape).astype(np.float32)
+                   * f.get("scale", 1.0)).astype(np.float32)
+        else:
+            raise ValueError(f"unknown wire {w!r} in weights frame")
+        out[f["path"]] = arr
+    return out
+
+
+def apply_leaves(target, by_path: Dict[str, np.ndarray]):
+    """Rebuild ``target`` with every leaf whose walk path appears in
+    ``by_path`` replaced (cast to the leaf's dtype); untouched leaves
+    pass through — partial pushes update only what they carry."""
+    def visit(path, leaf):
+        new = by_path.get(path)
+        if new is None:
+            return leaf
+        arr = np.asarray(leaf)
+        if tuple(new.shape) != tuple(arr.shape):
+            raise ValueError(
+                f"weights push leaf '{path}' has shape "
+                f"{tuple(new.shape)}, replica expects {tuple(arr.shape)}"
+                " — pushed model does not match the serving model")
+        return np.asarray(new).astype(arr.dtype, copy=False)
+
+    return _walk(target, "w", visit)
+
+
+class WeightPusher:
+    """Trainer-side client: encode the model tree and push it to the
+    serve router (which fans out to every replica and caches the frame
+    for rejoins).
+
+    >>> pusher = WeightPusher("127.0.0.1", router_port)
+    >>> ack = pusher.push(variables)          # epoch auto-increments
+    >>> pusher.close()
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        from horovod_tpu.serve.server import ServeClient
+
+        self._cli = ServeClient(host, port, timeout=timeout)
+        self._epoch = 0
+
+    def push(self, tree, *, epoch: Optional[int] = None,
+             wire: str = "int8", min_elems: int = PIN_MIN_ELEMS) -> dict:
+        if epoch is None:
+            self._epoch += 1
+            epoch = self._epoch
+        else:
+            self._epoch = int(epoch)
+        frames = encode_leaves(tree, wire=wire, min_elems=min_elems)
+        ack = self._cli.push_weights(frames, epoch)
+        note_weight_push()
+        return ack
+
+    def close(self) -> None:
+        self._cli.close()

@@ -1,0 +1,433 @@
+"""Crash-consistent sharded async checkpoint writer.
+
+Each rank snapshots its state OFF the step path: ``save()`` host-copies
+the pytrees (the double buffer — the training step mutates the live
+arrays freely while the writer thread serializes the copy), hands the
+snapshot to a dedicated writer thread, and returns.  The writer:
+
+1. serializes this rank's shard to ``step-<S>/shard-<r>-of-<N>.npz.tmp``
+   and renames it into place (a kill mid-write leaves only an invisible
+   ``.tmp``),
+2. joins a MAX-allreduce barrier (``ckpt.commit.s<S>``) where every
+   rank contributes its failure flag — the reduced max is 0 only when
+   EVERY shard landed,
+3. rank 0 then writes the step-stamped manifest, tmp+rename — the
+   commit point,
+4. applies retention (``HOROVOD_CHECKPOINT_KEEP``), deleting stale
+   manifests BEFORE their shard dirs so "manifest ⇒ complete set"
+   survives a crash mid-cleanup.
+
+A rank SIGKILLed mid-write (or the injected ``ckpt-kill`` fault) never
+reaches the barrier; the survivors' barrier collective aborts with
+``HorovodInternalError``, the manifest is never written, and the
+previous complete checkpoint remains the durable state — the torn-mix
+impossibility the fault-marked tests prove.
+
+State model: ``state`` is a dict of named slots (or an ``ElasticState``,
+whose tracked slots are used), each an arbitrary pytree walked in the
+deterministic sorted-key order of ``elastic.state._walk``.  ``sharded``
+maps a walk path (or any stable name) to ``(local_shard, n)`` — this
+rank's window of a flat length-``n`` ZeRO vector under the committed
+largest-first split.  Paths named in ``sharded`` (and any ``exclude``
+prefixes) are skipped by the replicated writer; everything else is
+saved once, from rank 0's file.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.checkpoint import manifest as mf
+from horovod_tpu.checkpoint.stats import note_checkpoint
+from horovod_tpu.elastic.state import _host_copy, _walk
+from horovod_tpu.runtime import engine_or_none
+from horovod_tpu.runtime.engine import HorovodInternalError, flight_note
+
+__all__ = ["CheckpointConfig", "CheckpointWriter", "parse_ckpt_kill"]
+
+
+def _int_env(raw: Optional[str], default: int) -> int:
+    try:
+        return int(raw) if raw not in (None, "") else default
+    except ValueError:
+        return default
+
+
+class CheckpointConfig:
+    """The ``HOROVOD_CHECKPOINT_*`` knobs (all lenient-parsed like the
+    rest of the env surface; see autotune/config.py for --print-config
+    rows)."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 interval_steps: Optional[int] = None,
+                 keep: Optional[int] = None, environ=os.environ):
+        env_dir = environ.get("HOROVOD_CHECKPOINT_DIR", "").strip()
+        self.directory = directory if directory is not None else (
+            env_dir or None)
+        self.interval_steps = max(1, interval_steps if interval_steps
+                                  is not None else _int_env(
+                                      environ.get(
+                                          "HOROVOD_CHECKPOINT_INTERVAL_STEPS"),
+                                      50))
+        self.keep = max(1, keep if keep is not None else _int_env(
+            environ.get("HOROVOD_CHECKPOINT_KEEP"), 2))
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.directory)
+
+
+# -- ckpt-kill fault schedule (Python-owned leg of HOROVOD_FAULT_INJECT) --
+
+_CKPT_KILL_FIRED = False
+
+
+def _strict_int(tok: str) -> Optional[int]:
+    """Mirror the C++ parser's strtol-with-endp validation: the whole
+    token must be a (signed) decimal integer, else the entry is a typo
+    and is IGNORED (parity with cpp/engine.cc)."""
+    tok = tok.strip()
+    if not tok:
+        return None
+    body = tok[1:] if tok[0] in "+-" else tok
+    if not body.isdigit():
+        return None
+    return int(tok)
+
+
+def parse_ckpt_kill(raw: Optional[str], rank: int) -> Optional[int]:
+    """First ``<rank>:<step>:ckpt-kill`` entry of the shared
+    ``HOROVOD_FAULT_INJECT`` schedule matching ``rank``; returns the arm
+    step (``-2`` for ``*`` = first checkpoint) or ``None``.  The engine
+    parser accepts the kind silently and leaves firing to us — the kill
+    must land mid-shard-write, which only the writer can time."""
+    if not raw:
+        return None
+    for token in raw.split(","):
+        fields = token.split(":")
+        if len(fields) < 3:
+            continue
+        frank = _strict_int(fields[0])
+        if frank is None or frank != rank:
+            continue
+        step_tok = fields[1].strip()
+        fstep = -2 if step_tok == "*" else _strict_int(step_tok)
+        if fstep is None:
+            continue
+        if fields[2].strip() == "ckpt-kill":
+            return fstep
+    return None
+
+
+def _maybe_fire_ckpt_kill(arm_step: Optional[int], step: int,
+                          partial_file) -> None:
+    """SIGKILL this process mid-shard-write: called after the tmp file
+    holds a PARTIAL serialization (flushed so the torn bytes are really
+    on disk).  One-shot per process, like the engine's fault_fired_."""
+    global _CKPT_KILL_FIRED
+    if arm_step is None or _CKPT_KILL_FIRED:
+        return
+    if arm_step != -2 and step < arm_step:
+        return
+    _CKPT_KILL_FIRED = True
+    partial_file.flush()
+    os.fsync(partial_file.fileno())
+    print(f"[hvd] FAULT INJECT: ckpt-kill at step {step} "
+          "(SIGKILL mid-shard-write)", flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+class CheckpointWriter:
+    """Async double-buffered per-rank shard writer + rank-0 committer.
+
+    >>> w = CheckpointWriter(directory)         # or env-configured
+    >>> w.maybe_save(step, state, sharded)      # interval-gated
+    >>> w.wait()                                # drain (tests/shutdown)
+    >>> w.close()
+
+    ``save()`` is collective ONLY in the sense that every rank must
+    eventually save the same step (the commit barrier rendezvous); the
+    call itself returns after the host copy.  Latest-wins: a save
+    arriving while the writer is busy replaces any queued snapshot —
+    under backpressure the plane drops intermediate checkpoints, never
+    blocks the step path.
+    """
+
+    def __init__(self, directory: Optional[str] = None, *,
+                 interval_steps: Optional[int] = None,
+                 keep: Optional[int] = None,
+                 meta: Optional[dict] = None):
+        self.config = CheckpointConfig(directory, interval_steps, keep)
+        if not self.config.enabled:
+            raise ValueError(
+                "CheckpointWriter needs a directory (argument or "
+                "HOROVOD_CHECKPOINT_DIR)")
+        from horovod_tpu.common.basics import basics
+
+        self.rank = basics.rank() if basics.is_initialized() else 0
+        self.size = basics.size() if basics.is_initialized() else 1
+        self.meta = dict(meta or {})
+        self.last_committed_step = -1
+        self.last_error: Optional[BaseException] = None
+        self._kill_step = parse_ckpt_kill(
+            os.environ.get("HOROVOD_FAULT_INJECT"), self.rank)
+        os.makedirs(self.config.directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: Optional[tuple] = None
+        self._busy = False
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"ckpt-writer-r{self.rank}", daemon=True)
+        self._thread.start()
+
+    # -- producer side (training thread) --
+
+    def maybe_save(self, step: int, state, sharded=None) -> bool:
+        """Interval-gated :meth:`save` (every ``interval_steps``-th
+        step, counting from step ``interval_steps``)."""
+        if step <= 0 or step % self.config.interval_steps != 0:
+            return False
+        self.save(step, state, sharded)
+        return True
+
+    def save(self, step: int, state, sharded=None) -> None:
+        """Snapshot now (host copies — the double buffer), write async.
+
+        A stored writer-thread failure is SHED here, not raised: a
+        failed attempt usually means a peer died mid-write (the barrier
+        aborted) — raising would make only the SURVIVING ranks skip
+        this save while a relaunched rank performs it, and the next
+        commit barrier would never rendezvous.  ``wait()`` still
+        re-raises, so tests and shutdown paths see persistent failures
+        (disk full) instead of looping silently."""
+        self.last_error = None
+        slots = self._slots_of(state)
+        snap = {k: _host_copy(v) for k, v in slots.items()}
+        sh: Dict[str, Tuple[np.ndarray, int]] = {}
+        for name, (shard, n) in (sharded or {}).items():
+            arr = np.array(np.asarray(shard), copy=True).ravel()
+            sh[name] = (arr, int(n))
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("CheckpointWriter is closed")
+            self._pending = (int(step), snap, sh)
+            self._cv.notify_all()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until the writer is idle with nothing queued; re-raise
+        a writer-thread failure."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._pending is not None or self._busy:
+                rem = None if deadline is None else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    raise TimeoutError("checkpoint writer did not drain")
+                self._cv.wait(rem)
+        if self.last_error is not None:
+            err, self.last_error = self.last_error, None
+            raise err
+
+    def close(self, *, drain: bool = True) -> None:
+        if drain and self._thread.is_alive():
+            try:
+                self.wait(timeout=120)
+            except TimeoutError:
+                pass
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10)
+
+    @staticmethod
+    def _slots_of(state) -> dict:
+        if hasattr(state, "_keys"):  # ElasticState duck type
+            return {k: getattr(state, k) for k in state._keys}
+        if not isinstance(state, dict):
+            raise TypeError(
+                "state must be a dict of named slots or an ElasticState")
+        return dict(state)
+
+    # -- writer thread --
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None and not self._closed:
+                    self._cv.wait()
+                if self._pending is None and self._closed:
+                    return
+                step, snap, sh = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                self._write_and_commit(step, snap, sh)
+            except BaseException as e:  # noqa: BLE001 — surfaced on wait()
+                self.last_error = e
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self._cv.notify_all()
+
+    def _write_and_commit(self, step: int, snap: dict, sh: dict) -> None:
+        t0 = time.monotonic_ns()
+        directory = self.config.directory
+        from horovod_tpu.common.basics import basics as _b
+
+        if _b.is_initialized():
+            # Re-read the identity per attempt: an elastic re-rendezvous
+            # may have resized the world or renumbered this rank since
+            # the writer was constructed.
+            self.rank, self.size = _b.rank(), _b.size()
+        # The begin/commit note pair is what the postmortem's "died at
+        # step S, last durable step C" line reads out of the merged
+        # flight rings — a begin with no commit marks the torn attempt.
+        flight_note("ckpt", f"begin step={step} world={self.size}")
+        failed = 0
+        nbytes = 0
+        sharded_meta, replicated_paths = [], []
+        try:
+            nbytes, sharded_meta, replicated_paths = self._write_shard(
+                step, snap, sh)
+        except Exception as e:  # noqa: BLE001 — reported via the barrier
+            failed = 1
+            self.last_error = e
+        from horovod_tpu.common.basics import basics
+
+        eng = engine_or_none() if basics.is_initialized() else None
+        if eng is not None:
+            # The commit barrier: MAX over every rank's failure flag.
+            # A rank that died mid-write never enqueues — the collective
+            # aborts, no manifest, previous checkpoint stays durable.
+            out = eng.allreduce(np.array([failed], dtype=np.float64),
+                                red_op="max", name=f"ckpt.commit.s{step}")
+            failed = int(out[0])
+        if failed:
+            raise HorovodInternalError(
+                f"checkpoint step {step}: a rank failed to write its "
+                "shard; commit aborted (previous checkpoint remains "
+                "durable)")
+        if self.rank == 0:
+            self._commit_manifest(step, nbytes, sharded_meta,
+                                  replicated_paths)
+        self.last_committed_step = step
+        ns = time.monotonic_ns() - t0
+        note_checkpoint(step, nbytes, ns)
+        flight_note("ckpt", f"commit step={step} bytes={nbytes} "
+                            f"world={self.size}")
+        if self.rank == 0:
+            self._apply_retention()
+
+    def _write_shard(self, step: int, snap: dict, sh: dict):
+        """Serialize this rank's npz (tmp+rename).  Returns (bytes,
+        sharded manifest entries, replicated path list)."""
+        directory = self.config.directory
+        sdir = mf.shard_dir(directory, step)
+        os.makedirs(sdir, exist_ok=True)
+        arrays: Dict[str, np.ndarray] = {}
+        sharded_meta = []
+        for i, (name, (shard, n)) in enumerate(sorted(sh.items())):
+            from horovod_tpu.runtime.sharded import shard_bounds
+
+            bounds = shard_bounds(n, self.size)
+            off, cnt = bounds[self.rank]
+            if shard.size != cnt:
+                raise ValueError(
+                    f"sharded entry '{name}': local shard has "
+                    f"{shard.size} elements but rank {self.rank}/"
+                    f"{self.size} owns {cnt} of n={n}")
+            key = f"sh.{i}"
+            arrays[key] = shard
+            sharded_meta.append({
+                "name": name, "n": n, "dtype": str(shard.dtype),
+                "key": key, "bounds": [list(b) for b in bounds],
+            })
+        replicated_paths = []
+        if self.rank == 0:
+            skip = set(sh)
+
+            def collect(path, leaf):
+                if path not in skip:
+                    arrays[f"rep.{len(replicated_paths)}"] = np.asarray(leaf)
+                    replicated_paths.append(path)
+                return leaf
+
+            for k in sorted(snap):
+                _walk(snap[k], k, collect)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        payload = buf.getvalue()
+        final = mf.shard_file(directory, step, self.rank, self.size)
+        tmp = final + ".tmp"
+        with open(tmp, "wb") as f:
+            # Two-phase write: the injected ckpt-kill fires between the
+            # halves, leaving a REAL torn tmp file on disk — the case the
+            # durability contract must shrug off.
+            half = max(1, len(payload) // 2)
+            f.write(payload[:half])
+            _maybe_fire_ckpt_kill(self._kill_step, step, f)
+            f.write(payload[half:])
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        return len(payload), sharded_meta, replicated_paths
+
+    def _commit_manifest(self, step: int, nbytes: int, sharded_meta,
+                         replicated_paths) -> None:
+        from horovod_tpu.common.basics import basics
+
+        directory = self.config.directory
+        eng = engine_or_none() if basics.is_initialized() else None
+        shards = []
+        for r in range(self.size):
+            path = mf.shard_file(directory, step, r, self.size)
+            shards.append({
+                "file": os.path.relpath(path, directory),
+                "rank": r,
+                "bytes": os.path.getsize(path),
+            })
+        man = {
+            "format": mf.FORMAT_VERSION,
+            "step": int(step),
+            "epoch": int(eng.epoch()) if eng is not None else 0,
+            "world_size": self.size,
+            "meta": self.meta,
+            "shards": shards,
+            "sharded": sharded_meta,
+            "replicated": {"paths": replicated_paths, "file_rank": 0},
+        }
+        final = mf.manifest_path(directory, step)
+        tmp = final + ".tmp"
+        import json
+
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(man, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+
+    def _apply_retention(self) -> None:
+        """Keep the newest ``keep`` committed checkpoints.  Order is the
+        durability contract in reverse: delete the MANIFEST first (the
+        set instantly stops being advertised), then its shards — a crash
+        between the two leaves an orphaned shard dir, never a manifest
+        pointing at deleted shards."""
+        import shutil
+
+        directory = self.config.directory
+        steps = mf.list_manifest_steps(directory)
+        for step in steps[:-self.config.keep] if len(steps) > \
+                self.config.keep else []:
+            try:
+                os.unlink(mf.manifest_path(directory, step))
+            except OSError:
+                pass
+            shutil.rmtree(mf.shard_dir(directory, step),
+                          ignore_errors=True)

@@ -439,6 +439,14 @@ int64_t horovod_flight_dumps() {
 int horovod_flight_dump(const char* reason) {
   return Engine::Get().FlightDump(reason ? reason : "manual dump");
 }
+// Python-plane events (checkpoint commits/restores, weight pushes)
+// recorded into the same ring as aborts/link events, so postmortem
+// merges them into one timeline.  Cycle 0: these events originate
+// outside the coordinator's control cycle.
+void horovod_flight_note(const char* kind, const char* text) {
+  hvd::GlobalFlightRecorder().Record(kind ? kind : "note", 0, "%s",
+                                     text ? text : "");
+}
 
 // Why the engine aborted, copied into buf (truncated to buflen-1); empty
 // while the engine is healthy or after a clean shutdown.  Lets callers

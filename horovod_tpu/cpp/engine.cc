@@ -955,6 +955,16 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
             }
           }
         }
+      } else if (fkind == "ckpt-kill") {
+        // rank:step:ckpt-kill — Python-owned: the checkpoint writer
+        // parses the shared schedule itself and SIGKILLs mid-shard-write
+        // (the kill must land between the tmp file's two half-writes,
+        // which only the writer can time).  Accept the kind silently so
+        // the shared parser does not warn, and keep scanning for an
+        // engine-side kind on this rank.
+        fault_step_ = -1;
+        fault_kind_ = FaultKind::NONE;
+        continue;
       } else if (fkind == "recv-stall") {
         // rank:step:recv-stall:ms — the next cascade on this rank stops
         // draining one channel for ms (a transient stall, not a dead
@@ -968,7 +978,7 @@ int Engine::Init(int rank, int size, int local_rank, int local_size,
         std::fprintf(stderr,
                      "horovod_tpu: unknown HOROVOD_FAULT_INJECT kind '%s' "
                      "(want exit|hang|drop-conn|stale-epoch|slow|"
-                     "conn-reset|recv-stall); ignored\n",
+                     "conn-reset|recv-stall|ckpt-kill); ignored\n",
                      fkind.c_str());
         fault_step_ = -1;
         fault_kind_ = FaultKind::NONE;

@@ -91,6 +91,21 @@ def run_elastic(train_fn: Callable[[ElasticState], object],
                         f"epoch={basics.epoch()} rank={basics.rank()} "
                         f"size={basics.size()}",
                         file=sys.stderr, flush=True)
+            ckpt_dir = os.environ.get("HOROVOD_CHECKPOINT_DIR",
+                                      "").strip()
+            if ckpt_dir:
+                # Disk beats memory only when rank 0 (the sync
+                # authority) lost progress — a full-fleet relaunch, or
+                # rank 0 itself died.  Collective: every rank takes the
+                # same branch (checkpoint/elastic.py).
+                from horovod_tpu.checkpoint import maybe_restore
+
+                restored = maybe_restore(state, ckpt_dir)
+                if restored is not None:
+                    print(
+                        "horovod_tpu elastic: restored from checkpoint "
+                        f"step {restored} ({ckpt_dir})",
+                        file=sys.stderr, flush=True)
             state.sync()
             commits_at_entry = state.commit_count
             return train_fn(state)

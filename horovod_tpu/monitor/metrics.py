@@ -132,6 +132,19 @@ STATS_METRICS: List[Metric] = [
            "link-heal suspect-to-healed duration p99"),
     Metric("clock_offset_ns", "horovod_clock_offset_ns", "gauge",
            "rendezvous-estimated monotonic clock offset to rank 0"),
+    Metric("checkpoint_bytes", "horovod_checkpoint_bytes_total", "counter",
+           "bytes written into committed checkpoint shards by this rank"),
+    Metric("checkpoint_restores", "horovod_checkpoint_restores_total",
+           "counter", "restores completed from a checkpoint manifest"),
+    Metric("weight_push_count", "horovod_weight_push_count_total",
+           "counter", "live trainer→serve weight pushes sent"),
+    Metric("checkpoint_ns_p50", "horovod_checkpoint_ns_p50", "gauge",
+           "off-path checkpoint write+commit wall time p50 "
+           "(sliding window)"),
+    Metric("checkpoint_ns_p99", "horovod_checkpoint_ns_p99", "gauge",
+           "off-path checkpoint write+commit wall time p99"),
+    Metric("last_checkpoint_step", "horovod_last_checkpoint_step", "gauge",
+           "step of the last committed (durable) checkpoint manifest"),
 ]
 
 

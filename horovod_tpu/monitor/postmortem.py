@@ -60,6 +60,10 @@ def analyze(dumps: Dict[int, dict], world_size: Optional[int] = None) -> dict:
     # suspect/healed events before the abort say the link was unstable
     # long before the fatal failure.
     link_events: Dict[int, Dict[str, int]] = {}
+    # Checkpoint history per rank, from the weight plane's begin/commit/
+    # restore notes: the "died at step S, last durable step C" readout.
+    ckpt_events: Dict[int, Dict[str, int]] = {}
+    ckpt_step_re = re.compile(r"step=(\d+)")
     merged: List[Tuple[int, int, dict]] = []  # (aligned_ns, rank, event)
     for rank, d in sorted(dumps.items()):
         offset = int(d.get("clock_offset_ns", 0))
@@ -75,6 +79,21 @@ def analyze(dumps: Dict[int, dict], world_size: Optional[int] = None) -> dict:
                 for key in lk:
                     if text.startswith(key):
                         lk[key] += 1
+            if e.get("kind") == "ckpt":
+                text = e.get("text", "")
+                m = ckpt_step_re.search(text)
+                if m:
+                    s = int(m.group(1))
+                    ck = ckpt_events.setdefault(
+                        rank, {"last_attempt": -1, "last_durable": -1,
+                               "restores": 0})
+                    if text.startswith("commit"):
+                        ck["last_durable"] = max(ck["last_durable"], s)
+                        ck["last_attempt"] = max(ck["last_attempt"], s)
+                    elif text.startswith("begin"):
+                        ck["last_attempt"] = max(ck["last_attempt"], s)
+                    elif text.startswith("restore"):
+                        ck["restores"] += 1
             if e.get("kind") == "abort":
                 text = e.get("text", "")
                 verdicts.append(f"rank {rank}: {text}")
@@ -110,6 +129,7 @@ def analyze(dumps: Dict[int, dict], world_size: Optional[int] = None) -> dict:
         "last_committed_cycle": min(last_cycle.values()) if last_cycle
         else 0,
         "link_events": link_events,
+        "ckpt_events": ckpt_events,
         "merged": merged,
     }
 
@@ -145,6 +165,29 @@ def format_report(result: dict, tail: int = 60) -> str:
             ("; the fatal failure followed earlier healed blips"
              if healed and (escal or result["culprit"] is not None)
              else ""))
+    ckpt = result.get("ckpt_events") or {}
+    if ckpt:
+        durable = max((v["last_durable"] for v in ckpt.values()),
+                      default=-1)
+        attempt = max((v["last_attempt"] for v in ckpt.values()),
+                      default=-1)
+        if durable >= 0:
+            died = (f"died at step {attempt}" if attempt > durable
+                    else f"died at or after step {durable}")
+            lines.append(
+                f"checkpoint: {died}, last durable step {durable} — a "
+                f"relaunch resumes from {durable}; work after it is "
+                "recomputed, never torn")
+        elif attempt >= 0:
+            lines.append(
+                f"checkpoint: died at step {attempt} with NO durable "
+                "commit — the write began but the commit barrier never "
+                "passed (previous manifest, if any, stays authoritative)")
+        restores = sum(v["restores"] for v in ckpt.values())
+        if restores:
+            lines.append(f"checkpoint: {restores} restore(s) recorded "
+                         "before the failure (an earlier incarnation "
+                         "already recovered once)")
     per = ", ".join(f"rank {r}={c}" for r, c in
                     sorted(result["last_cycle"].items()))
     lines.append(

@@ -122,9 +122,19 @@ def main(argv=None) -> int:
                              "engine world; --restart-on-failure is the "
                              "per-fleet relaunch budget on replica death)")
     parser.add_argument("--serve-model", default=None, metavar="NAME",
-                        help="served model config under --serve "
-                             "(LlamaConfig.<NAME>; default: "
-                             "HOROVOD_SERVE_MODEL or tiny)")
+                        help="served model under --serve: a LlamaConfig "
+                             "name (LlamaConfig.<NAME>) OR a checkpoint "
+                             "directory (replicas load the newest "
+                             "complete manifest's weights instead of "
+                             "seeded params; default: HOROVOD_SERVE_MODEL "
+                             "or tiny)")
+    parser.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                        help="set HOROVOD_CHECKPOINT_DIR for every "
+                             "worker: training built on run_elastic "
+                             "saves crash-consistent sharded checkpoints "
+                             "there and a relaunched/resized world "
+                             "resumes from the newest complete manifest "
+                             "(docs/checkpointing.md)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="command to run (prefix with --)")
     args = parser.parse_args(argv)
@@ -187,6 +197,8 @@ def main(argv=None) -> int:
             # elastic membership; the engine's coordinator commits the
             # actual (epoch, rank, size) at rendezvous.
             env["HOROVOD_ELASTIC"] = "1"
+        if args.checkpoint_dir:
+            env["HOROVOD_CHECKPOINT_DIR"] = args.checkpoint_dir
         if scrub_fault_inject:
             # A relaunched incarnation must not re-fire the injected
             # fault at the same step, or the job would never converge.

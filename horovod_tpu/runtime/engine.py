@@ -123,6 +123,28 @@ def note_sharded_step() -> None:
         fn()
 
 
+def flight_note(kind: str, text: str) -> None:
+    """Record a Python-plane event (e.g. a checkpoint commit/restore)
+    into the C++ flight recorder's ring, so postmortem merges it into
+    the same timeline as aborts and link events (no-op when no engine
+    is loaded or against a stale prebuilt .so)."""
+    global _engine
+    eng = _engine
+    if eng is None:
+        return
+    fn = getattr(eng._lib, "horovod_flight_note", None)
+    if fn is not None and getattr(fn, "restype", "?") is None:
+        fn(str(kind).encode()[:15], str(text).encode()[:160])
+
+
+def _checkpoint_stats() -> dict:
+    """The checkpoint plane's stats() slice (lazy import: the plane
+    imports this module for its commit barrier)."""
+    from horovod_tpu.checkpoint.stats import checkpoint_stats
+
+    return checkpoint_stats()
+
+
 def _dtype_code(dtype) -> int:
     name = np.dtype(dtype).name if np.dtype(dtype).name in _DTYPE_CODES \
         else str(dtype)
@@ -322,6 +344,13 @@ class NativeEngine:
             lib.horovod_flight_dump.restype = ctypes.c_int
         except AttributeError:
             pass  # stale .so: fleet_stats()/flight_dump() degrade
+        try:
+            lib.horovod_flight_note.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p,
+            ]
+            lib.horovod_flight_note.restype = None
+        except AttributeError:
+            pass  # stale .so: checkpoint events skip the flight ring
 
     # -- naming (auto names must be identical across ranks, which holds when
     #    ranks enqueue in the same program order — same contract as the
@@ -702,6 +731,9 @@ class NativeEngine:
             "wire_int8_count": self._lib.horovod_wire_int8_count(),
             "wire_fp8_count": self._lib.horovod_wire_fp8_count(),
             "sparse_count": _SPARSE_COUNT,
+            # The checkpoint plane's counters (Python-side, like
+            # sparse_count: the writer thread lives above the engine).
+            **_checkpoint_stats(),
             "topology": {
                 "hosts": self._lib.horovod_topology_hosts(),
                 "local_ranks": self._lib.horovod_topology_local_ranks(),
@@ -778,7 +810,10 @@ class NativeEngine:
                      "step_time_ns_p99",
                      "quorum_lag_ns_p50",
                      "quorum_lag_ns_p99",
-                     "clock_offset_ns"):
+                     "clock_offset_ns",
+                     "checkpoint_ns_p50",
+                     "checkpoint_ns_p99",
+                     "last_checkpoint_step"):
                 delta[k] = v
                 continue
             delta[k] = v - since.get(k, 0)

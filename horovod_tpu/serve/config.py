@@ -50,6 +50,7 @@ class ServeConfig:
     model: str = "tiny"
     dtype: str = ""                 # "" = the model config's own dtype
     param_seed: int = 0
+    checkpoint: str = ""            # "" = seeded params, no checkpoint
     block_size: int = 16
     kv_blocks: int = 64
     max_model_len: int = 256
@@ -84,6 +85,7 @@ class ServeConfig:
             model=environ.get("HOROVOD_SERVE_MODEL", "tiny"),
             dtype=environ.get("HOROVOD_SERVE_DTYPE", ""),
             param_seed=_int_env(environ, "HOROVOD_SERVE_PARAM_SEED", 0),
+            checkpoint=environ.get("HOROVOD_SERVE_CHECKPOINT", "").strip(),
             block_size=block,
             kv_blocks=max(1, _int_env(environ, "HOROVOD_SERVE_KV_BLOCKS",
                                       blocks_dflt)),
@@ -114,6 +116,10 @@ SERVE_KNOBS = [
     ("HOROVOD_SERVE_PARAM_SEED", "0", "param_seed",
      "deterministic parameter seed — every replica builds identical "
      "weights from it"),
+    ("HOROVOD_SERVE_CHECKPOINT", "(unset: seeded params)", "checkpoint",
+     "checkpoint directory: replicas load the newest complete "
+     "manifest's params instead of seeding (run.py --serve-model "
+     "<dir> sets it)"),
     ("HOROVOD_SERVE_BLOCK_SIZE", "16", "block_size",
      "paged KV-cache block size in tokens (forced to a power of two)"),
     ("HOROVOD_SERVE_KV_BLOCKS", "auto: max_batch*max_len/2", "kv_blocks",

@@ -20,9 +20,12 @@ serve streams bit-identical to offline ``jax.jit(generate)`` at
 (``tests/test_serve.py`` pins paged ≡ contiguous and serve ≡ offline).
 
 Parameters are built deterministically from ``HOROVOD_SERVE_PARAM_SEED``
-so every replica serves identical weights without shipping a checkpoint
-(a checkpointed deployment would load the same pytree via
-``horovod_tpu.flax.checkpoint`` instead — docs/serving.md).
+so every replica serves identical weights without shipping a checkpoint;
+a checkpointed deployment sets ``HOROVOD_SERVE_CHECKPOINT`` (what
+``run.py --serve --serve-model <dir>`` does) and every replica loads
+the newest complete manifest's ``params`` tree instead — trained
+weights at boot, with live trainer pushes layering on top
+(docs/checkpointing.md).
 """
 
 from __future__ import annotations
@@ -76,6 +79,10 @@ class ModelRunner:
         dummy = jnp.zeros((1, 8), jnp.int32)
         self.variables = model.init(jax.random.key(serve_cfg.param_seed),
                                     dummy)
+        #: manifest step the params came from (None = seeded params)
+        self.checkpoint_step = None
+        if serve_cfg.checkpoint:
+            self._restore_checkpoint(serve_cfg.checkpoint)
         self.block_size = serve_cfg.block_size
         self.max_blocks_per_seq = serve_cfg.max_blocks_per_seq
         #: pool blocks INCLUDING the reserved trash block 0
@@ -87,6 +94,29 @@ class ModelRunner:
         self._prefill_fns: Dict[int, object] = {}
         self._decode_fns: Dict[int, object] = {}
         self.compilations = 0
+
+    def _restore_checkpoint(self, directory: str) -> None:
+        """Replace the seeded params with the newest complete
+        checkpoint's ``params`` tree (walk-path fill: shape-checked per
+        leaf, cast into the model's own dtype).  Raises loudly on a
+        torn/absent checkpoint or a geometry mismatch — serving random
+        weights silently is worse than not starting."""
+        from horovod_tpu.checkpoint import CheckpointError, CheckpointLoader
+
+        loader = CheckpointLoader(directory)
+        try:
+            if "params" not in loader.slot_names():
+                raise CheckpointError(
+                    f"checkpoint step {loader.step} in {directory} has "
+                    f"no 'params' slot (slots: {loader.slot_names()}) — "
+                    "was it written by a trainer capture?")
+            variables = dict(self.variables)
+            variables["params"] = loader.restore_tree(
+                variables["params"], "params")
+            self.variables = variables
+            self.checkpoint_step = loader.step
+        finally:
+            loader.close()
 
     # -- jit caches --
 

@@ -24,6 +24,13 @@ shrink/rejoin cycle (docs/elastic.md):
 
 With every replica down and no budget left, queued requests fail with a
 clean error — the router never hangs a client.
+
+Live weight pushes (``{"op": "weights", ...}``, produced by
+``horovod_tpu.checkpoint.push.WeightPusher``) fan out to every live
+replica, which hot-swaps between decode iterations under the frame's
+generation epoch; the router caches the LATEST frame and replays it to
+a relaunched replica before it takes load, so a rejoin serves the
+current pushed epoch — never boot-time params (docs/checkpointing.md).
 """
 
 from __future__ import annotations
@@ -61,8 +68,9 @@ class _Replica:
         #: router's queue-parking hope is "any replica not terminal".
         self.terminal = False
         self.stats_waiter: Optional[asyncio.Future] = None
-        # Serializes stats exchanges: concurrent clients must not
-        # clobber each other's waiter future.
+        self.weights_waiter: Optional[asyncio.Future] = None
+        # Serializes request/reply exchanges (stats, weight pushes):
+        # concurrent clients must not clobber each other's waiter.
         self.stats_lock = asyncio.Lock()
 
 
@@ -101,8 +109,13 @@ class Router:
         self.counters = {
             "dispatched": 0, "completed": 0, "requeued": 0,
             "replica_deaths": 0, "rejoins": 0, "failed": 0,
-            "cancelled": 0, "wedged_kills": 0,
+            "cancelled": 0, "wedged_kills": 0, "weight_pushes": 0,
+            "weight_replays": 0,
         }
+        #: the latest weights frame pushed through the router, replayed
+        #: to every relaunched replica BEFORE it takes load (a rejoin
+        #: must serve the current epoch, not boot-time params).
+        self._last_push: Optional[dict] = None
         # Liveness probes for WEDGED (not dead) replicas: a replica whose
         # scheduler thread hangs keeps its socket open and its asyncio
         # front-end answering, so death detection alone never fires.  The
@@ -158,8 +171,11 @@ class Router:
         rep.port = await asyncio.wait_for(ready, timeout=300)
         for attempt in range(50):
             try:
+                # The stream limit must fit a whole weights frame (one
+                # JSON line carrying a base64 model) — the 64 KiB
+                # default readline cap would sever the connection.
                 rep.reader, rep.writer = await asyncio.open_connection(
-                    "127.0.0.1", rep.port)
+                    "127.0.0.1", rep.port, limit=1 << 26)
                 break
             except OSError:
                 await asyncio.sleep(0.1)
@@ -196,6 +212,19 @@ class Router:
                 rep.terminal = True
                 self._fail_queue_if_hopeless()
             return
+        if self._last_push is not None:
+            # The relaunched replica rebuilt BOOT-TIME params (seed or
+            # checkpoint); replay the latest pushed frame before it
+            # takes load so the whole fleet serves one weight epoch.
+            ack = await self._push_weights_rep(rep, self._last_push)
+            if ack is not None:
+                self.counters["weight_replays"] += 1
+            else:
+                sys.stderr.write(
+                    f"replica {rep.idx} rejoined but the weight replay "
+                    f"failed; it may serve a stale epoch until the "
+                    f"next push\n")
+                sys.stderr.flush()
         self.counters["rejoins"] += 1
         self._drain_queue()
 
@@ -231,6 +260,9 @@ class Router:
                 pass
         if rep.stats_waiter is not None and not rep.stats_waiter.done():
             rep.stats_waiter.set_result(None)
+        if rep.weights_waiter is not None \
+                and not rep.weights_waiter.done():
+            rep.weights_waiter.set_result(None)
         orphans = list(rep.pending)
         rep.pending.clear()
         for rid in orphans:
@@ -252,6 +284,11 @@ class Router:
                     if rep.stats_waiter is not None \
                             and not rep.stats_waiter.done():
                         rep.stats_waiter.set_result(ev["stats"])
+                    continue
+                if ev.get("event") == "weights_ack":
+                    if rep.weights_waiter is not None \
+                            and not rep.weights_waiter.done():
+                        rep.weights_waiter.set_result(ev)
                     continue
                 if ev.get("event") == "pong":
                     # Healthy = the asyncio side answered AND the
@@ -333,6 +370,28 @@ class Router:
         self._queue.clear()
         for rid in pending:
             self._dispatch(rid)
+
+    # -- live weight pushes --
+
+    async def _push_weights_rep(self, rep: _Replica, frame: dict,
+                                timeout: float = 90.0) -> Optional[dict]:
+        """One replica's weights exchange; ``None`` on death or timeout
+        (the death path owns the failure — its requests requeue and the
+        cached frame replays on the relaunch)."""
+        if not rep.alive:
+            return None
+        async with rep.stats_lock:
+            rep.weights_waiter = asyncio.get_running_loop() \
+                .create_future()
+            try:
+                rep.writer.write((json.dumps(frame) + "\n").encode())
+                await rep.writer.drain()
+                return await asyncio.wait_for(rep.weights_waiter,
+                                              timeout=timeout)
+            except (asyncio.TimeoutError, OSError):
+                return None
+            finally:
+                rep.weights_waiter = None
 
     # -- liveness probes (wedged-replica detection) --
 
@@ -425,6 +484,26 @@ class Router:
                             self._queue.remove(rid)
                             client.emit({"event": "cancelled", "id": want})
                             self._forget(rid)
+                elif op == "weights":
+                    frame = {"op": "weights",
+                             "frames": msg.get("frames") or [],
+                             "epoch": int(msg.get("epoch", 0))}
+                    # Cache FIRST: a replica that dies mid-push gets
+                    # the frame replayed when it rejoins.
+                    self._last_push = frame
+                    self.counters["weight_pushes"] += 1
+                    acks = []
+                    for rep in self.replicas:
+                        ack = await self._push_weights_rep(rep, frame)
+                        if ack is not None:
+                            acks.append({
+                                "replica": rep.idx,
+                                "applied": ack.get("applied"),
+                                "epoch": ack.get("epoch"),
+                                "restarted": ack.get("restarted")})
+                    client.emit({"event": "weights_ack",
+                                 "epoch": frame["epoch"],
+                                 "replicas": acks})
                 elif op == "stats":
                     client.emit({"event": "stats",
                                  "stats": await self._gather_stats()})
@@ -506,8 +585,9 @@ class Router:
                     rep.proc.kill()
                     await rep.proc.wait()
             raise
+        # limit: a weights push is one (large) JSON line from a client.
         server = await asyncio.start_server(self._handle_client, self.host,
-                                            self.port)
+                                            self.port, limit=1 << 26)
         if self.probe_sec > 0:
             self._tasks.append(asyncio.ensure_future(self._probe_loop()))
         port = server.sockets[0].getsockname()[1]
@@ -572,10 +652,34 @@ class Router:
 
 
 def serve_main(args) -> int:
-    """The ``run.py --serve`` entry: router + replica fleet."""
+    """The ``run.py --serve`` entry: router + replica fleet.
+
+    ``--serve-model`` is EITHER a LlamaConfig builder name or a
+    checkpoint directory: a directory containing manifests makes every
+    replica load the newest complete checkpoint's params
+    (HOROVOD_SERVE_CHECKPOINT) — the model name rides the manifest's
+    ``meta.model`` when the trainer recorded one.
+    """
     replica_env = {}
-    if getattr(args, "serve_model", None):
-        replica_env["HOROVOD_SERVE_MODEL"] = args.serve_model
+    model_arg = getattr(args, "serve_model", None)
+    if model_arg and os.path.isdir(model_arg):
+        from horovod_tpu.checkpoint import latest_manifest
+
+        found = latest_manifest(model_arg)
+        if found is None:
+            sys.stderr.write(
+                f"--serve-model {model_arg}: directory holds no "
+                "complete checkpoint manifest\n")
+            return 1
+        manifest, step = found
+        replica_env["HOROVOD_SERVE_CHECKPOINT"] = model_arg
+        meta_model = (manifest.get("meta") or {}).get("model")
+        if meta_model:
+            replica_env["HOROVOD_SERVE_MODEL"] = str(meta_model)
+        print(f"serving checkpoint step {step} from {model_arg}",
+              flush=True)
+    elif model_arg:
+        replica_env["HOROVOD_SERVE_MODEL"] = model_arg
     router = Router(
         num_replicas=max(1, args.replicas),
         restart_budget=max(0, args.restart_on_failure),

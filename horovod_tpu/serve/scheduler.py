@@ -28,6 +28,15 @@ Thread model: ``run()`` owns the model; ``submit``/``cancel``/``stats``
 are thread-safe and non-blocking.  Token events are delivered through
 the per-request ``emit`` callback FROM THE SCHEDULER THREAD — the
 server wraps it with ``loop.call_soon_threadsafe``.
+
+Live weight swaps: ``swap_weights(epoch, frames)`` (thread-safe,
+blocking) parks a decoded-on-arrival weight push that the scheduler
+applies at the NEXT step boundary — never inside a decode — under a
+monotonic generation epoch.  Every in-flight sequence restarts from
+its original prompt on the new weights (a ``requeued`` frame, same
+client contract as a replica death), so a finished stream's ``tokens``
+are always the product of exactly ONE weight epoch — no mixed-epoch
+continuations.  Stale pushes (epoch <= current) ack without applying.
 """
 
 from __future__ import annotations
@@ -122,6 +131,11 @@ class Scheduler:
         self._new: deque = deque()
         self._cancelled: set = set()
         self._stop = False
+        # Live weight push (trainer→serve): the pending swap is a
+        # latest-wins slot applied at the next STEP BOUNDARY, never
+        # mid-decode; _weight_epoch stamps every token/done event.
+        self._weight_epoch = 0
+        self._pending_weights: Optional[dict] = None
         self._waiting: deque[_Seq] = deque()
         self._running: List[_Seq] = []
         self._next_sid = 1
@@ -143,6 +157,7 @@ class Scheduler:
             "decode_steps": 0,
             "decode_seq_steps": 0,
             "tokens_streamed": 0,
+            "weight_swaps": 0,
         }
 
     # -- thread-safe API --
@@ -163,6 +178,42 @@ class Scheduler:
             self._stop = True
             self._wake.notify()
 
+    def swap_weights(self, epoch: int, frames: list,
+                     timeout: float = 60.0) -> dict:
+        """Hot-swap the served weights (thread-safe, BLOCKING).
+
+        ``frames`` are wire frames from
+        :func:`horovod_tpu.checkpoint.push.encode_leaves`; decode
+        happens here (caller's thread) so the scheduler thread only
+        pays the apply.  Blocks until the scheduler thread installs
+        them at a step boundary and restarts every in-flight sequence,
+        then returns ``{"applied", "epoch", "restarted"}``.  A stale
+        epoch (<= the installed one) or a stopped scheduler acks with
+        ``applied=False``; only the LATEST concurrent push wins a race
+        (the superseded caller is released with ``applied=False``).
+        """
+        from horovod_tpu.checkpoint.push import decode_leaves
+
+        pending = {"epoch": int(epoch), "by_path": decode_leaves(frames),
+                   "done": threading.Event(), "applied": False,
+                   "restarted": 0}
+        with self._wake:
+            if self._stop:
+                pending["done"].set()
+            else:
+                stale = self._pending_weights
+                if stale is not None:
+                    stale["done"].set()   # superseded, never applied
+                self._pending_weights = pending
+                self._wake.notify()
+        if not pending["done"].wait(timeout=timeout):
+            raise TimeoutError(
+                f"weight swap to epoch {epoch} not applied in "
+                f"{timeout:.0f}s (scheduler thread wedged?)")
+        return {"applied": pending["applied"],
+                "epoch": self._weight_epoch,
+                "restarted": pending["restarted"]}
+
     def stats(self) -> dict:
         with self._lock:
             c = dict(self._c)
@@ -176,6 +227,7 @@ class Scheduler:
             c["decode_seq_steps"] / c["decode_steps"]
             if c["decode_steps"] else 0.0)
         out["tokens_per_sec"] = c["tokens_streamed"] / elapsed
+        out["weight_epoch"] = self._weight_epoch
         out.update(self.kv.stats())
         out["tune_trials"] = self._tuner.trials if self._tuner else 0
         out["config"] = {
@@ -186,6 +238,8 @@ class Scheduler:
             "max_model_len": self.cfg.max_model_len,
             "model": self.cfg.model,
             "autotune": int(self._tuner is not None),
+            "checkpoint_step": getattr(self.runner, "checkpoint_step",
+                                       None),
         }
         return out
 
@@ -200,7 +254,7 @@ class Scheduler:
                     self._drain_all_locked()
                     return
                 if not (self._new or self._waiting or self._running
-                        or self._cancelled):
+                        or self._cancelled or self._pending_weights):
                     self._wake.wait(timeout=0.05)
                     continue
             self.step()
@@ -213,6 +267,7 @@ class Scheduler:
         prefill/decode call — keeps beating between phases, while a
         genuinely wedged phase freezes the beat."""
         self.last_beat = time.monotonic()
+        self._apply_weight_swap()
         self._intake()
         self._apply_cancellations()
         max_batch = max(1, int(self.max_batch))
@@ -228,6 +283,43 @@ class Scheduler:
             self._tuner.on_step()
 
     # -- internals (scheduler thread only) --
+
+    def _apply_weight_swap(self) -> None:
+        """Install a parked weight push at the step boundary: swap the
+        runner's variables, then restart every in-flight sequence from
+        its ORIGINAL prompt so no finished stream ever mixes tokens
+        from two weight epochs.  The restart reuses the death-requeue
+        client contract: a ``requeued`` frame, then the token stream
+        starts over at index 0."""
+        with self._lock:
+            pending = self._pending_weights
+            self._pending_weights = None
+        if pending is None:
+            return
+        if pending["epoch"] <= self._weight_epoch:
+            pending["done"].set()   # stale replay: ack without applying
+            return
+        from horovod_tpu.checkpoint.push import apply_leaves
+
+        self.runner.variables = apply_leaves(self.runner.variables,
+                                             pending["by_path"])
+        self._weight_epoch = pending["epoch"]
+        self._c["weight_swaps"] += 1
+        restarted = 0
+        for seq in list(self._running):
+            self._running.remove(seq)
+            self.kv.free(seq.sid)
+            # Restart from scratch, NOT a preemption resume: a resumed
+            # prefix would replay old-epoch tokens through new weights.
+            seq.out.clear()
+            seq.emit({"event": "requeued", "id": seq.req.id,
+                      "reason": "weights",
+                      "weight_epoch": self._weight_epoch})
+            self._waiting.appendleft(seq)
+            restarted += 1
+        pending["applied"] = True
+        pending["restarted"] = restarted
+        pending["done"].set()
 
     def _intake(self) -> None:
         with self._lock:
@@ -367,7 +459,7 @@ class Scheduler:
         seq.out.append(tok)
         self._c["tokens_streamed"] += 1
         seq.emit({"event": "token", "id": seq.req.id, "token": tok,
-                  "index": index})
+                  "index": index, "weight_epoch": self._weight_epoch})
 
     def _finish(self, seq: _Seq, cancelled: bool = False) -> None:
         if cancelled:
@@ -376,10 +468,14 @@ class Scheduler:
             return
         self._c["requests_completed"] += 1
         seq.emit({"event": "done", "id": seq.req.id, "tokens": seq.out,
-                  "preemptions": seq.preemptions})
+                  "preemptions": seq.preemptions,
+                  "weight_epoch": self._weight_epoch})
 
     def _drain_all_locked(self) -> None:
         """On stop: fail whatever is still queued so no caller hangs."""
+        if self._pending_weights is not None:
+            self._pending_weights["done"].set()   # applied stays False
+            self._pending_weights = None
         for seq in list(self._running) + list(self._waiting):
             seq.emit({"event": "error", "id": seq.req.id,
                       "error": "replica shutting down"})

@@ -11,6 +11,7 @@ Requests (one JSON object per line)::
     {"op": "cancel", "id": "r1"}
     {"op": "stats"}
     {"op": "ping"}
+    {"op": "weights", "epoch": 3, "frames": [...]}
     {"op": "shutdown"}
 
 Streamed responses (interleaved across in-flight requests)::
@@ -19,9 +20,13 @@ Streamed responses (interleaved across in-flight requests)::
     {"event": "done", "id": "r1", "tokens": [...], "preemptions": 0}
     {"event": "error", "id": "r1", "error": "..."}
     {"event": "cancelled", "id": "r1"}
-    {"event": "requeued", "id": "r1"}   # router only: stream restarts
+    {"event": "requeued", "id": "r1"}   # stream restarts (replica
+                                        # death via the router, or a
+                                        # live weight swap in place)
     {"event": "stats", "stats": {...}}
     {"event": "pong", "sched_age_sec": 0.004}
+    {"event": "weights_ack", "epoch": 3, "applied": true,
+     "restarted": 2}
 
 Tokens stream as they are produced by the continuous-batching scheduler;
 after a replica death the router re-queues the request and the token
@@ -59,7 +64,10 @@ class ReplicaServer:
         self._conns: set = set()
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
-        self._server = await asyncio.start_server(self._handle, host, port)
+        # limit: a weights frame is one JSON line carrying a base64
+        # model — far over the 64 KiB readline default.
+        self._server = await asyncio.start_server(self._handle, host, port,
+                                                  limit=1 << 26)
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
 
@@ -152,6 +160,22 @@ class ReplicaServer:
                         "sched_age_sec": round(
                             time.monotonic() - self.scheduler.last_beat,
                             3)})
+                elif op == "weights":
+                    # Live trainer→serve push: decode + apply happen on
+                    # the scheduler's step boundary; swap_weights BLOCKS
+                    # until installed, so run it off the event loop (the
+                    # front-end keeps answering pings while the swap
+                    # parks).
+                    try:
+                        ack = await loop.run_in_executor(
+                            None, self.scheduler.swap_weights,
+                            int(msg.get("epoch", 0)),
+                            msg.get("frames") or [])
+                        outbox.put_nowait({"event": "weights_ack", **ack})
+                    except (TimeoutError, ValueError, KeyError) as e:
+                        outbox.put_nowait({"event": "error", "id": None,
+                                           "error": f"weights push "
+                                                    f"failed: {e}"})
                 elif op == "shutdown":
                     outbox.put_nowait({"event": "bye"})
                     self.shutdown()
@@ -191,6 +215,13 @@ class ServeClient:
     def __init__(self, host: str, port: int, timeout: float = 120.0):
         self.timeout = timeout
         self._sock = socket.create_connection((host, port), timeout=timeout)
+        # timeout bounds the CONNECT only.  An established connection
+        # must tolerate arbitrary idle (a caller may sit between
+        # requests far longer than any per-request deadline); left in
+        # place, the recv timeout fires in the reader thread on an idle
+        # socket and falsely marks the connection dead.  Deadlines are
+        # enforced per-request in collect()/_wait_plain() instead.
+        self._sock.settimeout(None)
         self._file = self._sock.makefile("rb")
         self._wlock = threading.Lock()
         self._qlock = threading.Lock()
@@ -272,6 +303,9 @@ class ServeClient:
     def _plain_request(self, op: str, want_event: str,
                        timeout: float = 30.0) -> dict:
         self._send({"op": op})
+        return self._wait_plain(want_event, timeout)
+
+    def _wait_plain(self, want_event: str, timeout: float) -> dict:
         deadline = time.monotonic() + timeout
         while True:
             while self._plain:
@@ -288,6 +322,15 @@ class ServeClient:
     def stats(self) -> dict:
         return self._plain_request("stats", "stats")["stats"]
 
+    def push_weights(self, frames: list, epoch: int,
+                     timeout: float = 120.0) -> dict:
+        """Push wire frames (checkpoint.push.encode_leaves) and block
+        for the ``weights_ack`` — works against a replica directly (one
+        hot-swap) or the router (fan-out to the whole fleet)."""
+        self._send({"op": "weights", "frames": list(frames),
+                    "epoch": int(epoch)})
+        return self._wait_plain("weights_ack", timeout)
+
     def ping(self) -> None:
         self._plain_request("ping", "pong")
 
@@ -298,6 +341,15 @@ class ServeClient:
             pass
 
     def close(self) -> None:
+        # shutdown() FIRST: the reader thread blocks in readinto()
+        # holding the BufferedReader lock, and _file.close() takes that
+        # same lock — without the wakeup (recv returns EOF) close would
+        # deadlock against our own reader.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._reader.join(timeout=10)
         # makefile() dup'd the fd: both must close or the server never
         # sees EOF (and never cancels this client's in-flight work).
         for closer in (self._file.close, self._sock.close):

@@ -68,6 +68,13 @@ def pytest_configure(config):
         "(HOROVOD_PRIORITY_BANDS ordering/fusion/wave contracts); ci.sh "
         "runs them in the overlap gate under a hard timeout (main sweep "
         "excludes the marker, tier-1 still runs them)")
+    config.addinivalue_line(
+        "markers",
+        "ckpt: weight-plane tests (crash-consistent sharded saves, "
+        "elastic resharding restore, kill-and-resume, live serve push); "
+        "ci.sh runs them in the checkpoint gate under a hard timeout "
+        "(main sweep excludes the marker; tier-1 runs the ones not "
+        "also marked slow — the serve-fleet pushes are slow-marked)")
 
 
 @pytest.fixture(scope="session")

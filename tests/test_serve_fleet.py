@@ -22,6 +22,7 @@ import pytest
 
 import jax.numpy as jnp
 
+from horovod_tpu.checkpoint import CheckpointWriter, WeightPusher
 from horovod_tpu.models.generation import generate
 from horovod_tpu.serve.config import ServeConfig
 from horovod_tpu.serve.engine import ModelRunner
@@ -62,17 +63,62 @@ def offline():
     return gen
 
 
+@pytest.fixture(scope="module")
+def ref():
+    """``(variables, gen)`` — jitted offline generate over ARBITRARY
+    variables at the serving cache geometry; the reference for
+    weight-push tests, where the fleet's params are no longer the
+    seeded ones."""
+    import functools
+
+    import jax
+
+    runner = ModelRunner(ServeConfig.from_env(FLEET_ENV))
+    cache = runner.max_blocks_per_seq * runner.block_size
+    fns = {}
+
+    def gen(variables, prompt, n):
+        if n not in fns:
+            fns[n] = jax.jit(functools.partial(
+                generate, runner.model_cfg, max_new_tokens=n,
+                cache_len=cache))
+        return np.asarray(fns[n](
+            variables,
+            jnp.asarray(np.asarray(prompt, np.int32)[None])))[0]
+
+    return runner.variables, gen
+
+
+def _scaled(tree, factor):
+    """Every float leaf scaled by ``factor`` (dtype preserved) — a
+    cheap stand-in for 'the trainer made progress': measurably
+    different weights with the identical tree structure."""
+    import jax
+
+    def scale(a):
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating):
+            return (arr.astype(np.float32) * factor).astype(arr.dtype)
+        return arr
+
+    return jax.tree_util.tree_map(scale, tree)
+
+
 class _Fleet:
-    def __init__(self, replicas, restart=0, extra_env=None, delay=0.0):
+    def __init__(self, replicas, restart=0, extra_env=None, delay=0.0,
+                 model=None):
         env = dict(os.environ)
         env.update(FLEET_ENV)
         env.update(extra_env or {})
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        cmd = [sys.executable, "-m", "horovod_tpu.run", "--serve",
+               "--replicas", str(replicas), "--serve-port", "0",
+               "--restart-on-failure", str(restart),
+               "--relaunch-delay-sec", str(delay)]
+        if model is not None:
+            cmd += ["--serve-model", model]
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "horovod_tpu.run", "--serve",
-             "--replicas", str(replicas), "--serve-port", "0",
-             "--restart-on-failure", str(restart),
-             "--relaunch-delay-sec", str(delay)],
+            cmd,
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         self.port = None
@@ -249,6 +295,173 @@ def test_replica_death_requeues_all_requests(offline):
         # The relaunched replica rejoined (or is mid-relaunch with
         # budget spent on it) — the supervisor consumed restart budget.
         assert stats["router"]["restarts_left"] < 2
+        rc = fleet.stop(cli)
+        assert rc == 0, "".join(fleet.log[-20:])
+        cli.close()
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.ckpt
+@pytest.mark.slow
+def test_live_weight_push_hot_swaps_mid_traffic(offline, ref):
+    """A trainer-side WeightPusher lands a new weight epoch while the
+    fleet is mid-decode: every in-flight stream is restarted under the
+    new weights (requeued, reason ``weights``) and completes with the
+    EXACT offline tokens of the PUSHED variables — never a half-old,
+    half-new stream — while streams that finished before the swap stay
+    exact under the boot weights.  The epoch stamp on each ``done``
+    event says which reference applies."""
+    base_vars, gen = ref
+    vars2 = _scaled(base_vars, 1.25)
+    fleet = _Fleet(replicas=2)
+    try:
+        cli = ServeClient("127.0.0.1", fleet.port, timeout=240)
+        rng = np.random.default_rng(23)
+        prompts = [rng.integers(0, 512, int(rng.integers(3, 12))).tolist()
+                   for _ in range(6)]
+        for i, prompt in enumerate(prompts):
+            cli.start_generate(f"job{i}", prompt, max_tokens=24)
+        # Let the fleet admit the streams, then push mid-flight.  The
+        # fp32 wire is lossless, so the replicas swap in EXACTLY the
+        # arrays the reference below runs over.
+        time.sleep(2.0)
+        pusher = WeightPusher("127.0.0.1", fleet.port, timeout=240)
+        try:
+            ack = pusher.push(vars2, epoch=1, wire="fp32")
+        finally:
+            pusher.close()
+        assert ack["epoch"] == 1, ack
+        assert len(ack["replicas"]) == 2, ack
+        assert all(r["applied"] for r in ack["replicas"]), ack
+        results = {f"job{i}": cli.collect(f"job{i}", timeout=240)
+                   for i in range(len(prompts))}
+        swapped_streams = 0
+        for i, prompt in enumerate(prompts):
+            evs = results[f"job{i}"]
+            done = evs[-1]
+            assert done["event"] == "done", f"job{i} dropped: {done}"
+            assert len(done["tokens"]) == 24
+            if done.get("weight_epoch") == 1:
+                swapped_streams += 1
+                expected = gen(vars2, prompt, 24)
+            else:
+                expected = offline(prompt, 24)
+            np.testing.assert_array_equal(
+                np.asarray(done["tokens"]), expected)
+        assert swapped_streams > 0, \
+            "push acked but no stream finished under epoch 1:\n" + \
+            "".join(fleet.log[-30:])
+        stats = cli.stats()
+        assert stats["router"]["weight_pushes"] == 1, stats["router"]
+        for r in stats["replicas"]:
+            assert r["scheduler"]["weight_epoch"] == 1, r
+        assert sum(r["scheduler"]["weight_swaps"]
+                   for r in stats["replicas"]) >= 2
+        rc = fleet.stop(cli)
+        assert rc == 0, "".join(fleet.log[-20:])
+        cli.close()
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.ckpt
+@pytest.mark.slow
+def test_relaunched_replica_rejoins_at_current_weight_epoch(ref):
+    """Regression for the stale-rejoin hazard: push epoch 1, then kill
+    replica 1 mid-traffic.  The supervisor relaunches it with BOOT-TIME
+    params, and the router must replay the cached frame before the
+    rejoined replica takes load — both replicas report weight_epoch 1,
+    and a post-rejoin wave still decodes exactly under the pushed
+    weights (zero stale-epoch tokens)."""
+    base_vars, gen = ref
+    vars2 = _scaled(base_vars, 1.25)
+    fleet = _Fleet(replicas=2, restart=2,
+                   extra_env={"HOROVOD_FAULT_INJECT": "1:4:exit",
+                              "HOROVOD_LINK_RETRIES": "0"})
+    try:
+        cli = ServeClient("127.0.0.1", fleet.port, timeout=240)
+        pusher = WeightPusher("127.0.0.1", fleet.port, timeout=240)
+        try:
+            ack = pusher.push(vars2, epoch=1, wire="fp32")
+        finally:
+            pusher.close()
+        assert len(ack["replicas"]) == 2, ack
+        assert all(r["applied"] for r in ack["replicas"]), ack
+        rng = np.random.default_rng(29)
+        prompts = [rng.integers(0, 512, int(rng.integers(3, 12))).tolist()
+                   for _ in range(8)]
+        results = _run_jobs(cli, prompts, max_tokens=20)
+        requeued_streams = 0
+        for i, prompt in enumerate(prompts):
+            evs = results[f"job{i}"]
+            done = evs[-1]
+            assert done["event"] == "done", f"job{i} dropped: {done}"
+            assert done.get("weight_epoch") == 1, done
+            np.testing.assert_array_equal(
+                np.asarray(done["tokens"]), gen(vars2, prompt, 20))
+            requeued_streams += any(e["event"] == "requeued" for e in evs)
+        assert requeued_streams > 0, "fault fired but nothing requeued"
+        # Wait out the relaunch: the replay MUST have run by the time
+        # the rejoined replica shows alive.
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            stats = cli.stats()
+            if (stats["router"]["weight_replays"] >= 1
+                    and all(r["alive"] for r in stats["replicas"])):
+                break
+            time.sleep(1.0)
+        assert stats["router"]["replica_deaths"] == 1, stats["router"]
+        assert stats["router"]["weight_replays"] >= 1, stats["router"]
+        for r in stats["replicas"]:
+            assert r["scheduler"]["weight_epoch"] == 1, r
+        # Post-rejoin wave: whole fleet serves the pushed epoch.
+        results = _run_jobs(cli, prompts[:4], max_tokens=12)
+        for i, prompt in enumerate(prompts[:4]):
+            done = results[f"job{i}"][-1]
+            assert done["event"] == "done", done
+            assert done.get("weight_epoch") == 1, done
+            np.testing.assert_array_equal(
+                np.asarray(done["tokens"]), gen(vars2, prompt, 12))
+        rc = fleet.stop(cli)
+        assert rc == 0, "".join(fleet.log[-20:])
+        cli.close()
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.ckpt
+@pytest.mark.slow
+def test_serve_from_checkpoint_directory(tmp_path, ref):
+    """``--serve-model <checkpoint dir>``: every replica boots from the
+    newest complete manifest's params instead of the seed — the serving
+    path of the trainer→serve weight plane.  Tokens must match offline
+    generate over the checkpointed weights, and the replica reports the
+    manifest step it serves."""
+    base_vars, gen = ref
+    vars2 = _scaled(base_vars, 1.25)
+    cfg = ServeConfig.from_env(FLEET_ENV)
+    writer = CheckpointWriter(str(tmp_path), meta={"model": cfg.model})
+    writer.save(7, {"params": vars2["params"]})
+    writer.wait(timeout=120)
+    writer.close()
+    fleet = _Fleet(replicas=1, model=str(tmp_path))
+    try:
+        assert any("serving checkpoint step 7" in line
+                   for line in fleet.log), "".join(fleet.log)
+        cli = ServeClient("127.0.0.1", fleet.port, timeout=240)
+        rng = np.random.default_rng(31)
+        prompts = [rng.integers(0, 512, int(rng.integers(3, 12))).tolist()
+                   for _ in range(3)]
+        results = _run_jobs(cli, prompts, max_tokens=12)
+        for i, prompt in enumerate(prompts):
+            done = results[f"job{i}"][-1]
+            assert done["event"] == "done", done
+            np.testing.assert_array_equal(
+                np.asarray(done["tokens"]), gen(vars2, prompt, 12))
+        stats = cli.stats()
+        assert stats["replicas"][0]["scheduler"]["config"][
+            "checkpoint_step"] == 7, stats["replicas"][0]
         rc = fleet.stop(cli)
         assert rc == 0, "".join(fleet.log[-20:])
         cli.close()
