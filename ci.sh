@@ -251,6 +251,20 @@ echo "== serve gate (2-replica Poisson load, hard timeout) =="
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
     python bench_serve.py --gate
 
+echo "== serve prefix-cache + fused-kernel gate =="
+# Throughput-feature regression gate on the shared-system-prompt
+# chatbot workload (every request repeats a 24-token system prompt;
+# the plan tail repeats earlier requests verbatim).  Interleaved
+# best-of-2 fleets per arm — fused+prefix ON vs both OFF — must show:
+# prefix hit rate >= 0.5 with prefill tokens actually saved (and
+# exactly zero cache touches on the OFF arm), verbatim repeats
+# streaming BIT-IDENTICAL tokens, every request complete, occupancy
+# > 1, zero KV blocks left in use, no process/socket/shm leaks, and
+# ON throughput >= 0.85x OFF (the features must never cost real
+# throughput).  bench_serve.py --prefix-gate checks all of it.
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
+    python bench_serve.py --prefix-gate
+
 echo "== multichip sharding dry run =="
 PALLAS_AXON_POOL_IPS= python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun_multichip(8) OK')"
 

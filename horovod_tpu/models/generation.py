@@ -245,13 +245,16 @@ def _attend_b(q, k, v, *, q_pos, k_len):
     return out.reshape(B, Sq, Hq, D)
 
 
-def _paged_layer(cfg: LlamaConfig, lp, x, pk, pv, tables, *, pos):
+def _paged_layer(cfg: LlamaConfig, lp, x, pk, pv, tables, *, pos,
+                 fused: bool = False):
     """One decoder layer over one decode token per sequence.
 
     x: [B, 1, H]; pk/pv: this layer's pool [NB, BS, Hkv, D];
     tables: [B, MAXB] physical block ids; pos: [B] global positions.
-    Writes K/V at each sequence's ``pos`` slot, then attends the gathered
-    contiguous view.  Returns (x, pk, pv).
+    Writes K/V at each sequence's ``pos`` slot, then attends — via the
+    gather + :func:`_attend_b` oracle by default, or via the fused
+    paged-attention kernel (``ops/paged_attention.py``: block-table
+    reads, no contiguous staging) when ``fused``.  Returns (x, pk, pv).
     """
     D = cfg.head_dim
     B, S, _ = x.shape
@@ -270,10 +273,15 @@ def _paged_layer(cfg: LlamaConfig, lp, x, pk, pv, tables, *, pos):
     off = pos % bs
     pk = pk.at[blk, off].set(k[:, 0])
     pv = pv.at[blk, off].set(v[:, 0])
-    maxb = tables.shape[1]
-    ck = pk[tables].reshape(B, maxb * bs, cfg.num_kv_heads, D)
-    cv = pv[tables].reshape(B, maxb * bs, cfg.num_kv_heads, D)
-    out = _attend_b(q, ck, cv, q_pos=pos, k_len=pos + 1)
+    if fused:
+        from horovod_tpu.ops.paged_attention import paged_attention_decode
+
+        out = paged_attention_decode(q, pk, pv, tables, pos)
+    else:
+        maxb = tables.shape[1]
+        ck = pk[tables].reshape(B, maxb * bs, cfg.num_kv_heads, D)
+        cv = pv[tables].reshape(B, maxb * bs, cfg.num_kv_heads, D)
+        out = _attend_b(q, ck, cv, q_pos=pos, k_len=pos + 1)
     x = x + out.reshape(B, S, cfg.num_heads * D) @ \
         a["wo"]["kernel"].astype(cfg.dtype)
     y = _rms(x, lp["norm_mlp"]["scale"], cfg.rms_eps)
@@ -285,7 +293,7 @@ def _paged_layer(cfg: LlamaConfig, lp, x, pk, pv, tables, *, pos):
 
 
 def paged_decode_step(cfg: LlamaConfig, variables, tokens, pool_k, pool_v,
-                      tables, pos):
+                      tables, pos, *, fused: bool = False):
     """One decode step for a batch of independent sequences over the
     paged pool.
 
@@ -296,6 +304,12 @@ def paged_decode_step(cfg: LlamaConfig, variables, tokens, pool_k, pool_v,
     [B, V], pool_k, pool_v).  Rows are computed independently — a padded
     row (pos 0, all-trash table) produces garbage logits the caller
     discards, and never perturbs a live row.
+
+    ``fused`` (static under jit) selects the fused paged-attention
+    kernel instead of the gather oracle; numerically equivalent within
+    the documented tolerance, argmax-stable on the greedy corpus, but
+    NOT bitwise identical (online softmax re-associates the key
+    reduction) — ``HOROVOD_SERVE_FUSED_ATTN=0`` keeps the oracle.
     """
     p = _params(variables)
     x = jnp.take(p["tok_emb"]["embedding"], tokens[:, None],
@@ -303,7 +317,7 @@ def paged_decode_step(cfg: LlamaConfig, variables, tokens, pool_k, pool_v,
     new_k, new_v = [], []
     for i in range(cfg.num_layers):
         x, pk, pv = _paged_layer(cfg, p[f"layer_{i}"], x, pool_k[i],
-                                 pool_v[i], tables, pos=pos)
+                                 pool_v[i], tables, pos=pos, fused=fused)
         new_k.append(pk)
         new_v.append(pv)
     x = _rms(x, p["norm_f"]["scale"], cfg.rms_eps)
@@ -313,7 +327,7 @@ def paged_decode_step(cfg: LlamaConfig, variables, tokens, pool_k, pool_v,
 
 
 def paged_prefill(cfg: LlamaConfig, variables, prompt_ids, pool_k, pool_v,
-                  table, *, prompt_len, cache_len=None):
+                  table, *, prompt_len, cache_len=None, start_blk: int = 0):
     """Prefill one sequence's (padded) prompt into its pool blocks.
 
     prompt_ids: [1, S_pad] with S_pad a multiple of the block size
@@ -331,6 +345,20 @@ def paged_prefill(cfg: LlamaConfig, variables, prompt_ids, pool_k, pool_v,
     block-table decode steps do, and the whole serve stream is
     bit-reproducible against offline ``generate()`` at that
     ``cache_len``.
+
+    ``start_blk`` (static) > 0 is the prefix-cache hit path: the first
+    ``start_blk`` table blocks already hold this prompt's K/V (shared,
+    content-hash matched — serve/kv_cache.py), ``prompt_ids`` is the
+    PADDED SUFFIX starting at position ``start_blk * BS``, and only the
+    suffix is computed.  The temporary contiguous cache is seeded by
+    gathering the whole table from the pool — a permutation copy, so the
+    shared positions carry the exact bits a full prefill of the same
+    content would recompute — and only blocks ``>= start_blk`` are
+    scattered back: shared blocks are never written (the copy-on-write
+    invariant).  Positions beyond ``prompt_len`` hold junk from unfunded
+    table entries; the ``k_len`` mask zeroes them exactly (finfo.min →
+    exp → 0), so the hit path is bit-identical to the full prefill
+    (tests/test_serve.py pins it).
     """
     if cfg.num_experts > 1:
         raise NotImplementedError("KV-cache decode supports dense (non-MoE)"
@@ -340,14 +368,75 @@ def paged_prefill(cfg: LlamaConfig, variables, prompt_ids, pool_k, pool_v,
     bs = pool_k.shape[2]
     if cache_len is None:
         cache_len = S_pad
-    shape = (cfg.num_layers, B, cache_len, cfg.num_kv_heads, cfg.head_dim)
-    ck = jnp.zeros(shape, cfg.dtype)
-    cv = jnp.zeros(shape, cfg.dtype)
-    logits, ck, cv = _forward(cfg, p, prompt_ids, ck, cv, pos0=0,
-                              k_len=prompt_len)
-    last = jax.lax.dynamic_index_in_dim(logits, prompt_len - 1, axis=1,
-                                        keepdims=False)
     nb = cache_len // bs
+    if start_blk == 0:
+        shape = (cfg.num_layers, B, cache_len, cfg.num_kv_heads,
+                 cfg.head_dim)
+        ck = jnp.zeros(shape, cfg.dtype)
+        cv = jnp.zeros(shape, cfg.dtype)
+        logits, ck, cv = _forward(cfg, p, prompt_ids, ck, cv, pos0=0,
+                                  k_len=prompt_len)
+        last = jax.lax.dynamic_index_in_dim(logits, prompt_len - 1, axis=1,
+                                            keepdims=False)
+        pool_k = pool_k.at[:, table].set(
+            ck[:, 0].reshape(cfg.num_layers, nb, bs, cfg.num_kv_heads,
+                             cfg.head_dim))
+        pool_v = pool_v.at[:, table].set(
+            cv[:, 0].reshape(cfg.num_layers, nb, bs, cfg.num_kv_heads,
+                             cfg.head_dim))
+        return last, pool_k, pool_v
+    start = start_blk * bs
+    ck = pool_k[:, table].reshape(cfg.num_layers, cache_len,
+                                  cfg.num_kv_heads, cfg.head_dim)[:, None]
+    cv = pool_v[:, table].reshape(cfg.num_layers, cache_len,
+                                  cfg.num_kv_heads, cfg.head_dim)[:, None]
+    logits, ck, cv = _forward(cfg, p, prompt_ids, ck, cv, pos0=start,
+                              k_len=prompt_len)
+    last = jax.lax.dynamic_index_in_dim(logits, prompt_len - 1 - start,
+                                        axis=1, keepdims=False)
+    tail = table[start_blk:]
+    pool_k = pool_k.at[:, tail].set(
+        ck[:, 0, start:].reshape(cfg.num_layers, nb - start_blk, bs,
+                                 cfg.num_kv_heads, cfg.head_dim))
+    pool_v = pool_v.at[:, tail].set(
+        cv[:, 0, start:].reshape(cfg.num_layers, nb - start_blk, bs,
+                                 cfg.num_kv_heads, cfg.head_dim))
+    return last, pool_k, pool_v
+
+
+def paged_prefill_suffix(cfg: LlamaConfig, variables, prompt_ids, pool_k,
+                         pool_v, table, *, prompt_len, start, cache_len):
+    """The prefix-cache hit path with a TRACED ``start``.
+
+    Identical math to :func:`paged_prefill` with ``start_blk > 0`` —
+    gather-seed the contiguous cache from the whole table, run only the
+    padded suffix through the model at ``pos0=start`` — but ``start``
+    (block-aligned positions, ``0 < start < prompt_len``) is an operand,
+    so ONE compiled program serves every hit offset at a given suffix
+    bucket instead of one per ``(bucket, start_blk)`` pair.  The price
+    of the dynamic offset is the scatter-back: with no static block
+    split available, the WHOLE table is written.  That stays correct
+    under copy-on-write because positions below ``start`` pass through
+    ``_forward`` untouched from the gather seed, so every shared block
+    is rewritten with exactly its own bytes — shared content never
+    changes.  The caller must guarantee ``start + S_pad <= cache_len``
+    (a clamped ``dynamic_update_slice`` would silently shift the
+    writes); the engine falls back to the static path otherwise.
+    """
+    if cfg.num_experts > 1:
+        raise NotImplementedError("KV-cache decode supports dense (non-MoE)"
+                                  " configs")
+    p = _params(variables)
+    bs = pool_k.shape[2]
+    nb = cache_len // bs
+    ck = pool_k[:, table].reshape(cfg.num_layers, cache_len,
+                                  cfg.num_kv_heads, cfg.head_dim)[:, None]
+    cv = pool_v[:, table].reshape(cfg.num_layers, cache_len,
+                                  cfg.num_kv_heads, cfg.head_dim)[:, None]
+    logits, ck, cv = _forward(cfg, p, prompt_ids, ck, cv, pos0=start,
+                              k_len=prompt_len)
+    last = jax.lax.dynamic_index_in_dim(logits, prompt_len - 1 - start,
+                                        axis=1, keepdims=False)
     pool_k = pool_k.at[:, table].set(
         ck[:, 0].reshape(cfg.num_layers, nb, bs, cfg.num_kv_heads,
                          cfg.head_dim))

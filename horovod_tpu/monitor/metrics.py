@@ -17,6 +17,7 @@ from typing import Dict, List, NamedTuple, Optional
 __all__ = [
     "TELEM_COUNTERS",
     "STATS_METRICS",
+    "SERVE_METRICS",
     "render_prometheus",
     "render_json",
     "reference_rows",
@@ -147,6 +148,49 @@ STATS_METRICS: List[Metric] = [
            "step of the last committed (durable) checkpoint manifest"),
 ]
 
+#: Serve-plane counters mounted by the router as the ``"serve"``
+#: provider (``horovod_serve_*``): its own fleet counters plus the
+#: per-replica scheduler counters piggybacked on probe pongs and summed
+#: fleet-wide.  Keys absent from this table still export as bare gauges
+#: (the mount is schemaless by design); listing here adds HELP/TYPE rows
+#: and a docs/observability.md entry.
+SERVE_METRICS: List[Metric] = [
+    Metric("completed", "horovod_serve_completed", "counter",
+           "streams finished with a done event (router fleet view)"),
+    Metric("requeued", "horovod_serve_requeued", "counter",
+           "in-flight requests transparently requeued after a replica "
+           "death"),
+    Metric("replica_deaths", "horovod_serve_replica_deaths", "counter",
+           "replica processes declared down by the router"),
+    Metric("link_reconnects", "horovod_serve_link_reconnects", "counter",
+           "router→replica links transparently healed in place "
+           "(HOROVOD_SERVE_LINK_RETRIES; streams resume seq-exact, "
+           "no requeue)"),
+    Metric("weight_pushes", "horovod_serve_weight_pushes", "counter",
+           "live trainer→serve weight swaps fanned out to the fleet"),
+    Metric("prefix_hits", "horovod_serve_prefix_hits", "counter",
+           "prompt KV blocks served from the content-hash prefix cache "
+           "instead of being prefilled (summed over replicas)"),
+    Metric("prefix_misses", "horovod_serve_prefix_misses", "counter",
+           "shareable prompt blocks that missed the prefix cache and "
+           "were prefilled"),
+    Metric("prefix_evictions", "horovod_serve_prefix_evictions", "counter",
+           "cached prefix blocks recycled under pool pressure (LRU) or "
+           "a weight-epoch flush"),
+    Metric("cow_forks", "horovod_serve_cow_forks", "counter",
+           "copy-on-write forks where a sequence diverged from a "
+           "shared cached prefix"),
+    Metric("fused_attn_steps", "horovod_serve_fused_attn_steps", "counter",
+           "decode steps executed by the fused paged-attention kernel "
+           "(HOROVOD_SERVE_FUSED_ATTN)"),
+    Metric("prefill_tokens_saved", "horovod_serve_prefill_tokens_saved",
+           "counter",
+           "prompt tokens whose prefill compute was skipped via prefix "
+           "cache hits"),
+]
+
+_SERVE_HELP = {m.stats_key: m for m in SERVE_METRICS}
+
 
 def render_prometheus(stats: Optional[dict], fleet: Optional[dict],
                       extra: Optional[Dict[str, dict]] = None) -> str:
@@ -218,7 +262,12 @@ def render_prometheus(stats: Optional[dict], fleet: Optional[dict],
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue
             name = f"horovod_{provider}_{key}".replace(".", "_")
-            lines.append(f"# TYPE {name} gauge")
+            reg = _SERVE_HELP.get(key) if provider == "serve" else None
+            if reg is not None:
+                lines.append(f"# HELP {reg.prom} {reg.help}")
+                lines.append(f"# TYPE {reg.prom} {reg.kind}")
+            else:
+                lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {v}")
     return "\n".join(lines) + "\n"
 
@@ -247,6 +296,10 @@ def reference_rows() -> List[dict]:
     rows.append({"metric": "horovod_fleet_slowest_rank", "kind": "gauge",
                  "source": "fleet_stats()['slowest']",
                  "help": "rank with the worst step-time p99"})
+    rows.extend({
+        "metric": m.prom, "kind": m.kind,
+        "source": f"serve mount ['{m.stats_key}']", "help": m.help,
+    } for m in SERVE_METRICS)
     return rows
 
 

@@ -16,7 +16,7 @@ import os
 from typing import List, Optional
 
 __all__ = ["ServeConfig", "resolved_serve_config", "SERVE_KNOBS",
-           "resolve_probe_knobs"]
+           "resolve_probe_knobs", "resolve_link_retries"]
 
 
 def _int_env(environ, name: str, dflt: int) -> int:
@@ -56,6 +56,9 @@ class ServeConfig:
     max_model_len: int = 256
     max_batch: int = 8
     prefill_waves: int = 1
+    fused_attn: int = 0
+    prefix_cache: int = 1
+    warmup_tokens: int = 0
     autotune: int = 0
     autotune_seed: int = 0
     autotune_window_steps: int = 32
@@ -93,6 +96,10 @@ class ServeConfig:
             max_batch=max_batch,
             prefill_waves=max(1, _int_env(environ,
                                           "HOROVOD_SERVE_PREFILL_WAVES", 1)),
+            fused_attn=_int_env(environ, "HOROVOD_SERVE_FUSED_ATTN", 0),
+            prefix_cache=_int_env(environ, "HOROVOD_SERVE_PREFIX_CACHE", 1),
+            warmup_tokens=max(0, _int_env(environ, "HOROVOD_SERVE_WARMUP",
+                                          0)),
             autotune=_int_env(environ, "HOROVOD_SERVE_AUTOTUNE", 0),
             autotune_seed=_int_env(environ, "HOROVOD_SERVE_AUTOTUNE_SEED",
                                    0),
@@ -132,6 +139,19 @@ SERVE_KNOBS = [
      "max concurrently decoding sequences (live-tunable)"),
     ("HOROVOD_SERVE_PREFILL_WAVES", "1", "prefill_waves",
      "admissions prefilled per scheduler step (live-tunable)"),
+    ("HOROVOD_SERVE_FUSED_ATTN", "0", "fused_attn",
+     "1 = fused paged-attention decode kernel (block-table reads, no "
+     "gather; tolerance-equivalent); 0 = gather oracle, byte-identical "
+     "to offline generate"),
+    ("HOROVOD_SERVE_PREFIX_CACHE", "1", "prefix_cache",
+     "content-hash prefix caching: shared prompt blocks are refcounted "
+     "and copy-on-write forked; 0 restores per-request full prefill "
+     "bit-for-bit"),
+    ("HOROVOD_SERVE_WARMUP", "0", "warmup_tokens",
+     "pre-compile decode + prefill programs up to this many prompt "
+     "tokens before the replica reports READY, so jit compilation "
+     "lands in startup instead of the first unlucky requests' latency "
+     "(0 disables)"),
     ("HOROVOD_SERVE_AUTOTUNE", "0", "autotune",
      "serve-plane knob search scored on tokens/sec windows"),
     ("HOROVOD_SERVE_AUTOTUNE_SEED", "0", "autotune_seed",
@@ -176,6 +196,24 @@ def resolved_serve_config(environ=os.environ) -> List[dict]:
                "requests requeue like the death path (keep it above "
                "the model's worst single-call time — first-request "
                "jit compiles run inside one scheduler phase)"})
+    rows.append({
+        "env": "HOROVOD_SERVE_LINK_RETRIES",
+        "set": environ.get("HOROVOD_SERVE_LINK_RETRIES") or "",
+        "default": "2", "effective": str(resolve_link_retries(environ)),
+        "doc": "router->replica control-link reconnect attempts after a "
+               "transient socket failure (the replica parks the session "
+               "and replays missed events) before escalating to the "
+               "kill/requeue/relaunch path; 0 disables healing"})
+    raw_chunk = environ.get("HOROVOD_PAGED_ATTN_CHUNK") or ""
+    rows.append({
+        "env": "HOROVOD_PAGED_ATTN_CHUNK",
+        "set": raw_chunk,
+        "default": "whole table", "effective": raw_chunk or "whole table",
+        "doc": "table columns per online-softmax iteration in the "
+               "blockwise XLA fused-attention path (off-TPU stand-in "
+               "for the Pallas kernel); 1 = the kernel's exact "
+               "per-block reduction order, default folds the whole "
+               "table into one dense pass"})
     return rows
 
 
@@ -204,3 +242,10 @@ def resolve_probe_knobs(environ=os.environ):
     deadline = _float_env(environ, "HOROVOD_SERVE_PROBE_DEADLINE_SEC",
                           max(60.0, 3 * probe))
     return probe, deadline
+
+
+def resolve_link_retries(environ=os.environ) -> int:
+    """Router->replica control-link reconnect budget (PR 14 spirit:
+    bounded healing before honest escalation).  Shared by Router and the
+    --print-config row — one resolver, no drift."""
+    return max(0, _int_env(environ, "HOROVOD_SERVE_LINK_RETRIES", 2))

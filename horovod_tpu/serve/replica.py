@@ -17,7 +17,9 @@ Fault injection: the replica honors the engine's
 the *replica index* (``HOROVOD_REPLICA_ID``) standing in for the rank
 and the scheduler's decode-step counter for the step — ``exit`` hard-
 kills the process (exit 41, matching the engine's injected-exit code),
-``hang`` wedges the scheduler thread.  The router's supervisor scrubs
+``hang`` wedges the scheduler thread, ``conn-reset`` aborts every open
+connection ONCE (transient link loss: router sessions park and heal
+under HOROVOD_SERVE_LINK_RETRIES; the process keeps serving).  The router's supervisor scrubs
 the schedule on relaunch exactly like ``run.py --restart-on-failure``
 does, so a fault fires once, not on every incarnation.
 """
@@ -50,20 +52,36 @@ def parse_fault_schedule(raw: Optional[str],
             rank, step = int(bits[0]), int(bits[1])
         except ValueError:
             continue
-        if rank == replica_id and bits[2] in ("exit", "hang"):
+        if rank == replica_id and bits[2] in ("exit", "hang",
+                                              "conn-reset"):
             return step, bits[2]
     return None
 
 
-def _fault_hook(replica_id: int) -> Optional[Callable[[int], None]]:
+def _fault_hook(replica_id: int,
+                server_cell=None) -> Optional[Callable[[int], None]]:
     sched = parse_fault_schedule(os.environ.get("HOROVOD_FAULT_INJECT"),
                                  replica_id)
     if sched is None:
         return None
     fire_step, kind = sched
+    fired = [False]
 
     def hook(step: int) -> None:
         if step < fire_step:
+            return
+        if kind == "conn-reset":
+            # One-shot: a transient reset, not a dead link every step.
+            # The hook runs on the scheduler thread; drop_connections
+            # trampolines onto the server's event loop.
+            if fired[0] or not server_cell:
+                return
+            fired[0] = True
+            sys.stderr.write(f"[serve replica {replica_id}] injected "
+                             f"fault 'conn-reset' at decode step "
+                             f"{step}\n")
+            sys.stderr.flush()
+            server_cell[0].drop_connections()
             return
         sys.stderr.write(f"[serve replica {replica_id}] injected fault "
                          f"{kind!r} at decode step {step}\n")
@@ -101,12 +119,21 @@ def main(argv=None) -> int:
         hvd.init()
 
     runner = ModelRunner(cfg)
-    scheduler = Scheduler(runner, cfg, step_hook=_fault_hook(replica_id))
+    if cfg.warmup_tokens:
+        n = runner.warmup()
+        print(f"SERVE_REPLICA_WARMUP replica={replica_id} programs={n}",
+              flush=True)
+    # The conn-reset fault needs the server, which is built inside the
+    # loop AFTER the scheduler — hand the hook a late-bound cell.
+    server_cell: list = []
+    scheduler = Scheduler(runner, cfg,
+                          step_hook=_fault_hook(replica_id, server_cell))
     sched_thread = threading.Thread(target=scheduler.run, daemon=True)
     sched_thread.start()
 
     async def amain() -> None:
         server = ReplicaServer(scheduler)
+        server_cell.append(server)
         port = await server.start(args.host, args.port)
         print(f"SERVE_REPLICA_READY port={port} replica={replica_id}",
               flush=True)

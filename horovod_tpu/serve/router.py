@@ -58,6 +58,17 @@ class _Replica:
         self.writer: Optional[asyncio.StreamWriter] = None
         self.pending: Dict[str, "_ClientConn"] = {}
         self.alive = False
+        # Link healing (HOROVOD_SERVE_LINK_RETRIES): session token the
+        # replica parks our stream state under across a socket loss,
+        # the highest event seq we have PROCESSED (the replay cursor),
+        # and whether a reconnect attempt is in flight (a healing
+        # replica takes no new dispatches and no probe pings).
+        self.session_token = ""
+        self.last_seq = 0
+        self.healing = False
+        # Latest scheduler metrics counters piggybacked on pongs —
+        # summed into the /metrics "serve" mount (fleet-wide view).
+        self.metrics: Dict[str, int] = {}
         # Liveness probing (wedged-replica detection): when the last
         # HEALTHY pong — answered AND its scheduler heartbeat fresh —
         # was seen, reset on (re)spawn so a slow cold start is not
@@ -110,7 +121,7 @@ class Router:
             "dispatched": 0, "completed": 0, "requeued": 0,
             "replica_deaths": 0, "rejoins": 0, "failed": 0,
             "cancelled": 0, "wedged_kills": 0, "weight_pushes": 0,
-            "weight_replays": 0,
+            "weight_replays": 0, "link_reconnects": 0,
         }
         #: the latest weights frame pushed through the router, replayed
         #: to every relaunched replica BEFORE it takes load (a rejoin
@@ -128,9 +139,19 @@ class Router:
         # by serve.config.resolve_probe_knobs (the --print-config rows
         # use the same resolver, and the deadline default is sized for
         # in-phase jit compiles).
-        from horovod_tpu.serve.config import resolve_probe_knobs
+        from horovod_tpu.serve.config import (
+            resolve_link_retries,
+            resolve_probe_knobs,
+        )
 
         self.probe_sec, self.probe_deadline_sec = resolve_probe_knobs()
+        # Control-link healing budget (PR 14 spirit for the serve
+        # plane): a transient replica-socket failure retries this many
+        # reconnects (the replica parks our session and replays missed
+        # events) before the honest fallback — the kill/requeue/relaunch
+        # death path.  0 disables: today's plain links, bit-for-bit.
+        self.link_retries = resolve_link_retries()
+        self._spawn_count = 0
 
     # -- replica lifecycle --
 
@@ -181,6 +202,18 @@ class Router:
                 await asyncio.sleep(0.1)
         else:
             raise RuntimeError(f"cannot connect to replica {rep.idx}")
+        rep.healing = False
+        rep.last_seq = 0
+        self._spawn_count += 1
+        rep.session_token = f"r{rep.idx}.{self._spawn_count}"
+        if self.link_retries > 0:
+            # Open a durable session so a transient socket loss parks
+            # our stream state replica-side instead of cancelling it.
+            rep.writer.write((json.dumps(
+                {"op": "hello", "role": "router",
+                 "session": rep.session_token, "last_seq": 0})
+                + "\n").encode())
+            await rep.writer.drain()
         rep.alive = True
         rep.last_healthy = time.monotonic()
         self._tasks.append(asyncio.ensure_future(self._replica_reader(rep)))
@@ -280,6 +313,15 @@ class Router:
                 if not line:
                     break
                 ev = json.loads(line)
+                seq = ev.pop("seq", None)
+                if seq is not None:
+                    # Replay cursor for link healing: the highest event
+                    # we processed.  Popped so downstream client frames
+                    # stay byte-identical to the sessionless protocol.
+                    rep.last_seq = max(rep.last_seq, int(seq))
+                if ev.get("event") == "hello_ack":
+                    continue   # fresh-session ack (resume handled in
+                               # _heal_link's inline exchange)
                 if ev.get("event") == "stats":
                     if rep.stats_waiter is not None \
                             and not rep.stats_waiter.done():
@@ -307,6 +349,9 @@ class Router:
                                 max(2 * self.probe_sec, 5.0))
                     if age is None or age <= fresh:
                         rep.last_healthy = time.monotonic()
+                    counters = ev.get("counters")
+                    if isinstance(counters, dict):
+                        rep.metrics = counters
                     continue
                 rid = ev.get("id")
                 client = self._owners.get(rid)
@@ -323,7 +368,83 @@ class Router:
                 client.emit(ev)
         except (ConnectionResetError, json.JSONDecodeError, OSError):
             pass
+        await self._heal_or_down(rep)
+
+    # -- link healing (HOROVOD_SERVE_LINK_RETRIES) --
+
+    async def _heal_or_down(self, rep: _Replica) -> None:
+        """A broken replica socket first tries a bounded reconnect (the
+        replica parked our session and replays the events we missed);
+        only when healing is off, the process is actually gone, or every
+        attempt fails does it escalate to the battle-tested death path
+        (requeue in-flight work + supervisor relaunch)."""
+        if (self.link_retries <= 0 or self._shutdown.is_set()
+                or rep.healing or not rep.alive
+                or rep.proc is None or rep.proc.returncode is not None):
+            self._on_replica_down(rep)
+            return
+        rep.healing = True
+        try:
+            for attempt in range(self.link_retries):
+                await asyncio.sleep(0.2 * (attempt + 1))
+                if (self._shutdown.is_set() or not rep.alive
+                        or rep.proc.returncode is not None):
+                    break   # real death: its path already ran/will run
+                if await self._heal_link(rep):
+                    rep.healing = False
+                    self.counters["link_reconnects"] += 1
+                    sys.stderr.write(
+                        f"replica {rep.idx} control link healed "
+                        f"(attempt {attempt + 1}/"
+                        f"{self.link_retries})\n")
+                    sys.stderr.flush()
+                    self._tasks.append(asyncio.ensure_future(
+                        self._replica_reader(rep)))
+                    self._drain_queue()
+                    return
+        finally:
+            rep.healing = False
         self._on_replica_down(rep)
+
+    async def _heal_link(self, rep: _Replica) -> bool:
+        """One reconnect + resume exchange.  True iff the replica
+        accepted the resume — the new socket is installed and every
+        pending generate it never received has been re-sent."""
+        try:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", rep.port, limit=1 << 26)
+        except OSError:
+            return False
+        try:
+            writer.write((json.dumps(
+                {"op": "hello", "role": "router",
+                 "session": rep.session_token,
+                 "last_seq": rep.last_seq}) + "\n").encode())
+            await writer.drain()
+            line = await asyncio.wait_for(reader.readline(), timeout=10)
+            ack = json.loads(line) if line else {}
+            if not (ack.get("event") == "hello_ack"
+                    and ack.get("resume")):
+                raise OSError("resume refused")
+            rep.reader, rep.writer = reader, writer
+            # Generates lost in flight during the reset: dispatched on
+            # our books but absent from the replica's live set.
+            seen = set(ack.get("live") or [])
+            for rid in list(rep.pending):
+                if rid in seen or rid not in self._reqs:
+                    continue
+                frame = dict(self._reqs[rid])
+                frame["id"] = rid
+                rep.writer.write((json.dumps(frame) + "\n").encode())
+            await rep.writer.drain()
+            return True
+        except (OSError, asyncio.TimeoutError, json.JSONDecodeError,
+                ValueError):
+            try:
+                writer.close()
+            except OSError:
+                pass
+            return False
 
     # -- dispatch --
 
@@ -334,7 +455,10 @@ class Router:
             client.live.pop(rid, None)
 
     def _pick(self) -> Optional[_Replica]:
-        live = [r for r in self.replicas if r.alive]
+        # A healing replica is alive but its socket is mid-reconnect:
+        # no new dispatches until the link is back (park in the queue —
+        # _heal_or_down drains it either way).
+        live = [r for r in self.replicas if r.alive and not r.healing]
         if not live:
             return None
         return min(live, key=lambda r: (len(r.pending), r.idx))
@@ -377,8 +501,10 @@ class Router:
                                 timeout: float = 90.0) -> Optional[dict]:
         """One replica's weights exchange; ``None`` on death or timeout
         (the death path owns the failure — its requests requeue and the
-        cached frame replays on the relaunch)."""
-        if not rep.alive:
+        cached frame replays on the relaunch).  A replica mid-link-heal
+        is skipped the same way; the next push (or a relaunch replay)
+        covers it."""
+        if not rep.alive or rep.healing:
             return None
         async with rep.stats_lock:
             rep.weights_waiter = asyncio.get_running_loop() \
@@ -402,7 +528,7 @@ class Router:
                 return
             now = time.monotonic()
             for rep in self.replicas:
-                if not rep.alive or rep.proc is None:
+                if not rep.alive or rep.healing or rep.proc is None:
                     continue
                 stale = now - rep.last_healthy
                 if stale > self.probe_deadline_sec:
@@ -552,7 +678,7 @@ class Router:
         for rep in self.replicas:
             entry = {"replica": rep.idx, "alive": rep.alive,
                      "pending": len(rep.pending)}
-            if rep.alive:
+            if rep.alive and not rep.healing:
                 async with rep.stats_lock:
                     rep.stats_waiter = asyncio.get_running_loop() \
                         .create_future()
@@ -606,6 +732,16 @@ class Router:
                 out["replicas"] = self.num_replicas
                 out["replicas_alive"] = sum(
                     1 for r in self.replicas if r.alive)
+                # Fleet-wide scheduler counters (prefix cache / fused
+                # kernel instruments), summed from the latest
+                # pong-piggybacked snapshot of each replica — no extra
+                # round trips on the scrape path.
+                totals: Dict[str, int] = {}
+                for r in self.replicas:
+                    for k, v in r.metrics.items():
+                        if isinstance(v, (int, float)):
+                            totals[k] = totals.get(k, 0) + v
+                out.update(totals)
                 return out
 
             try:

@@ -113,7 +113,8 @@ class Scheduler:
         # built with) but never wider — block ids must stay in range.
         self.kv = PagedKVCache(
             min(runner.num_blocks, serve_cfg.kv_blocks + 1),
-            runner.block_size, runner.max_blocks_per_seq)
+            runner.block_size, runner.max_blocks_per_seq,
+            prefix_cache=bool(serve_cfg.prefix_cache))
         # Live-tunable knobs (the serve autotuner rewrites them between
         # steps; reads happen once per step so a mid-step change cannot
         # tear a batch).
@@ -158,6 +159,8 @@ class Scheduler:
             "decode_seq_steps": 0,
             "tokens_streamed": 0,
             "weight_swaps": 0,
+            "fused_attn_steps": 0,
+            "prefill_tokens_saved": 0,
         }
 
     # -- thread-safe API --
@@ -230,6 +233,8 @@ class Scheduler:
         out["weight_epoch"] = self._weight_epoch
         out.update(self.kv.stats())
         out["tune_trials"] = self._tuner.trials if self._tuner else 0
+        if self._tuner is not None:
+            out.update(self._tuner.stats())
         out["config"] = {
             "max_batch": self.max_batch,
             "prefill_waves": self.prefill_waves,
@@ -238,10 +243,26 @@ class Scheduler:
             "max_model_len": self.cfg.max_model_len,
             "model": self.cfg.model,
             "autotune": int(self._tuner is not None),
+            "fused_attn": int(self.runner.fused_attn),
+            "prefix_cache": int(self.kv.prefix_cache),
             "checkpoint_step": getattr(self.runner, "checkpoint_step",
                                        None),
         }
         return out
+
+    def metrics_counters(self) -> dict:
+        """The small numeric counter set the replica piggybacks on pong
+        frames; the router sums it across replicas for the ``serve``
+        /metrics mount (``horovod_serve_*`` gauges)."""
+        with self._lock:
+            return {
+                "prefix_hits": self.kv.prefix_hits,
+                "prefix_misses": self.kv.prefix_misses,
+                "prefix_evictions": self.kv.prefix_evictions,
+                "cow_forks": self.kv.cow_forks,
+                "fused_attn_steps": self._c["fused_attn_steps"],
+                "prefill_tokens_saved": self._c["prefill_tokens_saved"],
+            }
 
     # -- scheduler thread --
 
@@ -317,6 +338,11 @@ class Scheduler:
                       "weight_epoch": self._weight_epoch})
             self._waiting.appendleft(seq)
             restarted += 1
+        # New weights invalidate every cached prefix block: flush the
+        # hash map and recycle cached blocks so stale-epoch KV is
+        # structurally unreachable (nothing can hash-hit it anymore and
+        # no table points at it).
+        self.kv.flush_prefix()
         pending["applied"] = True
         pending["restarted"] = restarted
         pending["done"].set()
@@ -372,12 +398,24 @@ class Scheduler:
         cannot be funded right now (admission control refusal)."""
         seq = self._waiting[0]
         prefix = seq.prefix
-        if not self.kv.allocate(seq.sid, len(prefix)):
+        # Prefix-cache aware funding: leading blocks whose chained
+        # content hash matches cached ones are shared (refcounted) and
+        # only the non-shared suffix is funded and prefilled; a resumed
+        # preemption hits its own earlier blocks the same way.  With
+        # caching off this is plain allocate + full prefill, byte-for-
+        # byte the old path.
+        shared = self.kv.allocate_prefix(seq.sid, prefix)
+        if shared is None:
             return False
         self._waiting.popleft()
+        start = shared * self.kv.block_size
         logits = self.runner.prefill(
-            prefix, self.kv.table(seq.sid))
+            prefix, self.kv.table(seq.sid), start=start)
+        # Publish the full blocks AFTER the prefill wrote them, so a
+        # later hit always shares blocks that really hold the K/V.
+        self.kv.register_prefix(seq.sid, prefix)
         self._c["prefills"] += 1
+        self._c["prefill_tokens_saved"] += start
         tok = _sample(logits, seq.req.temperature, seq.req.seed,
                       len(prefix))
         self._emit_token(seq, tok)
@@ -426,6 +464,8 @@ class Scheduler:
         logits = self.runner.decode(tokens, tables, pos)
         self._c["decode_steps"] += 1
         self._c["decode_seq_steps"] += len(funded)
+        if self.runner.fused_attn:
+            self._c["fused_attn_steps"] += 1
         for i, seq in enumerate(funded):
             tok = _sample(logits[i], seq.req.temperature, seq.req.seed,
                           pos[i] + 1)

@@ -24,7 +24,9 @@ Streamed responses (interleaved across in-flight requests)::
                                         # death via the router, or a
                                         # live weight swap in place)
     {"event": "stats", "stats": {...}}
-    {"event": "pong", "sched_age_sec": 0.004}
+    {"event": "pong", "sched_age_sec": 0.004,
+     "counters": {"prefix_hits": 0, ...}}   # scheduler metrics ride
+                                            # the liveness probes
     {"event": "weights_ack", "epoch": 3, "applied": true,
      "restarted": 2}
 
@@ -36,6 +38,20 @@ stream RESTARTS at index 0 on a survivor — the ``done`` frame's
 A small blocking :class:`ServeClient` (reader-thread + per-request
 queues) is included for tests and simple callers; the open-loop
 benchmark drives the asyncio side directly.
+
+Router sessions (link healing): a connection whose FIRST frame is
+``{"op": "hello", "role": "router", "session": "<token>", "last_seq": N}``
+gets durable stream state — a :class:`_RouterSession` owning the live
+request set and a sequence-stamped event history.  On socket loss the
+session PARKS (generation keeps running, events accumulate) for a grace
+window instead of cancelling; the router reconnects, replays its token
+in a new hello, and the replica re-sends exactly the events with
+``seq > last_seq`` — the healed stream is bit-identical to an unbroken
+one.  A hello the replica cannot resume faithfully (history aged out,
+or an unknown token with ``last_seq > 0``) answers ``resume: false`` so
+the router escalates to its kill/requeue path — never a silent gap.
+Plain clients (no hello) keep today's cancel-on-disconnect semantics
+bit-for-bit.
 """
 
 from __future__ import annotations
@@ -52,6 +68,34 @@ from horovod_tpu.serve.scheduler import Request, Scheduler
 
 __all__ = ["ReplicaServer", "ServeClient"]
 
+#: How long a parked router session survives without a reconnect before
+#: its live requests are cancelled (pool blocks must not leak forever
+#: behind a router that is never coming back).  Comfortably above the
+#: router's whole retry schedule (resolve_link_retries attempts with
+#: sub-second backoff).
+_PARK_GRACE_SEC = 15.0
+
+
+class _RouterSession:
+    """One router's durable stream state, surviving socket loss.
+
+    ``seq`` stamps every stream event (token/done/error/cancelled/
+    requeued) in emission order; ``history`` keeps the recent tail so a
+    reconnecting router replays exactly the events it missed.  Control
+    replies (stats/pong/weights_ack/hello_ack/bye) are connection-scoped
+    and never recorded — a lost one times out on the router side, which
+    is already how those paths fail.
+    """
+
+    def __init__(self, token: str):
+        self.token = token
+        self.live: set = set()
+        self.seq = 0
+        self.history: deque = deque(maxlen=4096)
+        #: the attached connection's queue; None while parked
+        self.outbox: Optional[asyncio.Queue] = None
+        self.park_handle: Optional[asyncio.TimerHandle] = None
+
 
 class ReplicaServer:
     """Serves one Scheduler over asyncio TCP (JSON lines)."""
@@ -62,10 +106,13 @@ class ReplicaServer:
         self.port: Optional[int] = None
         self._shutdown = asyncio.Event()
         self._conns: set = set()
+        self._sessions: Dict[str, _RouterSession] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         # limit: a weights frame is one JSON line carrying a base64
         # model — far over the 64 KiB readline default.
+        self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(self._handle, host, port,
                                                   limit=1 << 26)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -83,16 +130,254 @@ class ReplicaServer:
                 writer.close()
             except OSError:
                 pass
+        # Parked sessions must not outlive the server: their live
+        # requests release pool blocks now, not at park expiry.
+        for token in list(self._sessions):
+            self._end_session(self._sessions[token])
         await asyncio.sleep(0)
         self.scheduler.stop()
 
     def shutdown(self) -> None:
         self._shutdown.set()
 
+    def drop_connections(self) -> None:
+        """Abort every open connection (fault injection: a transient
+        link reset).  Router sessions park and heal; plain clients see
+        today's cancel-on-disconnect.  Threadsafe — callable from the
+        scheduler thread's fault hook."""
+        loop = self._loop
+        if loop is None:
+            return
+
+        def _abort() -> None:
+            for w in list(self._conns):
+                try:
+                    tr = w.transport
+                    if tr is not None:
+                        tr.abort()   # RST, not FIN: a real reset
+                    else:
+                        w.close()
+                except (OSError, RuntimeError):
+                    pass
+
+        try:
+            loop.call_soon_threadsafe(_abort)
+        except RuntimeError:
+            pass   # loop already gone — nothing left to drop
+
+    # -- router sessions --
+
+    def _end_session(self, sess: _RouterSession) -> None:
+        """Forget the session and cancel whatever it still owns."""
+        self._sessions.pop(sess.token, None)
+        if sess.park_handle is not None:
+            sess.park_handle.cancel()
+            sess.park_handle = None
+        for rid in list(sess.live):
+            self.scheduler.cancel(rid)
+        sess.live.clear()
+
+    def _expire_session(self, token: str) -> None:
+        sess = self._sessions.get(token)
+        if sess is None or sess.outbox is not None:
+            return   # reattached while the park timer was pending
+        self._end_session(sess)
+
+    def _session_emit(self, loop, sess: _RouterSession,
+                      rid: str) -> Callable[[dict], None]:
+        def emit(ev: dict) -> None:
+            def push(ev=dict(ev)) -> None:
+                if ev["event"] in ("done", "error", "cancelled"):
+                    sess.live.discard(rid)
+                sess.seq += 1
+                ev["seq"] = sess.seq
+                sess.history.append(ev)
+                if sess.outbox is not None:
+                    sess.outbox.put_nowait(ev)
+            try:
+                loop.call_soon_threadsafe(push)
+            except RuntimeError:
+                pass   # loop torn down mid-shutdown
+        return emit
+
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
-        loop = asyncio.get_running_loop()
         self._conns.add(writer)
+        try:
+            try:
+                first = await reader.readline()
+            except (ConnectionResetError, asyncio.IncompleteReadError):
+                first = b""
+            hello = None
+            if first:
+                try:
+                    parsed = json.loads(first)
+                    if isinstance(parsed, dict) \
+                            and parsed.get("op") == "hello":
+                        hello = parsed
+                except json.JSONDecodeError:
+                    pass
+            if hello is not None:
+                await self._handle_router(hello, reader, writer)
+            else:
+                await self._handle_plain(first, reader, writer)
+        finally:
+            self._conns.discard(writer)
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+    async def _handle_router(self, hello: dict,
+                             reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        loop = asyncio.get_running_loop()
+        token = str(hello.get("session", ""))
+        try:
+            last_seq = int(hello.get("last_seq", 0) or 0)
+        except (TypeError, ValueError):
+            last_seq = 0
+        sess = self._sessions.get(token)
+        if sess is None and last_seq > 0:
+            # The router remembers a session we no longer hold (park
+            # expired, or a restarted replica) — resuming would silently
+            # drop events.  Refuse so the router escalates honestly.
+            sess = None
+        elif sess is None:
+            sess = _RouterSession(token)
+            self._sessions[token] = sess
+        if sess is not None and sess.park_handle is not None:
+            sess.park_handle.cancel()
+            sess.park_handle = None
+        if sess is not None and sess.history:
+            oldest = sess.history[0]["seq"]
+        else:
+            oldest = (sess.seq + 1) if sess is not None else 0
+        if sess is None or (last_seq < sess.seq
+                            and oldest > last_seq + 1):
+            # Unknown token with history, or events aged out of the
+            # replay window: the stream cannot be made whole.
+            if sess is not None:
+                self._end_session(sess)
+            try:
+                writer.write((json.dumps(
+                    {"event": "hello_ack", "session": token,
+                     "resume": False}) + "\n").encode())
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+            return
+        outbox: asyncio.Queue = asyncio.Queue()
+        sess.outbox = outbox
+        # Ack carries the live set so the router re-sends generates the
+        # replica never received (lost in flight during the reset);
+        # replay pushes exactly the unseen stream events, in order.
+        outbox.put_nowait({"event": "hello_ack", "session": token,
+                           "resume": True, "seq": sess.seq,
+                           "live": sorted(sess.live)})
+        for ev in sess.history:
+            if ev["seq"] > last_seq:
+                outbox.put_nowait(ev)
+
+        async def write_loop() -> None:
+            try:
+                while True:
+                    ev = await outbox.get()
+                    if ev is None:
+                        break
+                    writer.write((json.dumps(ev) + "\n").encode())
+                    await writer.drain()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass   # events live on in sess.history for the replay
+
+        wtask = asyncio.ensure_future(write_loop())
+        ended = False
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    outbox.put_nowait({"event": "error", "id": None,
+                                       "error": "malformed frame"})
+                    continue
+                op = msg.get("op")
+                if op == "generate":
+                    rid = str(msg.get("id", ""))
+                    try:
+                        req = Request(
+                            id=rid,
+                            prompt=[int(t) for t in msg["prompt"]],
+                            max_tokens=int(msg["max_tokens"]),
+                            temperature=float(msg.get("temperature", 0.0)),
+                            seed=int(msg.get("seed", 0)))
+                    except (KeyError, TypeError, ValueError) as e:
+                        outbox.put_nowait({"event": "error", "id": rid,
+                                           "error": f"bad request: {e}"})
+                        continue
+                    sess.live.add(rid)
+                    self.scheduler.submit(
+                        req, self._session_emit(loop, sess, rid))
+                elif op == "cancel":
+                    self.scheduler.cancel(str(msg.get("id", "")))
+                elif op == "stats":
+                    outbox.put_nowait({"event": "stats",
+                                       "stats": self.scheduler.stats()})
+                elif op == "ping":
+                    outbox.put_nowait({
+                        "event": "pong",
+                        "sched_age_sec": round(
+                            time.monotonic() - self.scheduler.last_beat,
+                            3),
+                        "counters": self.scheduler.metrics_counters()})
+                elif op == "weights":
+                    try:
+                        ack = await loop.run_in_executor(
+                            None, self.scheduler.swap_weights,
+                            int(msg.get("epoch", 0)),
+                            msg.get("frames") or [])
+                        outbox.put_nowait({"event": "weights_ack", **ack})
+                    except (TimeoutError, ValueError, KeyError) as e:
+                        outbox.put_nowait({"event": "error", "id": None,
+                                           "error": f"weights push "
+                                                    f"failed: {e}"})
+                elif op == "shutdown":
+                    outbox.put_nowait({"event": "bye"})
+                    ended = True
+                    self.shutdown()
+                    break
+                elif op == "hello":
+                    pass   # duplicate hello on a live link: ignore
+                else:
+                    outbox.put_nowait({"event": "error", "id": None,
+                                       "error": f"unknown op {op!r}"})
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            if sess.outbox is outbox:
+                sess.outbox = None
+            outbox.put_nowait(None)
+            try:
+                await asyncio.wait_for(wtask, timeout=5)
+            except (asyncio.TimeoutError, ConnectionResetError,
+                    BrokenPipeError):
+                wtask.cancel()
+            if ended or self._shutdown.is_set():
+                self._end_session(sess)
+            elif sess.outbox is None and token in self._sessions:
+                # Park: generation keeps running and events accumulate
+                # in the history; the grace timer is the honest bound —
+                # a router that never returns must not pin pool blocks.
+                sess.park_handle = loop.call_later(
+                    _PARK_GRACE_SEC, self._expire_session, token)
+
+    async def _handle_plain(self, first_line: bytes,
+                            reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        loop = asyncio.get_running_loop()
         outbox: asyncio.Queue = asyncio.Queue()
         live: set = set()
 
@@ -117,9 +402,13 @@ class ReplicaServer:
                 await writer.drain()
 
         wtask = asyncio.ensure_future(write_loop())
+        pending = first_line   # the frame _handle read to sniff hello
         try:
             while True:
-                line = await reader.readline()
+                if pending is not None:
+                    line, pending = pending, None
+                else:
+                    line = await reader.readline()
                 if not line:
                     break
                 try:
@@ -159,7 +448,8 @@ class ReplicaServer:
                         "event": "pong",
                         "sched_age_sec": round(
                             time.monotonic() - self.scheduler.last_beat,
-                            3)})
+                            3),
+                        "counters": self.scheduler.metrics_counters()})
                 elif op == "weights":
                     # Live trainer→serve push: decode + apply happen on
                     # the scheduler's step boundary; swap_weights BLOCKS
@@ -195,12 +485,6 @@ class ReplicaServer:
             except (asyncio.TimeoutError, ConnectionResetError,
                     BrokenPipeError):
                 wtask.cancel()
-            self._conns.discard(writer)
-            try:
-                writer.close()
-                await writer.wait_closed()
-            except (ConnectionResetError, BrokenPipeError, OSError):
-                pass
 
 
 class ServeClient:

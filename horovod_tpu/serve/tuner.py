@@ -53,6 +53,22 @@ class ServeTuner:
         self._sched.max_batch = int(cfg["max_batch"])
         self._sched.prefill_waves = int(cfg["prefill_waves"])
 
+    def stats(self) -> dict:
+        """The tuner's stats surface (merged into scheduler stats):
+        trial progress plus the serve counters its windows are scored
+        against — tokens throughput and the prefix-cache/fused-kernel
+        instruments, so a trial log can attribute a window's score."""
+        kv = self._sched.kv
+        hits, misses = kv.prefix_hits, kv.prefix_misses
+        return {
+            "tune_trials": self.trials,
+            "tune_committed": int(self.committed is not None),
+            "tune_window_steps": self._window_steps,
+            "tune_prefix_hit_rate": (hits / (hits + misses)
+                                     if hits + misses else 0.0),
+            "tune_fused_attn_steps": self._sched._c["fused_attn_steps"],
+        }
+
     def on_step(self) -> None:
         if self.committed is not None:
             return

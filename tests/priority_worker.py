@@ -191,6 +191,37 @@ def scenario_band_fusion(rank, size, eng):
           flush=True)
 
 
+def scenario_serve_mixed(rank, size, eng):
+    # Serve-plane traffic in a shared engine world: decode collectives
+    # stamp SERVE_DECODE_BAND (0) via serve_collective_priority while
+    # train gradients ride the less-urgent bands.  The serve tensor
+    # enqueues LAST every step (a decode step finishes after the
+    # backprop burst began) yet must dispatch FIRST — zero inversions,
+    # exact values for both planes.
+    from horovod_tpu.serve.engine import serve_collective_priority
+
+    prio = serve_collective_priority()
+    assert prio == 0, (prio, dict(os.environ))
+    for s in range(8):
+        handles = []
+        for j in range(4):
+            x = np.full((256,), float(rank + 1 + j), dtype=np.float32)
+            handles.append(("train", j, eng.enqueue_allreduce(
+                x, name=f"sm.{s}.grad{j}", priority=j + 1)))
+        xs = np.full((64,), float(rank + 101), dtype=np.float32)
+        handles.append(("serve", 0, eng.enqueue_allreduce(
+            xs, name=f"sm.{s}.decode", priority=prio)))
+        for kind, j, h in handles:
+            out = eng.synchronize(h)
+            base = 101 if kind == "serve" else 1 + j
+            expect = sum(r + base for r in range(size))
+            assert np.array_equal(
+                out, np.full_like(out, np.float32(expect))), (s, kind, j)
+    st = eng.stats()
+    assert st["priority_inversions"] == 0, st["priority_inversions"]
+    print(f"SERVE_MIXED_OK rank={rank}", flush=True)
+
+
 SCENARIOS = {
     "inversions_zero": scenario_inversions_zero,
     "inversions_observed": scenario_inversions_observed,
@@ -198,6 +229,7 @@ SCENARIOS = {
     "cached_order": scenario_cached_order,
     "priority_mismatch": scenario_priority_mismatch,
     "band_fusion": scenario_band_fusion,
+    "serve_mixed": scenario_serve_mixed,
 }
 
 

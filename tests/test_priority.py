@@ -91,6 +91,17 @@ def test_fusion_respects_band_boundaries():
                 extra_env={"HOROVOD_PRIORITY_BANDS": "2"})
 
 
+def test_serve_decode_collectives_preempt_training():
+    """A replica sharing an engine world with training
+    (HOROVOD_SERVE_ENGINE=1): serve decode collectives stamp band 0 via
+    ``serve_collective_priority`` and, enqueued LAST behind every step's
+    gradient burst, still dispatch FIRST — priority_inversions == 0 and
+    both planes' values exact."""
+    run_workers(2, "serve_mixed", timeout=180, worker=PRIO_WORKER,
+                extra_env={"HOROVOD_PRIORITY_BANDS": "1",
+                           "HOROVOD_SERVE_ENGINE": "1", **_NOFUSE})
+
+
 # ---------------------------------------------------------------------------
 # Wire-policy unit rules (single-process; the multi-rank bytes +
 # convergence contract runs in bench --overlap-gate / ci)

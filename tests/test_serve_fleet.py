@@ -255,6 +255,54 @@ def test_wedged_replica_probed_killed_and_requeued(offline):
 
 
 @pytest.mark.fault
+def test_transient_link_reset_heals_without_requeue(offline):
+    """Replica 1's control socket is RESET once at decode step 4
+    (injected ``conn-reset``) while the process keeps serving.  The
+    router must ride the bounded reconnect (HOROVOD_SERVE_LINK_RETRIES):
+    the replica parks the session, the router reattaches and replays the
+    missed events, and every stream completes with the EXACT offline
+    tokens — zero ``requeued`` frames, zero replica deaths, no restart
+    budget spent.  The healing path must be invisible to clients except
+    for latency."""
+    fleet = _Fleet(replicas=2, restart=2,
+                   extra_env={"HOROVOD_FAULT_INJECT": "1:4:conn-reset",
+                              "HOROVOD_SERVE_LINK_RETRIES": "2"})
+    try:
+        cli = ServeClient("127.0.0.1", fleet.port, timeout=240)
+        rng = np.random.default_rng(19)
+        prompts = [rng.integers(0, 512, int(rng.integers(3, 12))).tolist()
+                   for _ in range(8)]
+        results = _run_jobs(cli, prompts, max_tokens=20)
+        for i, prompt in enumerate(prompts):
+            evs = results[f"job{i}"]
+            assert evs[-1]["event"] == "done", f"job{i} dropped: {evs[-1]}"
+            assert not any(e["event"] == "requeued" for e in evs), \
+                f"job{i} was requeued — healing should have hidden " \
+                f"the reset: {evs}"
+            # Bit-exact stream THROUGH the reset: the token events in
+            # order spell the authoritative output (no gap, no dup).
+            streamed = [e["token"] for e in evs if e["event"] == "token"]
+            assert streamed == evs[-1]["tokens"], f"job{i} stream gap"
+            np.testing.assert_array_equal(
+                np.asarray(evs[-1]["tokens"]), offline(prompt, 20))
+        assert any("injected fault 'conn-reset'" in line
+                   for line in fleet.log), \
+            "fault never fired:\n" + "".join(fleet.log[-30:])
+        stats = cli.stats()
+        assert stats["router"]["completed"] == 8
+        assert stats["router"]["link_reconnects"] >= 1, stats["router"]
+        assert stats["router"]["requeued"] == 0, stats["router"]
+        assert stats["router"]["replica_deaths"] == 0, stats["router"]
+        assert stats["router"]["restarts_left"] == 2, stats["router"]
+        assert all(r["alive"] for r in stats["replicas"])
+        rc = fleet.stop(cli)
+        assert rc == 0, "".join(fleet.log[-20:])
+        cli.close()
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.fault
 def test_replica_death_requeues_all_requests(offline):
     """Kill replica 1 after 4 decode steps (HOROVOD_FAULT_INJECT
     schedule): its in-flight requests are re-queued onto replica 0 and
