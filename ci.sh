@@ -39,11 +39,15 @@ PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
     python -m pytest tests/ -q -m "fault and not slow and not scale and not observability" \
     --deselect tests/test_fault_tolerance.py::test_shrink_to_survivors_completes_at_smaller_size
 
-echo "== chaos membership soak (seeded multi-failure, hard timeout) =="
+echo "== chaos membership soak + heavy fault tests (hard timeout) =="
 # Randomized-but-seeded fault schedules over elastic runs: every seed
 # must converge or stop with the clean HOROVOD_ELASTIC_MIN_SIZE error —
-# never hang (the timeout is the hang detector).
-PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
+# never hang (the timeout is the hang detector).  The heavyweight
+# fault-injection tests (serve-fleet wedge/death/link-reset, autotune
+# hang-mid-trial) are fault+slow so they ride THIS budget instead of
+# the tier-1 sweep's — that sweep has a hard wall-clock ceiling and
+# these four alone burn ~150 s.
+PALLAS_AXON_POOL_IPS= timeout -k 15 1200 \
     python -m pytest tests/ -q -m "fault and slow and not scale"
 
 echo "== link-heal gate (transparent reconnect under conn-reset, hard timeout) =="
@@ -158,6 +162,21 @@ echo "== sharded gate (ZeRO-1 bitwise parity + wire-bytes ratio, hard timeout) =
 # the RS half-cascade.
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
     python bench_engine.py --sharded-gate
+
+echo "== fsdp gate (ZeRO-3 param sharding + band-0 allgather prefetch, hard timeout) =="
+# Full parameter sharding (HOROVOD_FSDP): (1) the 4-rank FsdpPlane walk
+# must stay BIT-IDENTICAL to a dense replicated SGD loop while the
+# grads-RS moves [0.40, 0.55]x the dense allreduce's deterministic
+# data_bytes_tx (the RS half of the ring); (2) the resident-param peak
+# counter must stay <= 0.45x the dense total at 4 ranks (measured
+# ~0.31x: 1/N owned shards + one in-flight unit); (3) prefetch-on must
+# hold >= 0.95x prefetch-off on the forward gather walk, judged on the
+# best PAIRED in-process interleaved round (both planes live in one
+# process, alternating order — the only protocol that survives this
+# box's CPU-ceilinged loopback; floor, not speedup).  The hard timeout
+# is the wedge detector for the per-unit AG/RS cascades.
+PALLAS_AXON_POOL_IPS= timeout -k 15 900 \
+    python bench_engine.py --fsdp-gate
 
 echo "== compression gate (wire dtypes + sparse error feedback, hard timeout) =="
 # Wire-level gradient compression: (1) the fp32-wire DEFAULT must be

@@ -226,6 +226,19 @@ KNOBS: List[Knob] = [
          "optimizer — reducescatter(grads), shard-local update, "
          "allgather(params); ~1/N optimizer memory per rank "
          "(docs/zero.md)"),
+    Knob("HOROVOD_FSDP", "0",
+         lambda raw: str(1 if (raw or "").strip() not in
+                         ("", "0", "false", "False") else 0),
+         "DistributedOptimizer(fsdp=) default: ZeRO-3/FSDP full "
+         "parameter sharding — per-unit JIT allgather forward, async "
+         "reducescatter backward, free-after-use; peak param residency "
+         "~1/N + one gathered unit (docs/zero.md)"),
+    Knob("HOROVOD_FSDP_PREFETCH", "1",
+         lambda raw: str(max(0, _int_env(raw, 1))),
+         "FSDP prefetch depth in units: each gather enqueues the next "
+         "k allgathers at priority band 0 so the banded scheduler "
+         "overlaps them with compute (0 disables — every gather "
+         "blocks)"),
     Knob("HOROVOD_LOCAL_SGD_STEPS", "1",
          lambda raw: str(max(1, _int_env(raw, 1))),
          "local-SGD periodic sync: H local steps per outer model-delta "

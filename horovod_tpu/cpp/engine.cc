@@ -2710,6 +2710,17 @@ void Engine::NoteQuorumLag(
   if (lag > attr.max_ns) attr.max_ns = lag;
 }
 
+void Engine::NoteSkippedQuorumLag(int64_t lag_ns) {
+  std::lock_guard<std::mutex> lk(quorum_mu_);
+  constexpr size_t kCap = 4096;
+  if (quorum_lag_samples_.size() < kCap) {
+    quorum_lag_samples_.push_back(lag_ns);
+  } else {
+    quorum_lag_samples_[quorum_lag_next_ % kCap] = lag_ns;
+  }
+  ++quorum_lag_next_;
+}
+
 int64_t Engine::QuorumLagNsPercentile(double p) const {
   std::vector<int64_t> snap;
   {
@@ -4264,12 +4275,24 @@ void Engine::MaybePartialCommits(ResponseList* out) {
   // window — i.e. a rank is skipped only when it lags the QUORUM by
   // more than the grace, never because one early-bird request (a
   // one-shot straggler catching up ahead of peers) aged the entry.
-  auto quorum_ready =
-      [&](std::vector<std::chrono::steady_clock::time_point> times) {
-        if (static_cast<int>(times.size()) < need) return false;
+  // Returns how long the quorum has been waiting (ns) when the commit
+  // may fire, -1 otherwise.  The wait doubles as the synthetic quorum-
+  // lag sample stamped at commit time (NoteSkippedQuorumLag): a partial
+  // commit means the skipped voter trails the quorum by AT LEAST this
+  // long, and recording it keeps the backup=auto arming window
+  // deterministic while skips are occurring (committed-without-the-
+  // straggler entries otherwise stop feeding the window).
+  auto quorum_wait_ns =
+      [&](std::vector<std::chrono::steady_clock::time_point> times)
+      -> int64_t {
+        if (static_cast<int>(times.size()) < need) return -1;
         std::nth_element(times.begin(), times.begin() + (need - 1),
                          times.end());
-        return now - times[need - 1] >= grace;
+        const auto waited = now - times[need - 1];
+        if (waited < grace) return -1;
+        return std::chrono::duration_cast<std::chrono::nanoseconds>(
+                   waited)
+            .count();
       };
 
   // Full-request pending entries.  Names first: the commit erases them.
@@ -4334,7 +4357,8 @@ void Engine::MaybePartialCommits(ResponseList* out) {
         if (info.seen[r]) ready_times.push_back(info.seen_time[r]);
       }
     }
-    if (!quorum_ready(std::move(ready_times))) continue;
+    const int64_t waited_ns = quorum_wait_ns(std::move(ready_times));
+    if (waited_ns < 0) continue;
     std::vector<uint32_t> participants;
     for (int r = 0; r < size_; ++r) {
       if (rank_in[r]) participants.push_back(static_cast<uint32_t>(r));
@@ -4349,19 +4373,21 @@ void Engine::MaybePartialCommits(ResponseList* out) {
         "partial", control_cycle_seq_, "%s skipped=%s", name.c_str(),
         RankListString(rank_in, size_, true).c_str());
     out->responses.push_back(BuildPartialResponse(name, participants));
+    NoteSkippedQuorumLag(waited_ns);
   }
 
   // Cached-slot readiness bits: same voter threshold, the replayed
   // response comes from each rank's replica (the coordinator's own
   // replica supplies the eligibility check — SUM allreduce only).
-  std::vector<uint32_t> pslots;
+  std::vector<std::pair<uint32_t, int64_t>> pslots;
   for (auto& kv : coord_slot_bits_) {
     if (kv.second.count < need || kv.second.count >= nvoters) continue;
     std::vector<std::chrono::steady_clock::time_point> vt;
     for (size_t v = 0; v < kv.second.seen.size(); ++v) {
       if (kv.second.seen[v]) vt.push_back(kv.second.seen_time[v]);
     }
-    if (!quorum_ready(std::move(vt))) continue;
+    const int64_t waited_ns = quorum_wait_ns(std::move(vt));
+    if (waited_ns < 0) continue;
     auto ce = cache_entries_.find(kv.first);
     if (ce == cache_entries_.end()) continue;  // defensive
     if ((ce->second.response.type != ResponseType::ALLREDUCE &&
@@ -4369,10 +4395,10 @@ void Engine::MaybePartialCommits(ResponseList* out) {
         ce->second.response.red_op != ReduceOp::SUM) {
       continue;
     }
-    pslots.push_back(kv.first);
+    pslots.emplace_back(kv.first, waited_ns);
   }
   std::sort(pslots.begin(), pslots.end());
-  for (uint32_t slot : pslots) {
+  for (const auto& [slot, slot_waited_ns] : pslots) {
     const SlotPending& sp = coord_slot_bits_[slot];
     std::vector<bool> rank_in(size_, false);
     if (hier) {
@@ -4409,6 +4435,7 @@ void Engine::MaybePartialCommits(ResponseList* out) {
     ps.slot = slot;
     ps.participants = std::move(participants);
     out->partial_slots.push_back(std::move(ps));
+    NoteSkippedQuorumLag(slot_waited_ns);
   }
 }
 
@@ -6636,9 +6663,12 @@ void Engine::ExecAllgather(const Response& response,
 
   if (size_ > 1) {
     // The sharded optimizer's parameter/update allgather gets its own
-    // span so ZeRO steps are attributable in traces next to "RS".
+    // span so ZeRO steps are attributable in traces next to "RS", and
+    // the FSDP plane's just-in-time parameter gathers get "FSDP_AG" so
+    // prefetch overlap is visible against compute.
     timeline_.ActivityStart(e.name,
-                            e.name.rfind("sharded.ag.", 0) == 0
+                            e.name.rfind("fsdp.", 0) == 0 ? "FSDP_AG"
+                            : e.name.rfind("sharded.ag.", 0) == 0
                                 ? "AG_PARAMS" : "RING_ALLGATHER");
     // Circulate blocks around the flat ring (shm on a whole-world host
     // group, TCP otherwise); after size-1 steps everyone has all.
@@ -6906,7 +6936,10 @@ void Engine::ExecReducescatter(const Response& response,
   bool ok;
   std::string msg;
   auto t0 = std::chrono::steady_clock::now();
-  timeline_.ActivityStart(tname, "RS");
+  // FSDP grad reduce-scatters get their own span (like FSDP_AG) so a
+  // ZeRO-3 step's backward cascade is attributable in traces.
+  timeline_.ActivityStart(tname,
+                          tname.rfind("fsdp.", 0) == 0 ? "FSDP_RS" : "RS");
   if (!half_path) {
     // Exact-parity fallback: the full allreduce cascade on the staged
     // buffer — the SAME RunAllreduceCascade selection ExecAllreduce

@@ -1121,6 +1121,14 @@ class Engine {
   void NoteQuorumLag(
       const std::vector<std::chrono::steady_clock::time_point>& times,
       const std::vector<int>& voter_ranks);
+  // Synthetic lag sample recorded when a partial commit fires: the
+  // skipped voter trails the quorum by at least the time the quorum has
+  // been waiting (>= the grace window by construction).  Keeps the
+  // arming window saturated while skips are actively occurring —
+  // without it, post-arming entries commit WITHOUT the straggler and
+  // stop producing lag samples, so the armed verdict would decay and
+  // oscillate on window churn.
+  void NoteSkippedQuorumLag(int64_t lag_ns);
   int64_t QuorumLagNsPercentile(double p) const;
   mutable std::mutex quorum_mu_;
   std::vector<int64_t> quorum_lag_samples_;

@@ -341,14 +341,36 @@ class DistributedOptimizer:
     unsharded flat step — asserted per dtype in tests.  Host path only
     (inside jit use the fsdp mesh axis instead); fp32 params only; see
     docs/zero.md for the memory math and resize semantics.
+
+    ``fsdp=True`` (default: ``HOROVOD_FSDP``) climbs one more rung of the
+    sharding ladder (ZeRO-3/FSDP): the model is cut into per-layer
+    UNITS — one per top-level key of the param tree, or explicit groups
+    via ``fsdp_units=[["embed", "lm_head"], ...]`` — and each unit gets
+    its own :class:`~horovod_tpu.runtime.fsdp.FsdpPlane` window.
+    ``update`` enqueues every unit's gradient reducescatter up front in
+    reverse unit order with priority band = unit index (the backward
+    cascade: early-forward units land in urgent bands because the next
+    step needs them first), runs each unit's inner update on the owned
+    shard as its reduction drains, and pipelines the per-unit update
+    allgathers at band 0 so they overlap later units' shard updates.
+    Inner optimizer state is per-unit shard-sized (the same ~1/N as
+    ZeRO-1), the step stays bit-identical to the unsharded anchor, and
+    the optax interface is unchanged (full ``updates`` tree out).  Full
+    1/N *parameter* residency — gather/free around each layer's
+    compute — is the plane's own API
+    (:meth:`horovod_tpu.runtime.fsdp.FsdpPlane.gather`); a tree-in/
+    tree-out optax wrapper cannot free params it does not own, and
+    docs/zero.md is honest about that line.
     """
 
     def __init__(self, optimizer, *, axis_name=None, op=Average,
                  compression=Compression.none, fusion_threshold_bytes=None,
                  reduce_gradients=True, name=None, local_sgd_steps=None,
-                 sharded=None):
+                 sharded=None, fsdp=None, fsdp_units=None,
+                 fsdp_prefetch=None):
         from horovod_tpu.elastic.state import (LocalSGD,
                                                default_local_sgd_steps)
+        from horovod_tpu.runtime.fsdp import fsdp_default
         from horovod_tpu.runtime.sharded import sharded_default
 
         self._inner = optimizer
@@ -363,23 +385,34 @@ class DistributedOptimizer:
                                  else max(1, int(local_sgd_steps)))
         self._sharded = (sharded_default() if sharded is None
                          else bool(sharded))
-        if self._sharded and self._local_sgd_steps > 1:
+        self._fsdp = fsdp_default() if fsdp is None else bool(fsdp)
+        if self._fsdp and self._sharded:
             raise ValueError(
-                "sharded=True and local_sgd_steps>1 are mutually "
+                "fsdp=True and sharded=True are mutually exclusive: "
+                "FSDP subsumes the ZeRO-1 step (pick one rung of the "
+                "ladder; see docs/zero.md)")
+        if (self._sharded or self._fsdp) and self._local_sgd_steps > 1:
+            raise ValueError(
+                "sharded/fsdp and local_sgd_steps>1 are mutually "
                 "exclusive: local SGD skips the per-step reduction the "
                 "sharded step is built around")
-        if self._sharded and not reduce_gradients:
+        if (self._sharded or self._fsdp) and not reduce_gradients:
             raise ValueError(
-                "sharded=True requires reduce_gradients=True: the ZeRO "
+                "sharded/fsdp requires reduce_gradients=True: the ZeRO "
                 "step IS the reduction (reducescatter -> shard update "
                 "-> allgather); without it the shard-sized state cannot "
                 "apply and ranks would silently diverge")
-        if self._sharded and op not in (Average, Sum):
+        if (self._sharded or self._fsdp) and op not in (Average, Sum):
             raise ValueError(
-                "sharded=True reduces gradients with SUM/AVERAGE only")
+                "sharded/fsdp reduces gradients with SUM/AVERAGE only")
         #: Lazy ZeRO state (built on first init() from the param tree).
         self._sharder = None
         self._tree_shapes = None
+        #: Lazy FSDP state (unit planes built on first init()).
+        self._fsdp_plane = None
+        self._fsdp_groups = None
+        self._fsdp_unit_spec = fsdp_units
+        self._fsdp_prefetch = fsdp_prefetch
         #: The periodic-sync policy (None when H <= 1 — fully
         #: synchronous, the pre-local-SGD contract, byte-identical).
         self.local_sgd = (LocalSGD(self._local_sgd_steps,
@@ -400,21 +433,30 @@ class DistributedOptimizer:
             fusion_threshold_bytes=self._fusion_threshold,
             reduce_gradients=self._reduce, name=self.name,
             local_sgd_steps=self._local_sgd_steps,
-            sharded=self._sharded,
+            sharded=self._sharded, fsdp=self._fsdp,
+            fsdp_units=self._fsdp_unit_spec,
+            fsdp_prefetch=self._fsdp_prefetch,
         )
         # Share the policy/sharder instances: anchors and counters live
         # with the training run, not with any one bound copy.
         copy.local_sgd = self.local_sgd
         copy._sharder = self._sharder
         copy._tree_shapes = self._tree_shapes
+        copy._fsdp_plane = self._fsdp_plane
+        copy._fsdp_groups = self._fsdp_groups
         return copy
 
     def init(self, params):
+        if self._fsdp:
+            return self._fsdp_init(params)
         if not self._sharded:
             return self._inner.init(params)
         return self._sharded_init(params)
 
     def update(self, grads, state, params=None, **extra):
+        # FSDP path: per-unit RS cascade → shard updates → banded AGs.
+        if self._fsdp and self._reduce:
+            return self._fsdp_update(grads, state, params, **extra)
         # ZeRO path: RS(flat grads) → shard-local inner update → AG.
         if self._sharded and self._reduce:
             return self._sharded_update(grads, state, params, **extra)
@@ -507,9 +549,183 @@ class DistributedOptimizer:
             treedef, [jnp.asarray(o) for o in outs])
         return updates, box["state"]
 
+    # -- ZeRO-3/FSDP path (host-driven; see docs/zero.md) --
+
+    def _fsdp_init(self, params):
+        import numpy as np
+        import jax.numpy as jnp
+        from horovod_tpu.ops.compression import TopKCompressor
+        from horovod_tpu.runtime.fsdp import FsdpPlane
+
+        if isinstance(self._compression, TopKCompressor):
+            raise ValueError(
+                "fsdp=True reduces gradients with reducescatter; the "
+                "top-k sparse path has no scatter half — use a wire "
+                "compressor (Compression.wire_bf16 etc.) instead")
+        leaves = jax.tree.leaves(params)
+        for leaf in leaves:
+            if jnp.asarray(leaf).dtype != jnp.float32:
+                raise TypeError(
+                    "fsdp=True requires float32 params (the fp32-master "
+                    "mixed-precision variant lives in the torch FSDP "
+                    "optimizer; see docs/zero.md) — got "
+                    f"{jnp.asarray(leaf).dtype}")
+        self._fsdp_groups = _fsdp_unit_groups(params,
+                                              self._fsdp_unit_spec)
+        wire = getattr(self._compression, "engine_wire_dtype", None)
+        wire = wire if wire in ("fp16", "bf16", "int8", "fp8") else None
+        np_leaves = [np.asarray(leaf) for leaf in leaves]
+        self._fsdp_plane = FsdpPlane(
+            [[np_leaves[j] for j in idxs]
+             for _, idxs in self._fsdp_groups],
+            name=self.name, prefetch=self._fsdp_prefetch,
+            wire_dtype=wire, average=(self._op is Average))
+        # Per-unit inner states, each shard-sized: the whole optimizer
+        # footprint is ~1/N like ZeRO-1, but reductions/gathers are now
+        # per-unit so the banded scheduler can overlap them.
+        return tuple(self._inner.init(jnp.asarray(self._fsdp_plane.shard(i)))
+                     for i in range(self._fsdp_plane.n_units))
+
+    def _fsdp_update(self, grads, state, params=None, **extra):
+        import numpy as np
+        import jax.numpy as jnp
+        from horovod_tpu.runtime import engine_or_none
+        from horovod_tpu.runtime.fsdp import _note_prefetch
+        from horovod_tpu.runtime.sharded import FlatSharder
+
+        leaves, treedef = jax.tree.flatten(grads)
+        if leaves and _is_traced(leaves[0]):
+            raise RuntimeError(
+                "fsdp=True is the host-driven (eager/DCN) path; inside "
+                "jit shard params with the mesh's 'fsdp' axis instead "
+                "(parallel/mesh.py)")
+        plane = self._fsdp_plane
+        if plane is None:
+            raise RuntimeError(
+                "fsdp DistributedOptimizer.update() before init(): the "
+                "unit layout is anchored at init(params)")
+        p_leaves = ([np.asarray(leaf) for leaf in jax.tree.leaves(params)]
+                    if params is not None else None)
+        g_leaves = [np.asarray(leaf) for leaf in leaves]
+        eng = engine_or_none()
+        new_states = [None] * plane.n_units
+        unit_updates = [None] * plane.n_units
+        ag_handles = {}
+        try:
+            # Backward cascade: enqueue EVERY unit's reducescatter up
+            # front, last unit first (its grads finish first in a real
+            # vjp), priority band = unit index so the units the next
+            # forward needs first win the wire.
+            for i in reversed(range(plane.n_units)):
+                _, idxs = self._fsdp_groups[i]
+                plane.reduce_grads(i, [g_leaves[j] for j in idxs])
+            for i in range(plane.n_units):
+                u = plane.units[i]
+                g_shard = plane.wait_grads(i)
+                p_shard = None
+                if p_leaves is not None:
+                    _, idxs = self._fsdp_groups[i]
+                    p_shard = jnp.asarray(FlatSharder.slice_flat(
+                        [p_leaves[j] for j in idxs],
+                        u.sharder.offset, u.sharder.count, np.float32))
+                upd, new_states[i] = self._inner.update(
+                    jnp.asarray(g_shard), state[i], p_shard, **extra)
+                upd = np.asarray(upd, dtype=np.float32)
+                if eng is None:
+                    unit_updates[i] = upd
+                else:
+                    # Band-0 update allgather: in flight while LATER
+                    # units' reductions drain and shards update.
+                    ag_handles[i] = eng.enqueue_allgather(
+                        upd, name=f"{plane._wire_name}.u{i}.agu",
+                        priority=0)
+            for i in sorted(ag_handles):
+                # Overlap accounting: the gather was free iff it landed
+                # before this drain reached it.
+                _note_prefetch(eng.poll(ag_handles[i]))
+                unit_updates[i] = np.asarray(
+                    eng.synchronize(ag_handles.pop(i)))
+        except BaseException:
+            # Drain hygiene: never strand a handle (StepSkipped on one
+            # unit must not leave the others' buffers in flight).
+            plane.drain()
+            for i in list(ag_handles):
+                try:
+                    eng.synchronize(ag_handles.pop(i))
+                except BaseException:
+                    pass
+            raise
+        out_leaves = [None] * len(leaves)
+        for i, (_, idxs) in enumerate(self._fsdp_groups):
+            u = plane.units[i]
+            outs = FlatSharder.unflatten(unit_updates[i], u.shapes)
+            for j, o in zip(idxs, outs):
+                out_leaves[j] = jnp.asarray(o)
+        plane.step()
+        updates = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        return updates, tuple(new_states)
+
     # Make it quack like an optax.GradientTransformation namedtuple.
     def __iter__(self):
         return iter((self.init, self.update))
+
+
+def _fsdp_unit_groups(params, fsdp_units=None):
+    """FSDP unit boundaries from the param tree's TOP-LEVEL structure:
+    ``[(unit_name, [global leaf indices])]`` in jax flatten order.  A
+    dict tree gets one unit per key (jax flattens dicts key-sorted); a
+    list/tuple one per element; anything else is a single unit.
+    ``fsdp_units=[["embed", "lm_head"], ["blocks"]]`` overrides with
+    explicit key groups — every top-level key exactly once (tied layers
+    that must share a window, or tiny layers worth coalescing)."""
+    if isinstance(params, dict):
+        try:
+            keys = sorted(params)
+        except TypeError as e:
+            raise TypeError(
+                "fsdp=True needs sortable top-level dict keys (jax's own "
+                "dict flatten order)") from e
+        spans, off = {}, 0
+        for k in keys:
+            cnt = len(jax.tree_util.tree_leaves(params[k]))
+            spans[k] = list(range(off, off + cnt))
+            off += cnt
+        if fsdp_units is not None:
+            groups, seen = [], set()
+            for gi, group in enumerate(fsdp_units):
+                idxs = []
+                for k in group:
+                    if k not in spans:
+                        raise ValueError(
+                            f"fsdp_units names unknown top-level key "
+                            f"{k!r} (have {sorted(map(str, keys))})")
+                    if k in seen:
+                        raise ValueError(
+                            f"fsdp_units lists key {k!r} twice")
+                    seen.add(k)
+                    idxs.extend(spans[k])
+                if idxs:
+                    groups.append(("+".join(map(str, group)), idxs))
+            missing = [str(k) for k in keys if k not in seen and spans[k]]
+            if missing:
+                raise ValueError(
+                    f"fsdp_units must cover every top-level key; "
+                    f"missing {missing}")
+            return groups
+        return [(str(k), spans[k]) for k in keys if spans[k]]
+    if isinstance(params, (list, tuple)):
+        groups, off = [], 0
+        for i, sub in enumerate(params):
+            cnt = len(jax.tree_util.tree_leaves(sub))
+            if cnt:
+                groups.append((str(i), list(range(off, off + cnt))))
+            off += cnt
+        if fsdp_units is not None:
+            raise ValueError(
+                "fsdp_units grouping needs a dict param tree")
+        return groups
+    n = len(jax.tree_util.tree_leaves(params))
+    return [("all", list(range(n)))]
 
 
 def broadcast_parameters(params, root_rank=0, *, axis_name=None):

@@ -174,16 +174,33 @@ def make_pipelined_llama_train_step(cfg, optimizer, mesh, *,
     ``step(params, opt_state, inputs, targets) ->
     (params, opt_state, loss)`` with ``params`` from
     :func:`init_pipelined_llama`.
+
+    FSDP composition: wrapping the optimizer as
+    ``DistributedOptimizer(inner, fsdp=True)`` on a mesh with a
+    non-trivial ``fsdp`` axis shards the GSPMD-level OPTIMIZER STATE
+    over that axis (each moment tensor constrained to 1/|fsdp| per
+    device; XLA inserts the allgather/reducescatter halves around the
+    update).  The batch already shards over the data-LIKE axes —
+    ``data`` and ``fsdp`` both carry microbatches — so pipeline × fsdp
+    × data coexist on one mesh: ``build_mesh({"pipe": P, "fsdp": F,
+    "data": D})``.  This is the in-jit rung of the sharding ladder; the
+    host-driven eager rung is ``runtime/fsdp.py`` (docs/zero.md).
     """
     import optax
+    from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
     from horovod_tpu.models.llama import LlamaLayer, rope_freqs
-    from horovod_tpu.parallel.mesh import data_axes
+    from horovod_tpu.parallel.mesh import AXIS_FSDP, data_axes
 
     from horovod_tpu.jax import DistributedOptimizer
 
+    fsdp_axis = None
     if isinstance(optimizer, DistributedOptimizer):
+        if getattr(optimizer, "_fsdp", False) \
+                and AXIS_FSDP in mesh.axis_names \
+                and mesh.shape[AXIS_FSDP] > 1:
+            fsdp_axis = AXIS_FSDP
         # Gradients are already data-psum'd inside the shard_map below.
         optimizer = optimizer.inner
 
@@ -244,6 +261,32 @@ def make_pipelined_llama_train_step(cfg, optimizer, mesh, *,
     stage_specs = P(pipe_axis)
     batch_spec = P(tuple(batch_axes) if batch_axes else None)
 
+    def _fsdp_state_spec(shape, n_stages):
+        """ZeRO spec for one optimizer-state leaf: stage-stacked moments
+        keep their pipe dim, then the first remaining dim divisible by
+        the fsdp axis shards over it (scalars and indivisible leaves
+        stay replicated — counts, tiny norms)."""
+        fsdp_size = mesh.shape[fsdp_axis]
+        spec = [None] * len(shape)
+        start = 0
+        if shape and shape[0] == n_stages:
+            spec[0] = pipe_axis
+            start = 1
+        for d in range(start, len(shape)):
+            if shape[d] >= fsdp_size and shape[d] % fsdp_size == 0:
+                spec[d] = fsdp_axis
+                break
+        return P(*spec)
+
+    def _constrain_opt_state(opt_state, n_stages):
+        def leaf(a):
+            if not hasattr(a, "shape"):
+                return a
+            return lax.with_sharding_constraint(
+                a, NamedSharding(mesh,
+                                 _fsdp_state_spec(a.shape, n_stages)))
+        return jax.tree.map(leaf, opt_state)
+
     def step(params, opt_state, inputs, targets):
         loss, grads = shard_map(
             _grads, mesh=mesh,
@@ -259,6 +302,9 @@ def make_pipelined_llama_train_step(cfg, optimizer, mesh, *,
             check_vma=False,
         )(params["stages"], params["rest"], inputs, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if fsdp_axis is not None:
+            n_stages = jax.tree.leaves(params["stages"])[0].shape[0]
+            opt_state = _constrain_opt_state(opt_state, n_stages)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 

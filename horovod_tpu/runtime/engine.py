@@ -145,6 +145,14 @@ def _checkpoint_stats() -> dict:
     return checkpoint_stats()
 
 
+def _fsdp_stats() -> dict:
+    """The FSDP plane's stats() slice (lazy import: the plane imports
+    this module for its collectives, like the checkpoint plane)."""
+    from horovod_tpu.runtime.fsdp import fsdp_stats
+
+    return fsdp_stats()
+
+
 def _dtype_code(dtype) -> int:
     name = np.dtype(dtype).name if np.dtype(dtype).name in _DTYPE_CODES \
         else str(dtype)
@@ -499,9 +507,15 @@ class NativeEngine:
             wire_advisory=wire_advisory)
 
     def enqueue_allgather(self, arr: np.ndarray,
-                          name: Optional[str] = None) -> int:
+                          name: Optional[str] = None,
+                          priority: Optional[int] = None) -> int:
+        """Gather every rank's dim-0 slice (sizes may differ).
+        ``priority`` as in :meth:`enqueue_allreduce` — the FSDP plane
+        stamps band 0 on its just-in-time parameter prefetches so the
+        banded scheduler dispatches them ahead of bulk traffic."""
         return self._enqueue(
-            _OP_ALLGATHER, arr, self._auto_name("allgather", name))
+            _OP_ALLGATHER, arr, self._auto_name("allgather", name),
+            priority=priority)
 
     def enqueue_probe(self, arr: np.ndarray, name: str) -> int:
         """Layout-probe allreduce (sum) of placeholder zeros for a tensor
@@ -734,6 +748,9 @@ class NativeEngine:
             # The checkpoint plane's counters (Python-side, like
             # sparse_count: the writer thread lives above the engine).
             **_checkpoint_stats(),
+            # The FSDP plane's counters (Python-side: unit registry,
+            # prefetch hit/miss, resident full-parameter bytes + peak).
+            **_fsdp_stats(),
             "topology": {
                 "hosts": self._lib.horovod_topology_hosts(),
                 "local_ranks": self._lib.horovod_topology_local_ranks(),
@@ -813,7 +830,13 @@ class NativeEngine:
                      "clock_offset_ns",
                      "checkpoint_ns_p50",
                      "checkpoint_ns_p99",
-                     "last_checkpoint_step"):
+                     "last_checkpoint_step",
+                     # FSDP gauges: units registered, bytes of full
+                     # (gathered) params resident now, and the high-water
+                     # mark — none are cumulative counters.
+                     "fsdp_units",
+                     "fsdp_param_bytes_resident",
+                     "fsdp_param_bytes_resident_peak"):
                 delta[k] = v
                 continue
             delta[k] = v - since.get(k, 0)
@@ -1008,11 +1031,13 @@ class NativeEngine:
             return out
         return self._apply_average(out, info.get("participants") or None)
 
-    def allgather(self, tensor, *, name: Optional[str] = None) -> np.ndarray:
+    def allgather(self, tensor, *, name: Optional[str] = None,
+                  priority: Optional[int] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)
         if arr.ndim == 0:
             arr = arr.reshape(1)
-        return self.synchronize(self.enqueue_allgather(arr, name))
+        return self.synchronize(self.enqueue_allgather(arr, name,
+                                                       priority=priority))
 
     def broadcast(self, tensor, root_rank: int,
                   *, name: Optional[str] = None) -> np.ndarray:

@@ -1,0 +1,427 @@
+"""ZeRO-3/FSDP parameter-sharding plane (Rajbhandari et al. stage 3;
+Zhao et al., "PyTorch FSDP" — the production pattern).
+
+ZeRO-1 (`runtime/sharded.py`) shards OPTIMIZER STATE: every rank still
+holds full params and full grads, so model size caps at per-rank RAM.
+This plane shards the PARAMETERS themselves.  The model is cut into
+per-layer **units**; each unit's params are flattened onto a
+:class:`~horovod_tpu.runtime.sharded.FlatSharder` window (the same
+largest-first split and epoch-stamped world anchor ZeRO-1 uses — which
+is what keeps every collective bit-exact against the unsharded anchor),
+and each rank retains only its owned window:
+
+* **forward**: :meth:`FsdpPlane.gather` allgathers a unit's shards
+  just-in-time and enqueues the NEXT unit's allgather at priority band 0
+  (``HOROVOD_FSDP_PREFETCH`` units ahead, default 1) so the banded
+  scheduler (HOROVOD_PRIORITY_BANDS) overlaps the wire with the current
+  unit's compute;
+* **backward**: :meth:`FsdpPlane.reduce_grads` reducescatters a unit's
+  grads the moment its vjp completes (async handle; the PR 12 RS
+  cascade), with the PR 15 advisory wire-dtype seam available per unit;
+* **after use**: :meth:`FsdpPlane.free` drops the gathered full params
+  immediately — peak residency is ~(owned shards + one or two gathered
+  units), the 1/N memory the ci fsdp gate measures.
+
+Bit-exactness rides the ZeRO-1 chain unchanged: 1-D flat units make
+``reducescatter(g)[rank]`` bit-for-bit ``allreduce(g)`` sliced, an
+elementwise shard update computes the same bytes as the full update, and
+the allgather is lossless — so an FSDP step is bit-identical to the
+unsharded flat step (asserted after EVERY step in tests/fsdp_worker.py).
+
+Observability: collectives are named ``fsdp.*`` so the engine timeline
+marks them ``FSDP_AG``/``FSDP_RS``; ``stats()`` gains ``fsdp_units``,
+``fsdp_ag_prefetch_hits``/``_misses`` (the prefetched allgather was
+complete when the unit was needed vs the gather blocked), and
+``fsdp_param_bytes_resident``/``_peak`` (deterministic byte accounting
+of shards + gathered fulls — the memory gate's instrument).
+
+Deliberately jax/torch-free (numpy + the native engine), like
+runtime.sharded — both frontends drive this plane.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from horovod_tpu.runtime import engine_or_none
+from horovod_tpu.runtime.engine import note_sharded_step
+from horovod_tpu.runtime.sharded import FlatSharder, ShardResizeError
+
+__all__ = ["FsdpPlane", "FsdpUnit", "fsdp_default", "prefetch_default",
+           "fsdp_stats", "reset_fsdp_stats", "ShardResizeError"]
+
+
+def fsdp_default() -> bool:
+    """The ``HOROVOD_FSDP`` env default for
+    ``DistributedOptimizer(fsdp=None)`` (0/off unless set)."""
+    raw = os.environ.get("HOROVOD_FSDP", "")
+    return raw.strip() not in ("", "0", "false", "False")
+
+
+def prefetch_default() -> int:
+    """``HOROVOD_FSDP_PREFETCH`` (lenient-parsed): how many units ahead
+    the forward gather enqueues at band 0.  Default 1; 0 disables
+    prefetch (every gather blocks — the overlap gate's OFF arm)."""
+    raw = os.environ.get("HOROVOD_FSDP_PREFETCH", "")
+    try:
+        return max(0, int(raw)) if raw.strip() else 1
+    except ValueError:
+        return 1
+
+
+# -- the plane's stats() slice (Python-side, like the checkpoint
+#    plane's: the registry/prefetch bookkeeping lives above the engine).
+#    Gauges (units, resident, peak) carry current values in
+#    stats_delta; hits/misses are cumulative counters. --
+
+_STATS_LOCK = threading.Lock()
+_UNITS = 0
+_PREFETCH_HITS = 0
+_PREFETCH_MISSES = 0
+_RESIDENT = 0
+_RESIDENT_PEAK = 0
+
+
+def fsdp_stats() -> dict:
+    with _STATS_LOCK:
+        return {
+            "fsdp_units": _UNITS,
+            "fsdp_ag_prefetch_hits": _PREFETCH_HITS,
+            "fsdp_ag_prefetch_misses": _PREFETCH_MISSES,
+            "fsdp_param_bytes_resident": _RESIDENT,
+            "fsdp_param_bytes_resident_peak": _RESIDENT_PEAK,
+        }
+
+
+def reset_fsdp_stats() -> None:
+    """Zero the plane counters (tests; a live plane keeps its own
+    bookkeeping, so only reset between plane lifetimes)."""
+    global _UNITS, _PREFETCH_HITS, _PREFETCH_MISSES
+    global _RESIDENT, _RESIDENT_PEAK
+    with _STATS_LOCK:
+        _UNITS = _PREFETCH_HITS = _PREFETCH_MISSES = 0
+        _RESIDENT = _RESIDENT_PEAK = 0
+
+
+def _note_units(delta: int) -> None:
+    global _UNITS
+    with _STATS_LOCK:
+        _UNITS += delta
+
+
+def _note_prefetch(hit: bool) -> None:
+    global _PREFETCH_HITS, _PREFETCH_MISSES
+    with _STATS_LOCK:
+        if hit:
+            _PREFETCH_HITS += 1
+        else:
+            _PREFETCH_MISSES += 1
+
+
+def _note_resident(delta_bytes: int) -> None:
+    global _RESIDENT, _RESIDENT_PEAK
+    with _STATS_LOCK:
+        _RESIDENT += int(delta_bytes)
+        if _RESIDENT > _RESIDENT_PEAK:
+            _RESIDENT_PEAK = _RESIDENT
+
+
+class FsdpUnit:
+    """One parameter unit: the shapes of its leaves, its FlatSharder
+    window anchor, and this rank's owned shard (fp32, mutable — the
+    update writes it in place)."""
+
+    __slots__ = ("index", "name", "shapes", "n", "sharder", "shard")
+
+    def __init__(self, index: int, name: str, shapes: List[tuple],
+                 n: int, sharder: FlatSharder, shard: np.ndarray):
+        self.index = index
+        self.name = name
+        self.shapes = shapes
+        self.n = n
+        self.sharder = sharder
+        self.shard = shard
+
+
+class FsdpPlane:
+    """Full parameter sharding over per-layer units.
+
+    ``unit_params`` is a sequence of units, each a list of numpy-like
+    arrays (one model layer's params, say).  Construction flattens each
+    unit to fp32, anchors a FlatSharder window, keeps ONLY the owned
+    shard, and drops the full arrays — after ``__init__`` the plane is
+    the single owner of the parameters.
+
+    >>> plane = FsdpPlane([layer0_params, layer1_params, ...])
+    >>> for i in range(plane.n_units):         # forward
+    ...     w = plane.gather(i)                # JIT AG + band-0 prefetch
+    ...     h = forward_layer(w, h)
+    ...     plane.free(i)                      # drop non-owned params
+    >>> for i in reversed(range(plane.n_units)):   # backward
+    ...     w = plane.gather(i, direction=-1)
+    ...     gs, h_grad = vjp_layer(w, ...)
+    ...     plane.reduce_grads(i, gs)          # async RS, fires NOW
+    ...     plane.free(i)
+    >>> for i in range(plane.n_units):         # optimizer
+    ...     g = plane.wait_grads(i)
+    ...     update_shard_inplace(plane.shard(i), g)
+    >>> plane.step()
+
+    Every rank must construct the plane with the same unit boundaries
+    (collective names follow program order, like the engine's
+    auto-naming).
+    """
+
+    #: Per-process construction counter — two planes in one process get
+    #: distinct collective names (same contract as FlatSharder).
+    _instances = 0
+
+    def __init__(self, unit_params: Sequence[Sequence], *,
+                 name: str = "fsdp", prefetch: Optional[int] = None,
+                 wire_dtype: Optional[str] = None,
+                 average: bool = True):
+        if not unit_params:
+            raise ValueError("FsdpPlane needs at least one unit")
+        self.name = name
+        self._wire_name = f"fsdp.{name}.{FsdpPlane._instances}"
+        FsdpPlane._instances += 1
+        self.prefetch = (prefetch_default() if prefetch is None
+                         else max(0, int(prefetch)))
+        self.wire_dtype = wire_dtype
+        self.average = average
+        self.units: List[FsdpUnit] = []
+        self._full: Dict[int, np.ndarray] = {}      # i -> full flat
+        self._ag_handles: Dict[int, int] = {}       # i -> engine handle
+        self._rs_handles: Dict[int, Tuple[int, dict]] = {}
+        self._steps = 0
+        total = 0
+        for i, arrays in enumerate(unit_params):
+            arrs = [np.asarray(a) for a in arrays]
+            shapes = [tuple(a.shape) for a in arrs]
+            flat = FlatSharder.flatten(arrs, np.float32)
+            n = int(flat.size)
+            if n == 0:
+                raise ValueError(f"FSDP unit {i} has no parameters")
+            sharder = FlatSharder(n, np.float32,
+                                  name=f"{self._wire_name}.u{i}")
+            shard = flat[sharder.offset:sharder.offset + sharder.count] \
+                .copy()
+            self.units.append(FsdpUnit(i, f"{name}.u{i}", shapes, n,
+                                       sharder, shard))
+            total += n * 4
+            _note_resident(shard.nbytes)
+        self.total_param_bytes = total
+        _note_units(len(self.units))
+
+    # -- geometry --
+
+    @property
+    def n_units(self) -> int:
+        return len(self.units)
+
+    @property
+    def shard_bytes(self) -> int:
+        return sum(u.shard.nbytes for u in self.units)
+
+    def shard(self, i: int) -> np.ndarray:
+        """This rank's owned fp32 window of unit ``i`` (mutable: the
+        optimizer updates it in place; the next gather serves the new
+        bytes)."""
+        return self.units[i].shard
+
+    def check_world(self) -> None:
+        """Raise :class:`ShardResizeError` when the committed world size
+        changed under the plane (elastic resize) — param shards live
+        only on their owner, so continuing would corrupt the model.
+        Re-anchors silently on a same-size epoch bump."""
+        for u in self.units:
+            u.sharder.check_world()
+
+    # -- forward: just-in-time allgather + band-0 prefetch --
+
+    def start_gather(self, i: int, *, priority: Optional[int] = 0) -> None:
+        """Enqueue unit ``i``'s parameter allgather (idempotent: a
+        pending handle or an already-gathered unit is left alone).
+        ``priority=0`` is the prefetch band — most urgent, so the banded
+        scheduler dispatches it ahead of same-cycle bulk traffic."""
+        if i < 0 or i >= len(self.units) or i in self._full \
+                or i in self._ag_handles:
+            return
+        u = self.units[i]
+        u.sharder.check_world()
+        eng = engine_or_none()
+        if eng is None:
+            return
+        self._ag_handles[i] = eng.enqueue_allgather(
+            u.shard, name=f"{self._wire_name}.u{i}.ag",
+            priority=priority)
+
+    def gather(self, i: int, *, direction: int = 1) -> List[np.ndarray]:
+        """Unit ``i``'s FULL params (list of arrays shaped like the
+        originals — views of one gathered flat buffer), allgathered
+        just-in-time, with the next ``prefetch`` units in traversal
+        ``direction`` enqueued at band 0.  Counts a prefetch hit when a
+        pending gather had already completed, a miss when it blocked
+        (or was never enqueued)."""
+        u = self.units[i]
+        if i not in self._full:
+            eng = engine_or_none()
+            handle = self._ag_handles.pop(i, None)
+            if eng is None:
+                flat = u.shard.copy() if u.sharder.size == 1 else None
+                if flat is None:
+                    raise RuntimeError(
+                        "FsdpPlane.gather without a running engine in a "
+                        "multi-process world")
+            else:
+                if handle is None:
+                    _note_prefetch(False)
+                    u.sharder.check_world()
+                    handle = eng.enqueue_allgather(
+                        u.shard, name=f"{self._wire_name}.u{i}.ag",
+                        priority=0)
+                else:
+                    _note_prefetch(eng.poll(handle))
+                flat = np.asarray(eng.synchronize(handle))
+            self._full[i] = flat
+            _note_resident(flat.nbytes)
+        for d in range(1, self.prefetch + 1):
+            self.start_gather(i + direction * d, priority=0)
+        return FlatSharder.unflatten(self._full[i], u.shapes)
+
+    def free(self, i: int) -> None:
+        """Drop unit ``i``'s gathered full params (the owned shard
+        stays — it IS the parameter storage)."""
+        flat = self._full.pop(i, None)
+        if flat is not None:
+            _note_resident(-flat.nbytes)
+
+    def free_all(self) -> None:
+        for i in list(self._full):
+            self.free(i)
+
+    # -- backward: async reduce-scatter the moment a unit's vjp lands --
+
+    def reduce_grads(self, i: int, grads: Sequence, *,
+                     priority: Optional[int] = None) -> None:
+        """Enqueue unit ``i``'s gradient reducescatter NOW (the backward
+        cascade: call as each unit's vjp completes, typically in reverse
+        unit order).  ``priority`` defaults to the unit index — earlier
+        units are needed first by the next forward, so they get the more
+        urgent band.  Results are claimed by :meth:`wait_grads`."""
+        if i in self._rs_handles:
+            raise RuntimeError(
+                f"unit {i} already has a gradient reduction in flight "
+                "(wait_grads it first)")
+        u = self.units[i]
+        u.sharder.check_world()
+        flat = FlatSharder.flatten([np.asarray(g) for g in grads],
+                                   np.float32)
+        if flat.size != u.n:
+            raise ValueError(
+                f"unit {i}: flat gradient length {flat.size} != {u.n}")
+        eng = engine_or_none()
+        if eng is None:
+            shard = flat[u.sharder.offset:
+                         u.sharder.offset + u.sharder.count].copy()
+            self._rs_handles[i] = (-1, {"local": shard})
+            return
+        info: dict = {}
+        handle = eng.enqueue_reducescatter(
+            flat, name=f"{self._wire_name}.u{i}.rs",
+            wire_dtype=self.wire_dtype,
+            priority=i if priority is None else priority)
+        self._rs_handles[i] = (handle, info)
+
+    def wait_grads(self, i: int) -> np.ndarray:
+        """Drain unit ``i``'s reducescatter: this rank's grad shard
+        (length = owned window), divisor-correct under backup-worker
+        partial commits.  A :class:`StepSkipped` partial commit that
+        left this rank out re-raises AFTER the handle is cleaned up —
+        nothing is stranded, and the prefetch pipeline keeps its state
+        (parameter allgathers are full-world collectives, never
+        partially committed)."""
+        entry = self._rs_handles.pop(i, None)
+        if entry is None:
+            raise RuntimeError(f"unit {i} has no gradient reduction in "
+                               "flight (reduce_grads it first)")
+        handle, info = entry
+        if handle == -1:  # world of one
+            return info["local"]
+        eng = engine_or_none()
+        out = eng.synchronize(handle, info)
+        if self.average:
+            out = eng._apply_average(out,
+                                     info.get("participants") or None)
+        return out
+
+    def pending_grads(self) -> List[int]:
+        """Unit indices with a gradient reduction still in flight."""
+        return sorted(self._rs_handles)
+
+    def drain(self) -> Dict[int, BaseException]:
+        """Drain EVERY in-flight handle (grad RS and prefetched AG),
+        never abandoning one (an abandoned handle leaks its kept-alive
+        buffer and leaves its name in flight — the engine drain-hygiene
+        contract).  Returns ``{unit: error}`` for reductions that
+        failed (e.g. StepSkipped); gathered params are cached as usual.
+        Call when abandoning a step (a skipped rank) so the next step
+        starts clean."""
+        errs: Dict[int, BaseException] = {}
+        eng = engine_or_none()
+        for i in sorted(self._rs_handles):
+            handle, info = self._rs_handles.pop(i)
+            if handle == -1:
+                continue
+            try:
+                eng.synchronize(handle, info)
+            except BaseException as e:  # noqa: BLE001 — reported per unit
+                errs[i] = e
+        for i in sorted(self._ag_handles):
+            handle = self._ag_handles.pop(i)
+            try:
+                flat = np.asarray(eng.synchronize(handle))
+            except BaseException as e:  # noqa: BLE001 — reported per unit
+                errs[i] = e
+            else:
+                self._full[i] = flat
+                _note_resident(flat.nbytes)
+        return errs
+
+    def step(self) -> None:
+        """Mark a completed FSDP step (the shared ``sharded_steps``
+        counter) and verify nothing was left in flight."""
+        if self._rs_handles:
+            raise RuntimeError(
+                f"FSDP step completed with gradient reductions still in "
+                f"flight for units {sorted(self._rs_handles)}")
+        self._steps += 1
+        note_sharded_step()
+
+    # -- checkpoint integration (writer speaks flat windows natively) --
+
+    def sharded_state(self) -> Dict[str, Tuple[np.ndarray, int]]:
+        """``{name: (owned_shard, n)}`` for ``CheckpointWriter.save(...,
+        sharded=...)`` — each rank writes its owned windows directly, no
+        gather-to-full; the manifest's per-leaf flat-offset windows
+        express the layout, so a restore at ANY world size reassembles
+        exactly (loader.my_flat_shard)."""
+        return {f"fsdp.{self.name}.u{u.index}": (u.shard, u.n)
+                for u in self.units}
+
+    def restore(self, loader) -> None:
+        """Load every unit's owned window from a checkpoint written at
+        ANY world size (the loader's flat-offset resharding core)."""
+        for u in self.units:
+            got = loader.my_flat_shard(f"fsdp.{self.name}.u{u.index}",
+                                       u.sharder.rank, u.sharder.size)
+            if got.size != u.shard.size:
+                raise ShardResizeError(
+                    f"restored window for unit {u.index} has "
+                    f"{got.size} elements, expected {u.shard.size}")
+            u.shard[:] = np.asarray(got, dtype=np.float32)
+        self.free_all()

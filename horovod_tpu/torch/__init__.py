@@ -647,12 +647,246 @@ class _ShardedOptimizer:
                 g["master"].copy_(gsd["master"].to(torch.float32))
 
 
+class _FsdpOptimizer:
+    """ZeRO-3/FSDP optimizer (``DistributedOptimizer(fsdp=True)``).
+
+    One step up the ladder from :class:`_ShardedOptimizer`: each param
+    group is an FSDP **unit** on a :class:`~horovod_tpu.runtime.fsdp.
+    FsdpPlane` window, and the backward pass drives the wire.  Grad
+    hooks count a unit's outstanding leaves; the moment the LAST leaf
+    of a unit lands, the unit's fp32 flat gradient reducescatters
+    IMMEDIATELY (priority band = group index — front groups win the
+    wire because the next forward needs them first) and the unit's
+    ``.grad`` tensors are freed on the spot, so full-model gradient
+    memory never materializes.  ``step()`` drains the reductions onto
+    fp32 master shards (the masters ARE the plane's shards —
+    ``torch.from_numpy`` write-through, so checkpoint capture sees live
+    bytes), runs ONE inner step of the user's optimizer class across
+    all master shards, then ships every group's updated master back
+    through the plane's band-0 allgather pipeline (counted in
+    ``fsdp_ag_prefetch_hits/misses``) and casts into the model params.
+
+    Mixed precision like ZeRO-1: model params may be fp16/bf16; grads
+    cast up for the reduction, the update runs on the fp32 master
+    shard, and the gathered master casts back.  fp32 models with an
+    elementwise inner optimizer step bit-identically to the unsharded
+    anchor (asserted in tests/fsdp_worker.py).  For LR schedulers use
+    :attr:`shard_optimizer`, as with the sharded optimizer.
+    """
+
+    def __init__(self, optimizer, compression=Compression.none,
+                 prefetch=None):
+        import numpy as np
+
+        from horovod_tpu.runtime.fsdp import FsdpPlane
+        from horovod_tpu.torch.compression import TopKCompressor
+
+        if isinstance(compression, TopKCompressor):
+            raise ValueError(
+                "fsdp=True reduces gradients with reducescatter; the "
+                "top-k sparse path has no scatter half — use a wire "
+                "compressor (Compression.wire_bf16 etc.) instead")
+        wire = getattr(compression, "engine_wire_dtype", None)
+        wire = wire if wire in ("fp16", "bf16", "int8", "fp8") else None
+        self._groups = []
+        unit_params = []
+        for group in optimizer.param_groups:
+            params = list(group["params"])
+            if not params:
+                raise ValueError(
+                    "fsdp=True: every param group must be non-empty "
+                    "(each group is one FSDP unit)")
+            self._groups.append({
+                "params": params,
+                "shapes": [tuple(p.shape) for p in params],
+                "numels": [p.numel() for p in params],
+                "defaults": {k: v for k, v in group.items()
+                             if k != "params"},
+            })
+            unit_params.append([
+                np.ascontiguousarray(
+                    p.detach().to(torch.float32).reshape(-1).numpy())
+                for p in params
+            ])
+        #: The parameter plane: unit = param group, shards fp32.
+        self.plane = FsdpPlane(unit_params, name="torch",
+                               prefetch=prefetch, wire_dtype=wire,
+                               average=True)
+        shard_groups = []
+        for gi, g in enumerate(self._groups):
+            # Write-through master: the torch tensor SHARES the plane
+            # shard's buffer, so the inner optimizer's in-place update
+            # IS the plane update (gathers and checkpoints see it).
+            g["master"] = torch.from_numpy(self.plane.shard(gi))
+            shard_groups.append({**g["defaults"],
+                                 "params": [g["master"]]})
+        self._shard_opt = type(optimizer)(shard_groups)
+        self.param_groups = self._shard_opt.param_groups
+        # Hook pipeline: fire a unit's RS the moment its last grad
+        # lands (the backward cascade — no wait-for-full-model).
+        self._pending = [0] * len(self._groups)
+        self._enqueued = [False] * len(self._groups)
+        self._grad_accs = []
+        for gi, g in enumerate(self._groups):
+            for p in g["params"]:
+                if p.requires_grad:
+                    self._pending[gi] += 1
+                    self._grad_accs.append(
+                        p.register_post_accumulate_grad_hook(
+                            self._make_hook(gi)))
+        self._hook_total = list(self._pending)
+
+    def _make_hook(self, gi):
+        def hook(p):
+            self._pending[gi] -= 1
+            if self._pending[gi] == 0:
+                self._reduce_unit(gi)
+        return hook
+
+    def _reduce_unit(self, gi):
+        import numpy as np
+
+        g = self._groups[gi]
+        flats = []
+        for p, numel in zip(g["params"], g["numels"]):
+            if p.grad is None:
+                flats.append(np.zeros(numel, dtype=np.float32))
+                continue
+            gr = p.grad
+            if gr.is_sparse:
+                gr = gr.to_dense()  # flat RS has no sparse path
+            flats.append(np.ascontiguousarray(
+                gr.detach().to(torch.float32).reshape(-1).numpy()))
+            # ZeRO-3 gradient hygiene: the full-precision grad is on
+            # the wire now — drop the tensor before the NEXT unit's
+            # backward allocates, so grad memory stays one-unit-deep.
+            p.grad = None
+        self.plane.reduce_grads(gi, flats)
+        self._enqueued[gi] = True
+
+    @property
+    def shard_optimizer(self):
+        """The inner ``torch.optim.Optimizer`` over the fp32 master
+        shards — the handle to give LR schedulers."""
+        return self._shard_opt
+
+    @property
+    def sharders(self):
+        return [u.sharder for u in self.plane.units]
+
+    def state_bytes(self) -> int:
+        """Per-rank master-weight + optimizer-state bytes (the ~1/N
+        memory claim)."""
+        total = self.plane.shard_bytes
+        for st in self._shard_opt.state.values():
+            for v in st.values():
+                if torch.is_tensor(v):
+                    total += v.numel() * v.element_size()
+        return total
+
+    def zero_grad(self, set_to_none: bool = True):
+        for g in self._groups:
+            for p in g["params"]:
+                if set_to_none:
+                    p.grad = None
+                elif p.grad is not None:
+                    p.grad.detach_()
+                    p.grad.zero_()
+
+    def step(self, closure=None):
+        import numpy as np
+
+        loss = closure() if closure is not None else None
+        # Units whose hooks never all fired this step (partial backward,
+        # or grad-accumulation edge): reduce them NOW with zeros for the
+        # missing leaves — the collective schedule must be identical on
+        # every rank.
+        for gi in range(len(self._groups)):
+            if not self._enqueued[gi]:
+                self._reduce_unit(gi)
+        try:
+            for gi, g in enumerate(self._groups):
+                shard_g = self.plane.wait_grads(gi)
+                g["master"].grad = torch.from_numpy(
+                    np.ascontiguousarray(shard_g))
+        except BaseException:
+            self.plane.drain()  # never strand a later unit's handle
+            self._reset_step()
+            raise
+        self._shard_opt.step()
+        for g in self._groups:
+            g["master"].grad = None
+        # Ship every group's updated master through the plane's band-0
+        # gather pipeline; copy back as each unit lands, free at once.
+        for gi in range(len(self._groups)):
+            self.plane.start_gather(gi, priority=0)
+        for gi, g in enumerate(self._groups):
+            fulls = self.plane.gather(gi)
+            with torch.no_grad():
+                for p, full, shape in zip(g["params"], fulls,
+                                          g["shapes"]):
+                    chunk = torch.from_numpy(np.ascontiguousarray(full))
+                    p.data.copy_(chunk.reshape(shape).to(p.dtype))
+            self.plane.free(gi)
+        self._reset_step()
+        self.plane.step()
+        return loss
+
+    def _reset_step(self):
+        self._pending = list(self._hook_total)
+        self._enqueued = [False] * len(self._groups)
+
+    def state_dict(self):
+        """Shard-LOCAL state (same envelope as the ZeRO-1 sharded
+        optimizer: each rank saves its own windows)."""
+        return {
+            "shard_opt": self._shard_opt.state_dict(),
+            "groups": [
+                {
+                    "master": g["master"].detach().clone().cpu(),
+                    "shard": {"offset": u.sharder.offset,
+                              "count": u.sharder.count,
+                              "n": u.sharder.n,
+                              "size": u.sharder.size},
+                }
+                for g, u in zip(self._groups, self.plane.units)
+            ],
+        }
+
+    def load_state_dict(self, sd):
+        from horovod_tpu.runtime.sharded import ShardResizeError
+
+        groups_sd = sd.get("groups")
+        if groups_sd is None or len(groups_sd) != len(self._groups):
+            raise ShardResizeError(
+                "fsdp checkpoint holds "
+                f"{0 if groups_sd is None else len(groups_sd)} "
+                f"unit(s) but this optimizer has {len(self._groups)}")
+        for gi, (u, gsd) in enumerate(zip(self.plane.units, groups_sd)):
+            meta = gsd.get("shard", {})
+            sh = u.sharder
+            if (meta.get("n") != sh.n or meta.get("size") != sh.size or
+                    meta.get("offset") != sh.offset):
+                raise ShardResizeError(
+                    f"fsdp checkpoint unit {gi} was written for shard "
+                    f"{meta.get('offset')}+{meta.get('count')} of "
+                    f"{meta.get('n')} at world size {meta.get('size')}, "
+                    f"but this optimizer owns {sh.offset}+{sh.count} of "
+                    f"{sh.n} at size {sh.size}; restore through the "
+                    "CheckpointLoader's resharding reader instead "
+                    "(docs/zero.md)")
+        self._shard_opt.load_state_dict(sd["shard_opt"])
+        with torch.no_grad():
+            for g, gsd in zip(self._groups, groups_sd):
+                g["master"].copy_(gsd["master"].to(torch.float32))
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1,
                          sparse_as_dense=False,
                          local_sgd_steps=None,
-                         sharded=None):
+                         sharded=None, fsdp=None, fsdp_prefetch=None):
     """Wrap a torch optimizer so gradients are averaged across ranks during
     ``backward()`` (reference factory, torch/__init__.py:115-150).
 
@@ -677,14 +911,31 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     :class:`_ShardedOptimizer` instead of the hook mixin: fp32 master
     weights and optimizer state live only on each shard's owner (~1/N
     memory), gradients reduce by ``reducescatter`` and params return by
-    ``allgather`` — see docs/zero.md."""
+    ``allgather`` — see docs/zero.md.
+
+    ``fsdp=True`` (default ``HOROVOD_FSDP``) returns the ZeRO-3
+    :class:`_FsdpOptimizer`: each param group is a parameter-plane unit
+    whose gradient reducescatter fires FROM THE GRAD HOOK the moment
+    the unit's last leaf lands (grads freed immediately — one-unit-deep
+    gradient memory), and updated master shards return through band-0
+    allgathers (``fsdp_prefetch``, default ``HOROVOD_FSDP_PREFETCH``)
+    — see docs/zero.md's sharding ladder."""
+    from horovod_tpu.runtime.fsdp import fsdp_default
     from horovod_tpu.runtime.sharded import sharded_default
 
     if sharded is None:
         sharded = sharded_default()
-    if sharded:
+    if fsdp is None:
+        fsdp = fsdp_default()
+    if fsdp and sharded:
+        raise ValueError(
+            "fsdp=True and sharded=True are mutually exclusive: FSDP "
+            "subsumes the ZeRO-1 step (pick one rung of the ladder; "
+            "see docs/zero.md)")
+    if sharded or fsdp:
         from horovod_tpu.elastic.state import default_local_sgd_steps
 
+        which = "fsdp=True" if fsdp else "sharded=True"
         # Resolve the env default too (HOROVOD_LOCAL_SGD_STEPS) so the
         # exclusivity contract matches the jax frontend's: a requested
         # local-SGD cadence must never be silently dropped.
@@ -692,19 +943,22 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                       else max(1, int(local_sgd_steps)))
         if resolved_h > 1:
             raise ValueError(
-                "sharded=True and local_sgd_steps>1 are mutually "
+                f"{which} and local_sgd_steps>1 are mutually "
                 "exclusive: local SGD skips the per-step reduction the "
                 "sharded step is built around")
         if int(backward_passes_per_step) != 1:
             # Never silently change gradient-accumulation semantics: the
             # sharded step reduces+applies on EVERY step().
             raise ValueError(
-                "sharded=True does not support backward_passes_per_step"
+                f"{which} does not support backward_passes_per_step"
                 f"={backward_passes_per_step}: the flat reduce-scatter "
                 "fires on every step(). Accumulate gradients in the "
                 "training loop (call step() every Nth backward) instead")
         # named_parameters is accepted and unused (the flat RS needs no
         # per-tensor names); sparse grads are densified in step().
+        if fsdp:
+            return _FsdpOptimizer(optimizer, compression=compression,
+                                  prefetch=fsdp_prefetch)
         return _ShardedOptimizer(optimizer, compression=compression)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))

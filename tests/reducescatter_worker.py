@@ -185,23 +185,39 @@ def scenario_backup_auto(rank, size, eng):
 
 
 def scenario_backup_auto_arms(rank, size, eng):
-    # Deterministic straggler: rank (size-1) stalls 120 ms before every
-    # 12th enqueue, the rest are ~cycle-time fast — so the coordinator's
-    # window shows p99 >> 3 * p50 once >= 64 samples land, the auto rule
-    # arms k=1, and the straggler starts getting skipped (partial
-    # commits) while the fast ranks keep stepping.
+    # PERSISTENT straggler: rank (size-1) stalls 80 ms before EVERY
+    # enqueue after a short warmup — the quorum rule's design point
+    # (the default backup=auto instrument arms when quorum-lag p50
+    # exceeds the HOROVOD_BACKUP_GRACE_MS window over >= 64 samples; a
+    # persistent straggler makes lag p50 ~= p99, which the legacy
+    # steptime ratio rule would NEVER fire on, and an intermittent
+    # 1-in-K stall keeps lag p50 near zero, which the quorum rule never
+    # fires on — the pre-fix flake).  Every post-warmup step feeds the
+    # window a sample above grace, so arming lands deterministically at
+    # the 64-sample floor, and NoteSkippedQuorumLag keeps the window
+    # saturated once partial commits start skipping the straggler
+    # (committed-without-the-straggler entries would otherwise starve
+    # the window and let armed decay mid-schedule).
     import time
 
     from horovod_tpu.runtime.engine import StepSkipped
 
+    warmup = 8
     skips = 0
     for i in range(140):
-        if rank == size - 1 and i % 12 == 11 and i > 70:
-            time.sleep(0.12)
+        # Stop stalling once arming is PROVEN (5 skips): the point is
+        # made, and a straggler that never recovers would let the fast
+        # ranks finish and tear the world down underneath it.
+        if rank == size - 1 and i >= warmup and skips < 5:
+            time.sleep(0.08)
         try:
             eng.allreduce(np.full(64, 1.0, np.float32), name=f"baa.{i}")
         except StepSkipped:
             skips += 1
+    # Full-world rendezvous before anyone shuts down: MAX allreduces are
+    # never partially committed, so this waits for the recovered
+    # straggler (same epilogue discipline as scenario_backup_rs).
+    eng.allreduce(np.zeros(1, np.float32), red_op="max", name="baa.done")
     st = eng.stats()
     if rank == 0:
         # The coordinator evaluated the rule and armed at least once by
@@ -211,7 +227,7 @@ def scenario_backup_auto_arms(rank, size, eng):
     if rank == size - 1:
         assert skips > 0 or st["backup_skips"] > 0, (
             "auto mode never armed: the stalled rank was never skipped",
-            st["step_time_ns_p50"], st["step_time_ns_p99"])
+            st["quorum_lag_ns_p50"], st["quorum_lag_ns_p99"])
     print(f"BACKUP_AUTO_ARMS_OK rank={rank} skips={skips}", flush=True)
 
 

@@ -207,6 +207,7 @@ def test_stale_control_frames_dropped_while_wire_tuning():
 
 
 @pytest.mark.fault
+@pytest.mark.slow
 def test_hang_mid_trial_discards_trial_no_wedge():
     """A rank wedges mid-trial: the failure detector aborts the world
     within HOROVOD_FAULT_TIMEOUT_SEC, the surviving rank's tuner thread

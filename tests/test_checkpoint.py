@@ -375,6 +375,7 @@ def test_jax_sharded_resharding_restore_bitexact(tmp_path):
 
 
 @pytest.mark.ckpt
+@pytest.mark.slow
 def test_torch_sharded_resharding_restore_bitexact(tmp_path):
     """The torch ZeRO wrapper: fp32 masters + momentum shards written at
     world 4 reassemble at world 2 with the params re-derived from the

@@ -1,0 +1,299 @@
+"""ZeRO-3/FSDP parameter sharding (``runtime/fsdp.py`` + the
+``fsdp=True`` frontends).
+
+Judged like ZeRO-1 (tests/sharded_worker.py discipline), one rung up:
+
+* bitwise step parity vs the unsharded anchor after EVERY step, per
+  frontend, at 2 AND 4 ranks;
+* deterministic memory counters (``fsdp_param_bytes_resident_peak``)
+  in place of wall-clock claims — the ci fsdp gate turns them into a
+  hard 1/N ratio;
+* fsdp x backup-workers: StepSkipped strands nothing, the prefetch
+  pipeline stays aligned;
+* fsdp x wire int8: compressed gradient RS under a lossless fp32
+  param allgather, bounded quantization drift;
+* sharded checkpoints: each rank writes OWNED windows, restore
+  reshards world-4 → world-2/3 bit-exactly;
+* elastic shrink 4 → 3 mid-run: clean ShardResizeError + loader-based
+  reshard restore, bit-exact from the last commit.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tests.test_native_engine import run_workers
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FSDP_WORKER = os.path.join(REPO, "tests", "fsdp_worker.py")
+
+#: Bands on: the plane stamps band-0 prefetch priorities, and the
+#: inversion counter must stay at zero by construction.
+_BANDS = {"HOROVOD_PRIORITY_BANDS": "1"}
+
+
+# ---------------------------------------------------------------------------
+# Multi-process parity + counters
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_fsdp_plane_bitwise_parity_and_counters(n):
+    """The plane itself: per-step bitwise parity vs the unsharded flat
+    anchor, RS wire ~0.5x allreduce, resident-peak ~1/N + O(units),
+    zero priority inversions with bands on."""
+    run_workers(n, "numpy", timeout=240, worker=FSDP_WORKER,
+                extra_env=_BANDS)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_fsdp_jax_bitwise_parity(n):
+    """DistributedOptimizer(optax.adam, fsdp=True): unit boundaries
+    from the param tree, per-unit shard-sized inner state, bitwise
+    parity vs per-unit unsharded adam after every step."""
+    run_workers(n, "jax", timeout=240, worker=FSDP_WORKER,
+                extra_env={"JAX_PLATFORMS": "cpu", **_BANDS})
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_fsdp_torch_bitwise_parity(n):
+    """torch _FsdpOptimizer: hook-driven unit reductions on a real
+    backward, bitwise parity vs the flat reference, measured ~1/N
+    state bytes."""
+    run_workers(n, "torch", timeout=240, worker=FSDP_WORKER,
+                extra_env=_BANDS)
+
+
+@pytest.mark.straggler
+def test_fsdp_backup_stepskipped_strands_nothing():
+    """fsdp x backup workers (k=1): the straggler's per-unit
+    StepSkipped leaves no handle in flight, fast ranks see the
+    participants-correct shard, and after recovery every rank's
+    gathered params are bitwise identical (the AG is full-world)."""
+    run_workers(4, "backup", timeout=240, worker=FSDP_WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1", **_BANDS})
+
+
+def test_fsdp_wire_int8_grads_bounded():
+    """fsdp x wire int8: compressed RS payload (<0.45x fp32 bytes),
+    per-step and cumulative quantization drift inside the linear
+    bound, allgathered params bitwise identical across ranks."""
+    run_workers(2, "wire", timeout=240, worker=FSDP_WORKER)
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints: owned-window writes + resharding restore
+# ---------------------------------------------------------------------------
+
+def _run_ckpt(np_, mode, ckpt_dir):
+    outs = run_workers(np_, "ckpt", timeout=240, worker=FSDP_WORKER,
+                       extra_env={"CKPT_MODE": mode,
+                                  "HOROVOD_CHECKPOINT_DIR": ckpt_dir})
+    digests = set()
+    for out, _err in outs:
+        m = re.search(r"FSDP_CKPT rank=\d+ size=\d+ mode=\w+ "
+                      r"digest=([0-9a-f]+)", out.decode())
+        assert m, out.decode()
+        digests.add(m.group(1))
+    assert len(digests) == 1, digests  # AG-identical on every rank
+    return digests.pop()
+
+
+@pytest.mark.ckpt
+@pytest.mark.parametrize("m", [2, 3])
+def test_fsdp_sharded_checkpoint_reshards_world4(m, tmp_path):
+    """World-4 save (each rank writes ONLY its owned windows — no
+    gather-to-full) restores at world 2 and 3 with the identical
+    full-model digest: the loader's flat-window resharding reader."""
+    ckpt = str(tmp_path / "fsdp_ck")
+    d4 = _run_ckpt(4, "train", ckpt)
+    dm = _run_ckpt(m, "resume", ckpt)
+    assert dm == d4, (m, dm, d4)
+
+
+# ---------------------------------------------------------------------------
+# Elastic: shrink mid-run, reshard-restore from the last commit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_fsdp_elastic_shrink_resumes_bit_exact(tmp_path):
+    """Rank 3 dies mid-run and is never replaced: survivors re-form at
+    size 3, the stale plane raises a CLEAN ShardResizeError, and the
+    rebuilt plane restores its new windows from the last committed
+    checkpoint — bit-exact (the worker asserts the digest against the
+    one recorded at commit time) — then training completes."""
+    ckpt = str(tmp_path / "fsdp_el")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update({
+        "HOROVOD_CYCLE_TIME": "2",
+        "HOROVOD_FAULT_TIMEOUT_SEC": "5",
+        "HOROVOD_ELASTIC_BACKOFF_SEC": "0.5",
+        "HOROVOD_ELASTIC_MAX_RETRIES": "4",
+        "HOROVOD_ELASTIC_GROW_TIMEOUT_SEC": "2",
+        "HOROVOD_ELASTIC_MIN_SIZE": "2",
+        "HOROVOD_CHECKPOINT_DIR": ckpt,
+        "HOROVOD_FAULT_INJECT": "3:30:exit",
+        "HOROVOD_TEST_TOTAL_STEPS": "12",
+    })
+    p = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+         "--elastic", "--", sys.executable, FSDP_WORKER, "elastic"],
+        cwd=REPO, env=env, capture_output=True, timeout=300)
+    out = p.stdout.decode() + p.stderr.decode()
+    assert p.returncode == 0, out
+    oks = re.findall(
+        r"FSDP_ELASTIC_OK rank=(\d+) size=(\d+) epoch=(\d+) "
+        r"restored=(\d+) resize_errors=(\d+) digest=([0-9a-f]+)",
+        p.stdout.decode())
+    assert len(oks) == 3, out                      # survivors finished
+    assert {ok[1] for ok in oks} == {"3"}, oks     # at world size 3
+    assert all(int(ok[2]) >= 2 for ok in oks), oks  # epoch advanced
+    assert all(int(ok[3]) >= 1 for ok in oks), oks  # reshard-restored
+    assert all(int(ok[4]) >= 1 for ok in oks), oks  # clean resize error
+    assert len({ok[5] for ok in oks}) == 1, oks    # identical params
+    # The reshard-restore really went through the loader at the NEW
+    # world size (the worker prints the marker with its digest check).
+    assert "FSDP_RESHARD" in p.stdout.decode(), out
+
+
+# ---------------------------------------------------------------------------
+# Single-process semantics (tier-1, no subprocesses)
+# ---------------------------------------------------------------------------
+
+def test_fsdp_plane_resize_raises_clean_error():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    from horovod_tpu.runtime.fsdp import FsdpPlane, ShardResizeError
+
+    plane = FsdpPlane([[np.ones(10, np.float32)]], name="rz")
+    plane.units[0].sharder.size += 1  # committed world changed under us
+    with pytest.raises(ShardResizeError):
+        plane.check_world()
+
+
+def test_fsdp_plane_world_of_one_roundtrip():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    from horovod_tpu.runtime.fsdp import FsdpPlane
+
+    arrs = [np.arange(6, dtype=np.float32).reshape(2, 3),
+            np.ones(5, np.float32)]
+    plane = FsdpPlane([arrs], name="one")
+    got = plane.gather(0)
+    assert [a.shape for a in got] == [(2, 3), (5,)]
+    assert np.array_equal(got[0], arrs[0])
+    plane.reduce_grads(0, [np.ones((2, 3), np.float32),
+                           np.full(5, 2.0, np.float32)])
+    g = plane.wait_grads(0)
+    assert g.shape == (11,)
+    assert np.array_equal(g, np.concatenate([np.ones(6),
+                                             np.full(5, 2.0)]))
+    plane.free(0)
+    plane.step()
+    # Checkpoint envelope: owned windows keyed per unit.
+    st = plane.sharded_state()
+    assert set(st) == {"fsdp.one.u0"}
+    shard, n = st["fsdp.one.u0"]
+    assert n == 11 and shard.size == 11
+
+
+def test_fsdp_stats_merged_into_engine_stats():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    from horovod_tpu.runtime.engine import get_engine
+
+    st = get_engine().stats()
+    for key in ("fsdp_units", "fsdp_ag_prefetch_hits",
+                "fsdp_ag_prefetch_misses", "fsdp_param_bytes_resident",
+                "fsdp_param_bytes_resident_peak"):
+        assert key in st, key
+
+
+def test_fsdp_jax_mutual_exclusions():
+    import optax
+
+    import horovod_tpu.jax as hvd
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), fsdp=True, sharded=True)
+    with pytest.raises(ValueError, match="reduce_gradients"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), fsdp=True,
+                                 reduce_gradients=False)
+    with pytest.raises(ValueError, match="local"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), fsdp=True,
+                                 local_sgd_steps=4)
+    import jax.numpy as jnp
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), fsdp=True)
+    with pytest.raises(TypeError, match="float32"):
+        opt.init({"w": jnp.zeros(4, dtype=jnp.bfloat16)})
+    from horovod_tpu.ops.compression import Compression
+
+    opt2 = hvd.DistributedOptimizer(
+        optax.sgd(0.1), fsdp=True, compression=Compression.topk(0.1))
+    with pytest.raises(ValueError, match="top-k"):
+        opt2.init({"w": jnp.zeros(4, dtype=jnp.float32)})
+
+
+def test_fsdp_jax_unit_grouping_override():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import horovod_tpu as hvd0
+    import horovod_tpu.jax as hvd
+
+    hvd0.init()
+    params = {"a": jnp.zeros(4), "b": jnp.zeros(3), "c": jnp.zeros(5)}
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1), fsdp=True,
+                                   fsdp_units=[["a", "c"], ["b"]])
+    opt.init(jax.tree.map(lambda x: x.astype(jnp.float32), params))
+    assert opt._fsdp_plane.n_units == 2
+    assert opt._fsdp_plane.units[0].n == 9   # a (4) + c (5)
+    assert opt._fsdp_plane.units[1].n == 3
+    with pytest.raises(ValueError, match="unknown top-level key"):
+        hvd.DistributedOptimizer(
+            optax.sgd(0.1), fsdp=True, fsdp_units=[["a", "zzz"]],
+        ).init({"a": jnp.zeros(4, jnp.float32)})
+    with pytest.raises(ValueError, match="missing"):
+        hvd.DistributedOptimizer(
+            optax.sgd(0.1), fsdp=True, fsdp_units=[["a"]],
+        ).init({"a": jnp.zeros(4, jnp.float32),
+                "b": jnp.zeros(3, jnp.float32)})
+
+
+def test_fsdp_torch_mutual_exclusions():
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    base = torch.optim.SGD([torch.nn.Parameter(torch.zeros(4))], lr=0.1)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        hvd.DistributedOptimizer(base, fsdp=True, sharded=True)
+    with pytest.raises(ValueError, match="local"):
+        hvd.DistributedOptimizer(base, fsdp=True, local_sgd_steps=4)
+    with pytest.raises(ValueError, match="backward_passes_per_step"):
+        hvd.DistributedOptimizer(base, fsdp=True,
+                                 backward_passes_per_step=2)
+
+
+def test_fsdp_prefetch_env_default(monkeypatch):
+    from horovod_tpu.runtime.fsdp import fsdp_default, prefetch_default
+
+    monkeypatch.delenv("HOROVOD_FSDP_PREFETCH", raising=False)
+    assert prefetch_default() == 1
+    monkeypatch.setenv("HOROVOD_FSDP_PREFETCH", "3")
+    assert prefetch_default() == 3
+    monkeypatch.setenv("HOROVOD_FSDP_PREFETCH", "junk")
+    assert prefetch_default() == 1
+    monkeypatch.delenv("HOROVOD_FSDP", raising=False)
+    assert fsdp_default() is False
+    monkeypatch.setenv("HOROVOD_FSDP", "1")
+    assert fsdp_default() is True

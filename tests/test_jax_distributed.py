@@ -7,6 +7,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from tests.test_native_engine import _free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -87,6 +89,7 @@ def test_jax_distributed_bootstrap_two_processes():
     _run_jaxdist("bootstrap")
 
 
+@pytest.mark.slow
 def test_gspmd_train_step_two_processes_matches_single():
     """make_parallel_train_step across 2 processes x 2 devices (4-device
     data x fsdp mesh via jax.distributed): both ranks observe identical

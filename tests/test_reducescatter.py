@@ -147,9 +147,14 @@ def test_backup_auto_reported_and_unarmed_when_healthy():
 @pytest.mark.straggler
 @pytest.mark.slow
 def test_backup_auto_arms_under_straggler():
-    """A rank stalling 120 ms on every 12th step inflates p99 >> 3*p50;
-    the coordinator must arm k=1 and the straggler must start seeing
-    clean StepSkipped outcomes (runs in the ci straggler gate)."""
+    """A rank stalling 80 ms before EVERY post-warmup enqueue pushes
+    quorum-lag p50 over the 50 ms grace window once the 64-sample floor
+    lands; the coordinator must arm k=1 and the straggler must start
+    seeing clean StepSkipped outcomes (runs in the ci straggler gate).
+    Deterministic by construction: every post-warmup step feeds the
+    arming window a sample above grace, and partial commits stamp
+    synthetic quorum-lag samples so armed stays latched while skips
+    occur."""
     run_workers(4, "backup_auto_arms", timeout=300, worker=RS_WORKER,
                 extra_env={"HOROVOD_BACKUP_WORKERS": "auto"})
 

@@ -209,6 +209,7 @@ def test_two_replica_fleet_serves_and_balances(offline):
 
 
 @pytest.mark.fault
+@pytest.mark.slow
 def test_wedged_replica_probed_killed_and_requeued(offline):
     """Replica 1's SCHEDULER THREAD wedges at decode step 4 (injected
     ``hang``) while its asyncio front-end stays up — death detection
@@ -255,6 +256,7 @@ def test_wedged_replica_probed_killed_and_requeued(offline):
 
 
 @pytest.mark.fault
+@pytest.mark.slow
 def test_transient_link_reset_heals_without_requeue(offline):
     """Replica 1's control socket is RESET once at decode step 4
     (injected ``conn-reset``) while the process keeps serving.  The
@@ -303,6 +305,7 @@ def test_transient_link_reset_heals_without_requeue(offline):
 
 
 @pytest.mark.fault
+@pytest.mark.slow
 def test_replica_death_requeues_all_requests(offline):
     """Kill replica 1 after 4 decode steps (HOROVOD_FAULT_INJECT
     schedule): its in-flight requests are re-queued onto replica 0 and
