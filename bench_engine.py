@@ -238,6 +238,32 @@ def _rs_sweep_worker() -> None:
     basics.shutdown()
 
 
+def _alltoall_sweep_worker() -> None:
+    """Alltoall bus bandwidth ((N-1)/N · bytes / wall — each rank keeps
+    its own block, so that's the fraction crossing the wire) from the
+    engine's deterministic alltoall counters.  Equal splits: the sweep
+    measures the transport, not the split negotiation (the variable-
+    split cases are correctness-gated in the moe marker)."""
+    import numpy as np
+
+    basics, eng = _engine_setup()
+    nbytes = int(os.environ["BENCH_SWEEP_BYTES"])
+    size = basics.size()
+    n = max(size, nbytes // 4 // size * size)  # divisible by the world
+    iters = max(2, min(30, (32 << 20) // max(nbytes, 1)))
+    x = np.ones(n, dtype=np.float32)
+    eng.alltoall(x, name="a2a.sweep.warm")
+    before = eng.stats()
+    for _ in range(iters):
+        eng.synchronize(eng.enqueue_alltoall(x, name="a2a.sweep.t"))
+    d = eng.stats_delta(before)
+    if basics.rank() == 0:
+        print(f"A2A_SWEEP_BUS_MB_S "
+              f"{d['alltoall_bus_bw_bytes_per_sec'] / 1e6:.1f}",
+              flush=True)
+    basics.shutdown()
+
+
 def _sharded_bytes_worker() -> None:
     """Per-step wire accounting of the ZeRO sharded step vs the
     unsharded allreduce, on the deterministic byte counters: the
@@ -779,6 +805,27 @@ def main() -> None:
             if m:
                 per_size[label] = float(m.group(1))
     result["reducescatter_bus_bw_mb_s"] = rs_sweep
+
+    # Alltoall size sweep (the MoE dispatch/combine transport) on the
+    # default plane and the single-channel TCP baseline: alltoall busbw
+    # = (N-1)/N · bytes / wall, comparable to the RS busbw above.
+    a2a_sweep: dict = {}
+    a2a_sweep_1ch: dict = {}
+    for n in (2, 4):
+        for dest, env in ((a2a_sweep, {}),
+                          (a2a_sweep_1ch, {"HOROVOD_NUM_CHANNELS": "1",
+                                           "HOROVOD_SHM_DISABLE": "1"})):
+            per_size = dest.setdefault(str(n), {})
+            for label, nbytes in sizes:
+                out = _run_ranks(n, [sys.executable, os.path.abspath(__file__),
+                                     "--alltoall-sweep-worker"],
+                                 extra_env={**env,
+                                            "BENCH_SWEEP_BYTES": str(nbytes)})
+                m = re.search(r"A2A_SWEEP_BUS_MB_S ([\d.]+)", out)
+                if m:
+                    per_size[label] = float(m.group(1))
+    result["alltoall_bus_bw_mb_s"] = a2a_sweep
+    result["alltoall_bus_bw_mb_s_1ch"] = a2a_sweep_1ch
 
     # ZeRO step wire accounting at 4 ranks, 4 MB flat model, on the
     # deterministic byte counters: grads_rs ~0.5 (the gated half),
@@ -1475,6 +1522,8 @@ if __name__ == "__main__":
         _link_heal_bench_worker()
     elif "--rs-sweep-worker" in sys.argv:
         _rs_sweep_worker()
+    elif "--alltoall-sweep-worker" in sys.argv:
+        _alltoall_sweep_worker()
     elif "--sharded-bytes-worker" in sys.argv:
         _sharded_bytes_worker()
     elif "--sharded-gate" in sys.argv:

@@ -27,7 +27,7 @@ echo "== test suite (8-device virtual CPU mesh) =="
 # Caller args go BEFORE the marker filter so a user-passed -m cannot
 # override it — the fault tests must only ever run under the hard
 # timeout below (a reintroduced hang would otherwise eat the CI budget).
-PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability and not linkheal and not priority and not ckpt"
+PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q "${@}" -m "not fault and not scale and not straggler and not observability and not linkheal and not priority and not ckpt and not moe"
 
 echo "== fault-tolerance gate (pytest -m fault, hard timeout) =="
 # These tests previously WOULD HANG when a rank died mid-collective; the
@@ -55,7 +55,9 @@ echo "== link-heal gate (transparent reconnect under conn-reset, hard timeout) =
 # the main sweep and the fault gates above): a 4-rank multichannel run
 # with one injected conn-reset per rank completes every step BIT-EXACT
 # with zero collective aborts and link_reconnects >= 1 on every rank
-# (test_heal_mid_allreduce_bitwise_parity), a transient recv stall heals
+# (test_heal_mid_allreduce_bitwise_parity), variable-split alltoalls
+# riding the healed per-channel sockets stay bitwise equal to pairwise
+# sends (test_heal_mid_alltoall_bitwise_parity), a transient recv stall heals
 # with zero reconnects, and a HOROVOD_LINK_HEAL_TIMEOUT_MS=1-strangled
 # run escalates to the clean attributed abort within the fault bound
 # (test_retries_exhausted_escalates_to_clean_abort).  The seeded flap
@@ -63,6 +65,21 @@ echo "== link-heal gate (transparent reconnect under conn-reset, hard timeout) =
 # hang detector for a healing loop that stops converging.
 PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
     python -m pytest tests/ -q -m "linkheal"
+
+echo "== moe gate (expert-parallel plane: dense-reference bit-parity, hard timeout) =="
+# Expert-parallel MoE plane (docs/moe.md, own `moe` marker, excluded
+# from the main sweep): a distributed MoE training step at 2 AND 4
+# ranks — over shm and the pure-TCP multi-channel cascade — must be
+# BIT-IDENTICAL to the single-rank dense-gated reference (forward
+# bytes, input grads, router grads, owned expert grads, updated
+# params), the capacity-factor sweep's drop-token counts must equal
+# the reference exactly with the engine's moe_tokens_dropped counter
+# advancing by precisely the local drops, training must converge on
+# the reference trajectory, and moe.* alltoalls must be attributed as
+# MOE_DISPATCH timeline spans.  The hard timeout is the hang detector
+# for a wedged dispatch/combine alltoall.
+PALLAS_AXON_POOL_IPS= timeout -k 15 600 \
+    python -m pytest tests/test_moe.py -q -m "moe"
 
 echo "== elastic resize gate (3 ranks, kill rank 2, no replacement) =="
 # In-place membership regression gate: rank 2 dies with no replacement;

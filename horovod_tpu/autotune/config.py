@@ -77,6 +77,34 @@ def _sparse_topk(raw):
     return str(min(1.0, max(1e-6, v)))
 
 
+def _moe_experts(raw):
+    # Mirrors runtime/moe.moe_experts_default: lenient int parse,
+    # default = world size (one expert per rank), clamped up to the
+    # world size.  --print-config runs worldless, so the floor shows as
+    # the symbolic default.
+    try:
+        n = int(raw) if raw and raw.strip() else 0
+    except ValueError:
+        n = 0
+    return str(n) if n > 0 else "(world size)"
+
+
+def _moe_capacity_factor(raw):
+    # Mirrors runtime/moe.moe_capacity_factor_default exactly.
+    try:
+        return str(max(0.0, float(raw)) if raw and raw.strip() else 1.25)
+    except ValueError:
+        return "1.25"
+
+
+def _moe_topk(raw):
+    # Mirrors runtime/moe.moe_topk_default exactly.
+    try:
+        return str(max(1, int(raw)) if raw and raw.strip() else 2)
+    except ValueError:
+        return "2"
+
+
 #: Every performance/robustness knob the engine reads, in the order the
 #: docs table presents them.  Live-tunable knobs (autotune may rewrite
 #: them at runtime) are marked in the doc string.
@@ -239,6 +267,19 @@ KNOBS: List[Knob] = [
          "k allgathers at priority band 0 so the banded scheduler "
          "overlaps them with compute (0 disables — every gather "
          "blocks)"),
+    Knob("HOROVOD_MOE_EXPERTS", "(world size)", _moe_experts,
+         "global expert count for the MoE plane (runtime/moe.py): "
+         "defaults to one expert per rank and is clamped up to the "
+         "world size so every rank owns at least one expert; must "
+         "divide evenly across ranks (docs/moe.md)"),
+    Knob("HOROVOD_MOE_CAPACITY_FACTOR", "1.25", _moe_capacity_factor,
+         "slack multiplier on the perfect-balance per-expert token "
+         "budget: capacity = ceil(cf * topk * tokens / experts); "
+         "overflow tokens drop deterministically in global token order "
+         "(moe_tokens_dropped counter)"),
+    Knob("HOROVOD_MOE_TOPK", "2", _moe_topk,
+         "experts per token for top-k gating (stable tie-break toward "
+         "the lower expert id; full-softmax gate weights)"),
     Knob("HOROVOD_LOCAL_SGD_STEPS", "1",
          lambda raw: str(max(1, _int_env(raw, 1))),
          "local-SGD periodic sync: H local steps per outer model-delta "

@@ -190,6 +190,38 @@ int64_t horovod_reducescatter_fallbacks() {
 }
 int64_t horovod_sharded_steps() { return Engine::Get().sharded_steps(); }
 void horovod_note_sharded_step() { Engine::Get().NoteShardedStep(); }
+// Alltoall observability (first-class collective + the MoE plane riding
+// it): payload bytes / wall time of ALLTOALL responses — Python's
+// stats() derives alltoall_bus_bw_bytes_per_sec = (N-1)/N·bytes/wall —
+// plus cumulative MoE drop-token accounting (noted per dispatch from
+// runtime/moe.py so it rides the TELEM fleet aggregation).
+int64_t horovod_alltoall_bytes() { return Engine::Get().alltoall_bytes(); }
+int64_t horovod_alltoall_ns() { return Engine::Get().alltoall_ns(); }
+int64_t horovod_moe_tokens_dropped() {
+  return Engine::Get().moe_tokens_dropped();
+}
+void horovod_note_moe_dispatch(int64_t dropped) {
+  Engine::Get().NoteMoeDispatch(dropped);
+}
+// Alltoall enqueue with the variable per-rank split surface: `splits`
+// (nsplits = world size entries, summing to shape[0]) is this rank's
+// per-destination dim-0 row counts; nsplits = 0 is the legacy
+// equal-split contract.  wire_dtype/wire_advisory/priority behave
+// exactly as in horovod_enqueue_priority.
+int64_t horovod_enqueue_alltoall(const char* name, int dtype, int ndim,
+                                 const int64_t* shape, void* data,
+                                 const int64_t* splits, int nsplits,
+                                 int wire_dtype, int wire_advisory,
+                                 int priority) {
+  std::vector<int64_t> dims(shape, shape + ndim);
+  std::vector<int64_t> sp;
+  if (splits != nullptr && nsplits > 0) sp.assign(splits, splits + nsplits);
+  return Engine::Get().Enqueue(RequestType::ALLTOALL, name,
+                               static_cast<DataType>(dtype), dims, data,
+                               /*root_rank=*/-1, hvd::ReduceOp::SUM,
+                               /*probe=*/false, wire_dtype, priority,
+                               wire_advisory != 0, sp);
+}
 int64_t horovod_num_channels() {
   return static_cast<int64_t>(Engine::Get().num_channels());
 }

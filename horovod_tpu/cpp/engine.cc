@@ -2535,7 +2535,8 @@ const char* const kTelemCounterNames[TC_COUNT] = {
     "shm_bytes_tx",         "compressed_bytes_tx",
     "wire_bytes_saved",     "backup_skips",
     "stale_epoch_msgs",     "stall_warnings",
-    "priority_inversions",
+    "priority_inversions",  "alltoall_bytes",
+    "moe_tokens_dropped",
 };
 
 TelemEntry Engine::BuildTelemEntry() {
@@ -2558,7 +2559,8 @@ TelemEntry Engine::BuildTelemEntry() {
       shm_bytes_tx_.load(),         compressed_bytes_tx_.load(),
       wire_bytes_saved_.load(),     backup_skips_.load(),
       stale_epoch_msgs_.load(),     stall_warnings_.load(),
-      priority_inversions_.load(),
+      priority_inversions_.load(),  alltoall_bytes_.load(),
+      moe_tokens_dropped_.load(),
   };
   t.deltas.resize(TC_COUNT);
   for (int i = 0; i < TC_COUNT; ++i) {
@@ -3410,6 +3412,7 @@ static Request RequestFromEntry(const TensorTableEntry& e, int rank) {
   q.wire_default = e.wire_default;
   q.priority = e.priority;
   for (int d = 0; d < e.shape.ndim(); ++d) q.shape.push_back(e.shape.dim(d));
+  q.splits = e.splits;
   return q;
 }
 
@@ -3461,6 +3464,7 @@ void Engine::ApplyCacheUpdates(const ResponseList& list) {
         for (int d = 0; d < e.shape.ndim(); ++d) {
           entry.sig.shape.push_back(e.shape.dim(d));
         }
+        entry.sig.splits = e.splits;
       }
       Response single;
       single.type = resp.type;
@@ -3951,7 +3955,8 @@ Response Engine::BuildResponse(const std::string& name) {
     // on the ring.  Probes and knob-derived (wire_default) requests are
     // exempt — they adopt the committed wire (see wire_ref above).
     if ((first.type == RequestType::ALLREDUCE ||
-         first.type == RequestType::REDUCESCATTER) &&
+         first.type == RequestType::REDUCESCATTER ||
+         first.type == RequestType::ALLTOALL) &&
         !q.probe && !q.wire_default && !wire_ref->wire_default &&
         q.wire_dtype != wire_ref->wire_dtype) {
       err << "Mismatched wire dtypes: rank " << wire_ref->request_rank
@@ -3980,9 +3985,90 @@ Response Engine::BuildResponse(const std::string& name) {
     }
   }
 
-  if (first.type == RequestType::REDUCESCATTER ||
-      first.type == RequestType::ALLTOALL) {
-    // Both need identical shapes on every rank (the output partitioning is
+  if (first.type == RequestType::ALLTOALL) {
+    // Split geometry negotiated like the dim-0 allgather's: dims 1+ must
+    // match on every rank, dim 0 may differ (each rank routes its own
+    // rows).  Per-rank `splits` — when present — must be size_
+    // non-negative entries summing to that rank's dim 0; an EMPTY splits
+    // vector is the legacy equal-split contract (dim 0 divisible by the
+    // world size).  The committed size×size split matrix rides
+    // tensor_sizes row-major: row r = rank r's send splits, so rank j's
+    // recv geometry is column j.
+    if (first.shape.empty()) {
+      err << "alltoall requires a tensor with at least one dimension for "
+             "tensor " << name << ".";
+      resp.type = ResponseType::ERROR;
+      resp.error_message = err.str();
+      return resp;
+    }
+    for (int r = 1; r < size_; ++r) {
+      const auto& s = info.requests[r].shape;
+      bool ok = s.size() == first.shape.size() && !s.empty();
+      for (size_t d = 1; ok && d < s.size(); ++d) ok = s[d] == first.shape[d];
+      if (!ok) {
+        err << "Mismatched alltoall tensor shapes: all dimensions except "
+               "the first must match across ranks for tensor "
+            << name << ".";
+        resp.type = ResponseType::ERROR;
+        resp.error_message = err.str();
+        return resp;
+      }
+    }
+    for (int r = 0; r < size_; ++r) {
+      const Request& q = info.requests[r];
+      const int64_t rows = q.shape[0];
+      if (q.splits.empty()) {
+        if (rows % size_ != 0) {
+          err << "alltoall requires dimension 0 (" << rows
+              << ") to be divisible by the number of ranks (" << size_
+              << ") for tensor " << name
+              << " when no explicit splits are passed.";
+          resp.type = ResponseType::ERROR;
+          resp.error_message = err.str();
+          return resp;
+        }
+        for (int d = 0; d < size_; ++d) {
+          resp.tensor_sizes.push_back(rows / size_);
+        }
+        continue;
+      }
+      if (static_cast<int>(q.splits.size()) != size_) {
+        err << "alltoall splits for tensor " << name << " on rank " << r
+            << " has " << q.splits.size() << " entries; expected one per "
+            << "rank (" << size_ << ").";
+        resp.type = ResponseType::ERROR;
+        resp.error_message = err.str();
+        return resp;
+      }
+      int64_t sum = 0;
+      for (int64_t s : q.splits) {
+        if (s < 0) {
+          err << "alltoall splits for tensor " << name << " on rank " << r
+              << " contain a negative entry (" << s << ").";
+          resp.type = ResponseType::ERROR;
+          resp.error_message = err.str();
+          return resp;
+        }
+        sum += s;
+      }
+      if (sum != rows) {
+        err << "alltoall splits for tensor " << name << " on rank " << r
+            << " sum to " << sum << " but dimension 0 is " << rows << ".";
+        resp.type = ResponseType::ERROR;
+        resp.error_message = err.str();
+        return resp;
+      }
+      for (int64_t s : q.splits) resp.tensor_sizes.push_back(s);
+    }
+    resp.type = ResponseType::ALLTOALL;
+    // Committed wire format: alltoall rides the same codec seam as the
+    // reductions (fp16/bf16 half staging, int8/fp8 block quantization of
+    // the routed activations).
+    resp.wire_dtype = wire_ref->wire_dtype;
+    return resp;
+  }
+  if (first.type == RequestType::REDUCESCATTER) {
+    // Needs identical shapes on every rank (the output partitioning is
     // computed from the common shape).
     for (int r = 1; r < size_; ++r) {
       if (info.requests[r].shape != first.shape) {
@@ -3999,18 +4085,6 @@ Response Engine::BuildResponse(const std::string& name) {
           << "least one dimension for tensor " << name << ".";
       resp.type = ResponseType::ERROR;
       resp.error_message = err.str();
-      return resp;
-    }
-    if (first.type == RequestType::ALLTOALL) {
-      if (first.shape[0] % size_ != 0) {
-        err << "alltoall requires dimension 0 (" << first.shape[0]
-            << ") to be divisible by the number of ranks (" << size_
-            << ") for tensor " << name << ".";
-        resp.type = ResponseType::ERROR;
-        resp.error_message = err.str();
-        return resp;
-      }
-      resp.type = ResponseType::ALLTOALL;
       return resp;
     }
     // Reducescatter: rows split as evenly as possible, earlier ranks get
@@ -7035,58 +7109,238 @@ void Engine::ExecReducescatter(const Response& response,
 void Engine::ExecAlltoall(const Response& response,
                           std::vector<TensorTableEntry>& entries,
                           const ExecCtx& ctx) {
-  // Ring-rotation alltoall: circulate each rank's full input around the
-  // ring; at step t a rank holds the input of rank (rank - t) and keeps
-  // the block addressed to it.  Link traffic is (size-1)·input — fine for
-  // the host control/data plane this engine serves (the accelerator
-  // alltoall is an XLA collective, ops/collective_ops.py); a pairwise
-  // exchange would need all-to-all sockets the ring deliberately avoids.
+  // Variable-split ring-rotation alltoall: circulate each rank's full
+  // (wire-form) input around the ring; at step t a rank holds the input
+  // of rank (rank - t - 1) and extracts the block addressed to it.
+  // Link traffic is (size-1)·input — fine for the host control/data
+  // plane this engine serves (the accelerator alltoall is an XLA
+  // collective, ops/collective_ops.py); a pairwise exchange would need
+  // all-to-all sockets the ring deliberately avoids.  The committed
+  // size×size split matrix rides response.tensor_sizes row-major (row s
+  // = rank s's send splits), so every rank derives every peer's buffer
+  // geometry — including encoded sizes under a block-quantized wire —
+  // without any extra negotiation.  Out-of-place: recv dim0 = Σ over
+  // sources of split(src → this rank), which generally differs from the
+  // send dim0.
   TensorTableEntry& e = entries[0];
   timeline_.Start(e.name);
   const size_t esize = DataTypeSize(e.dtype);
-  int64_t total = e.shape.num_elements();
-  int64_t block = total / size_;  // elements per destination block
+  int64_t row = 1;  // elements per dim-0 row (dims 1+ match cross-rank)
+  for (int d = 1; d < e.shape.ndim(); ++d) row *= e.shape.dim(d);
+
+  // Committed split matrix; synthesized for the legacy equal-split
+  // contract if a (defensively handled) matrix-less response shows up.
+  std::vector<int64_t> matrix = response.tensor_sizes;
+  if (matrix.size() != static_cast<size_t>(size_) * size_) {
+    matrix.assign(static_cast<size_t>(size_) * size_,
+                  e.shape.ndim() > 0 ? e.shape.dim(0) / size_ : 0);
+  }
+  auto split = [&](int s, int d) -> int64_t {
+    return matrix[static_cast<size_t>(s) * size_ + d];
+  };
+
+  // Geometry: per-source send dim0 and this rank's recv layout.
+  std::vector<int64_t> src_rows(size_, 0);
+  int64_t recv_rows = 0;
+  for (int s = 0; s < size_; ++s) {
+    for (int d = 0; d < size_; ++d) src_rows[s] += split(s, d);
+    recv_rows += split(s, rank_);
+  }
+  // Output offsets (bytes): source blocks land in source-rank order.
+  std::vector<int64_t> out_off(size_, 0);
+  for (int s = 1; s < size_; ++s) {
+    out_off[s] = out_off[s - 1] +
+                 split(s - 1, rank_) * row * static_cast<int64_t>(esize);
+  }
 
   auto hs = GetHandle(e.handle);
   if (hs == nullptr) return;
-  hs->result.resize(static_cast<size_t>(total) * esize);
+  hs->result.resize(static_cast<size_t>(recv_rows * row) * esize);
   hs->result_shape.clear();
-  for (int d = 0; d < e.shape.ndim(); ++d) {
+  hs->result_shape.push_back(recv_rows);
+  for (int d = 1; d < e.shape.ndim(); ++d) {
     hs->result_shape.push_back(e.shape.dim(d));
   }
 
   const uint8_t* input = static_cast<const uint8_t*>(e.data);
-  const size_t block_bytes = static_cast<size_t>(block) * esize;
-  // Own block stays put.
-  memcpy(hs->result.data() + rank_ * block_bytes, input + rank_ * block_bytes,
-         block_bytes);
-  if (size_ > 1) {
-    timeline_.ActivityStart(e.name, "RING_ALLTOALL");
-    std::vector<uint8_t> cur(input, input + static_cast<size_t>(total) * esize);
-    std::vector<uint8_t> nxt(cur.size());
-    RingSpec spec = FlatRingSpec();
-    const RingPort& port = spec.ports[ctx.channel];
-    for (int step = 1; step < size_; ++step) {
-      std::string err;
-      int64_t wns = 0;
-      if (!PortSendRecvChunked(port, cur.data(), cur.size(), nxt.data(),
-                               nxt.size(), /*chunk=*/0, nullptr,
-                               socket_timeout_sec_ * 1000, &err, &wns)) {
-        timeline_.ActivityEnd(e.name);
-        FinishEntry(e, Status::Aborted(TransportError(
-            "alltoall", e.name, err, (rank_ + 1) % size_,
-            (rank_ - 1 + size_) % size_)));
-        return;
+  const int64_t my_bytes = src_rows[rank_] * row *
+                           static_cast<int64_t>(esize);
+  alltoall_bytes_.fetch_add(my_bytes);
+  auto t0 = std::chrono::steady_clock::now();
+
+  if (size_ == 1) {
+    // World of one: identity (the MoE plane's single-rank bit-exact
+    // reference path — no wire, no codec).
+    memcpy(hs->result.data(), input, static_cast<size_t>(my_bytes));
+    alltoall_ns_.fetch_add(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - t0)
+            .count());
+    timeline_.End(e.name, e.dtype, e.shape.DebugString());
+    FinishEntry(e, Status::OK());
+    return;
+  }
+
+  // Committed wire format (fp32 payloads only, like the reductions).
+  const WireDtype wire = e.dtype == DataType::FLOAT32
+                             ? response.wire_dtype : WireDtype::FP32;
+  const bool quantized = wire == WireDtype::INT8 || wire == WireDtype::FP8;
+  const bool half_wire = wire == WireDtype::FP16 || wire == WireDtype::BF16;
+  switch (wire) {
+    case WireDtype::FP16: wire_fp16_count_.fetch_add(1); break;
+    case WireDtype::BF16: wire_bf16_count_.fetch_add(1); break;
+    case WireDtype::INT8: wire_int8_count_.fetch_add(1); break;
+    case WireDtype::FP8: wire_fp8_count_.fetch_add(1); break;
+    case WireDtype::FP32: break;
+  }
+  if (wire != WireDtype::FP32) {
+    char wm[16];
+    std::snprintf(wm, sizeof(wm), "WIRE_%s", WireDtypeName(wire));
+    for (char* c = wm; *c; ++c) *c = static_cast<char>(toupper(*c));
+    timeline_.Algo(e.name, wm);
+  }
+
+  // Per-source WIRE buffer geometry, identical on every rank.  Blocks
+  // are encoded per DESTINATION so a receiver decodes exactly its own
+  // block; under int8/fp8 each block is an independent run of
+  // fixed-size scaled sub-blocks (deterministic encoded length from the
+  // committed matrix + the committed chunk knob).
+  const size_t wire_esize = half_wire ? 2 : esize;
+  const int64_t qblock_elems =
+      std::max<int64_t>(64, chunk_bytes_.load() / 4);
+  const size_t qblock_bytes = 4 + static_cast<size_t>(qblock_elems);
+  auto enc_bytes = [&](int64_t nelems) -> int64_t {
+    if (!quantized) return nelems * static_cast<int64_t>(wire_esize);
+    if (nelems == 0) return 0;
+    return (nelems + qblock_elems - 1) / qblock_elems *
+           static_cast<int64_t>(qblock_bytes);
+  };
+  std::vector<int64_t> buf_bytes(size_, 0);
+  // blk_off[s*size_+d]: byte offset of block (s → d) in source s's wire
+  // buffer.
+  std::vector<int64_t> blk_off(static_cast<size_t>(size_) * size_, 0);
+  int64_t max_buf = 0;
+  for (int s = 0; s < size_; ++s) {
+    int64_t off = 0;
+    for (int d = 0; d < size_; ++d) {
+      blk_off[static_cast<size_t>(s) * size_ + d] = off;
+      off += enc_bytes(split(s, d) * row);
+    }
+    buf_bytes[s] = off;
+    max_buf = std::max(max_buf, off);
+  }
+
+  // Stage this rank's input into wire form.  The codec round-trips the
+  // OWN block too, so a block's bytes never depend on which rank it
+  // stayed on — fp32 wire stays bitwise-verbatim, lossy wires are
+  // uniformly lossy.
+  std::vector<uint8_t> cur(static_cast<size_t>(max_buf));
+  std::vector<uint8_t> nxt(static_cast<size_t>(max_buf));
+  if (wire == WireDtype::FP32) {
+    memcpy(cur.data(), input, static_cast<size_t>(my_bytes));
+  } else {
+    const float* fp = reinterpret_cast<const float*>(input);
+    auto q0 = std::chrono::steady_clock::now();
+    if (half_wire) {
+      uint16_t* hb = reinterpret_cast<uint16_t*>(cur.data());
+      const int64_t n = src_rows[rank_] * row;
+      if (wire == WireDtype::FP16) {
+        for (int64_t i = 0; i < n; ++i) hb[i] = FloatToHalf(fp[i]);
+      } else {
+        for (int64_t i = 0; i < n; ++i) hb[i] = FloatToBF16(fp[i]);
       }
-      wire_ns_.fetch_add(wns);
-      CountPortBytes(port, static_cast<int64_t>(cur.size()),
-                     static_cast<int64_t>(nxt.size()));
-      int src = (rank_ - step + size_) % size_;
-      memcpy(hs->result.data() + src * block_bytes,
-             nxt.data() + rank_ * block_bytes, block_bytes);
+    } else {
+      int64_t elem_off = 0;
+      for (int d = 0; d < size_; ++d) {
+        const int64_t n = split(rank_, d) * row;
+        uint8_t* dst =
+            cur.data() + blk_off[static_cast<size_t>(rank_) * size_ + d];
+        for (int64_t o = 0; o < n; o += qblock_elems) {
+          QuantizeBlock(fp + elem_off + o, std::min(qblock_elems, n - o),
+                        wire, dst + o / qblock_elems * qblock_bytes,
+                        qblock_elems);
+        }
+        elem_off += n;
+      }
+    }
+    quantize_ns_.fetch_add(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - q0)
+            .count());
+    wire_bytes_saved_.fetch_add(
+        std::max<int64_t>(0, my_bytes - buf_bytes[rank_]));
+  }
+
+  // Decode block (src → this rank) out of src's wire buffer into the
+  // output slot.
+  auto extract = [&](int src, const uint8_t* buf) {
+    const int64_t n = split(src, rank_) * row;
+    if (n == 0) return;
+    const uint8_t* blk =
+        buf + blk_off[static_cast<size_t>(src) * size_ + rank_];
+    uint8_t* out = hs->result.data() + out_off[src];
+    if (wire == WireDtype::FP32) {
+      memcpy(out, blk, static_cast<size_t>(n) * esize);
+      return;
+    }
+    float* fout = reinterpret_cast<float*>(out);
+    auto q0 = std::chrono::steady_clock::now();
+    if (half_wire) {
+      const uint16_t* hb = reinterpret_cast<const uint16_t*>(blk);
+      if (wire == WireDtype::FP16) {
+        for (int64_t i = 0; i < n; ++i) fout[i] = HalfToFloat(hb[i]);
+      } else {
+        for (int64_t i = 0; i < n; ++i) fout[i] = BF16ToFloat(hb[i]);
+      }
+    } else {
+      for (int64_t o = 0; o < n; o += qblock_elems) {
+        DequantizeBlock(blk + o / qblock_elems * qblock_bytes,
+                        std::min(qblock_elems, n - o), wire, fout + o);
+      }
+    }
+    quantize_ns_.fetch_add(
+        std::chrono::duration_cast<std::chrono::nanoseconds>(
+            std::chrono::steady_clock::now() - q0)
+            .count());
+  };
+  extract(rank_, cur.data());
+
+  // MoE token routing gets its own span (like FSDP_AG) so expert
+  // dispatch/combine traffic is attributable against compute in traces.
+  timeline_.ActivityStart(e.name, e.name.rfind("moe.", 0) == 0
+                                      ? "MOE_DISPATCH" : "ALLTOALL");
+  RingSpec spec = FlatRingSpec();
+  const RingPort& port = spec.ports[ctx.channel];
+  bool failed = false;
+  std::string err;
+  for (int step = 0; step < size_ - 1 && !failed; ++step) {
+    const int send_src = (rank_ - step + size_) % size_;
+    const int recv_src = (rank_ - step - 1 + size_) % size_;
+    int64_t wns = 0;
+    failed = !PortSendRecvChunked(
+        port, cur.data(), static_cast<size_t>(buf_bytes[send_src]),
+        nxt.data(), static_cast<size_t>(buf_bytes[recv_src]),
+        /*chunk=*/0, nullptr, socket_timeout_sec_ * 1000, &err, &wns);
+    wire_ns_.fetch_add(wns);
+    if (!failed) {
+      CountPortBytes(port, buf_bytes[send_src], buf_bytes[recv_src]);
+      if (wire != WireDtype::FP32) {
+        compressed_bytes_tx_.fetch_add(buf_bytes[send_src]);
+      }
+      extract(recv_src, nxt.data());
       cur.swap(nxt);
     }
-    timeline_.ActivityEnd(e.name);
+  }
+  timeline_.ActivityEnd(e.name);
+  alltoall_ns_.fetch_add(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now() - t0)
+          .count());
+  if (failed) {
+    FinishEntry(e, Status::Aborted(TransportError(
+        "alltoall", e.name, err, (rank_ + 1) % size_,
+        (rank_ - 1 + size_) % size_)));
+    return;
   }
   timeline_.End(e.name, e.dtype, e.shape.DebugString());
   FinishEntry(e, Status::OK());
@@ -7370,7 +7624,8 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
                         DataType dtype, const std::vector<int64_t>& shape,
                         void* data, int root_rank, ReduceOp red_op,
                         bool probe, int wire_dtype, int priority,
-                        bool wire_advisory) {
+                        bool wire_advisory,
+                        const std::vector<int64_t>& splits) {
   MaybeInjectFault();
   if (!initialized_.load() || shutdown_requested_.load() ||
       shut_down_.load()) {
@@ -7384,7 +7639,8 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   // fallback (full quantized ring + local slice).
   WireDtype wire = WireDtype::FP32;
   if ((type == RequestType::ALLREDUCE ||
-       type == RequestType::REDUCESCATTER) &&
+       type == RequestType::REDUCESCATTER ||
+       type == RequestType::ALLTOALL) &&
       dtype == DataType::FLOAT32) {
     int wv = wire_dtype >= 0 ? wire_dtype : wire_dtype_.load();
     if (wv >= 1 && wv <= 4) wire = static_cast<WireDtype>(wv);
@@ -7416,6 +7672,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   e.wire_dtype = wire;
   e.wire_default = wire_default;
   e.priority = static_cast<int32_t>(priority);
+  if (type == RequestType::ALLTOALL) e.splits = splits;
   e.handle = handle;
   e.enqueue_time = std::chrono::steady_clock::now();
 
@@ -7431,6 +7688,7 @@ int64_t Engine::Enqueue(RequestType type, const std::string& name,
   q.wire_default = wire_default;
   q.priority = static_cast<int32_t>(priority);
   q.shape = shape;
+  if (type == RequestType::ALLTOALL) q.splits = splits;
 
   {
     std::lock_guard<std::mutex> lk(mu_);

@@ -74,6 +74,10 @@ enum TelemCounter {
   TC_STALE_EPOCH_MSGS,
   TC_STALL_WARNINGS,
   TC_PRIORITY_INVERSIONS,
+  // Appended entries (PR 20) — the wire carries positions, so new
+  // counters only ever go at the END, before TC_COUNT.
+  TC_ALLTOALL_BYTES,
+  TC_MOE_TOKENS_DROPPED,
   TC_COUNT,
 };
 extern const char* const kTelemCounterNames[TC_COUNT];
@@ -95,6 +99,9 @@ struct TensorTableEntry {
   bool wire_default = false;
   // Scheduling priority (0 = most urgent; see Request::priority).
   int32_t priority = 0;
+  // Alltoall: this rank's per-destination dim-0 split sizes (see
+  // Request::splits).  Empty = legacy equal splits.
+  std::vector<int64_t> splits;
   int64_t handle = -1;
   // Enqueue wall-clock: FinishEntry derives the per-collective
   // completion latency (step_time_ns percentiles) from it.
@@ -201,11 +208,15 @@ class Engine {
   // value on a cross-rank disagreement instead of erroring — the seam
   // the statistics-driven wire policy uses, since per-rank gradient
   // stats may legitimately disagree for a step.
+  // `splits` (alltoall only): per-destination dim-0 row counts, size_
+  // entries summing to shape[0]; empty = legacy equal splits (shape[0]
+  // divisible by world size).
   int64_t Enqueue(RequestType type, const std::string& name, DataType dtype,
                   const std::vector<int64_t>& shape, void* data,
                   int root_rank, ReduceOp red_op = ReduceOp::SUM,
                   bool probe = false, int wire_dtype = -1,
-                  int priority = 0, bool wire_advisory = false);
+                  int priority = 0, bool wire_advisory = false,
+                  const std::vector<int64_t>& splits = {});
 
   // Execution stats (readable from any thread).  `exec_cycles` counts
   // negotiation cycles that executed at least one response on this rank;
@@ -286,6 +297,19 @@ class Engine {
   int64_t reducescatter_ns() const { return reducescatter_ns_.load(); }
   int64_t reducescatter_fallback_count() const {
     return reducescatter_fallback_count_.load();
+  }
+  // Alltoall observability: payload bytes (full input buffer per
+  // response — what the variable-split ring circulates scales it by
+  // (N-1)/N, which is also the alltoall busbw numerator convention) and
+  // cumulative wall time of ALLTOALL responses.
+  int64_t alltoall_bytes() const { return alltoall_bytes_.load(); }
+  int64_t alltoall_ns() const { return alltoall_ns_.load(); }
+  // MoE plane accounting (runtime/moe.py): cumulative tokens dropped by
+  // capacity-factor truncation, noted per dispatch from Python so the
+  // counter rides the TELEM fleet aggregation like sharded_steps.
+  int64_t moe_tokens_dropped() const { return moe_tokens_dropped_.load(); }
+  void NoteMoeDispatch(int64_t dropped) {
+    moe_tokens_dropped_.fetch_add(dropped);
   }
   // Sharded-optimizer steps (ZeRO-1: reducescatter(grads) → shard-local
   // update → allgather) completed by the Python frontends on this
@@ -1008,10 +1032,14 @@ class Engine {
     // band-fuses) by the CURRENT priority on every rank.
     int32_t priority = 0;
     std::vector<int64_t> shape;
+    // Alltoall split geometry: a split change re-routes bytes, so it
+    // must evict and renegotiate exactly like a shape change.
+    std::vector<int64_t> splits;
     bool Matches(const Request& q) const {
       return q.type == type && q.dtype == dtype && q.root_rank == root_rank &&
              q.red_op == red_op && q.wire_dtype == wire_dtype &&
-             q.priority == priority && q.shape == shape;
+             q.priority == priority && q.shape == shape &&
+             q.splits == splits;
     }
   };
   struct CacheEntry {
@@ -1523,6 +1551,9 @@ class Engine {
   std::atomic<int64_t> reducescatter_bytes_{0};
   std::atomic<int64_t> reducescatter_ns_{0};
   std::atomic<int64_t> reducescatter_fallback_count_{0};
+  std::atomic<int64_t> alltoall_bytes_{0};
+  std::atomic<int64_t> alltoall_ns_{0};
+  std::atomic<int64_t> moe_tokens_dropped_{0};
   std::atomic<int64_t> sharded_steps_{0};
   std::atomic<int64_t> shm_bytes_tx_{0};
   std::atomic<int64_t> shm_bytes_rx_{0};

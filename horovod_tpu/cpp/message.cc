@@ -21,6 +21,8 @@ static void SerializeRequest(const Request& q, Writer* w) {
   w->u8(q.wire_default ? 1 : 0);
   w->vu(q.shape.size());
   for (auto d : q.shape) w->vi(d);
+  w->vu(q.splits.size());
+  for (auto s : q.splits) w->vi(s);
 }
 
 static bool ParseRequest(Reader* r, Request* q) {
@@ -37,6 +39,10 @@ static bool ParseRequest(Reader* r, Request* q) {
   if (nd > (1u << 16)) return false;  // corrupt frame guard
   q->shape.clear();
   for (uint64_t i = 0; i < nd && r->ok(); ++i) q->shape.push_back(r->vi());
+  uint64_t ns = r->vu();
+  if (ns > (1u << 16)) return false;  // corrupt frame guard
+  q->splits.clear();
+  for (uint64_t i = 0; i < ns && r->ok(); ++i) q->splits.push_back(r->vi());
   return r->ok();
 }
 

@@ -115,6 +115,12 @@ struct Request {
   // pre-priority protocol.
   int32_t priority = 0;
   std::vector<int64_t> shape;
+  // Alltoall only: this rank's per-destination dim-0 row counts (size_
+  // entries summing to shape[0]).  EMPTY means the legacy equal-split
+  // contract (shape[0] divisible by world size).  Validated cross-rank
+  // like the dim-0 allgather's geometry; the committed size×size split
+  // matrix rides Response::tensor_sizes row-major.
+  std::vector<int64_t> splits;
 };
 
 // Fleet telemetry (HOROVOD_TELEMETRY_CYCLES): every N negotiation cycles

@@ -182,14 +182,23 @@ def reducescatter(tensor, *, axis_name="data", op=Sum, scatter_axis=0,
 
 
 def alltoall(tensor, *, axis_name="seq", split_axis=0, concat_axis=0,
-             name=None):
+             name=None, splits=None, wire_dtype=None, priority=None):
     """All-to-all.  Traced: one XLA all_to_all over ``axis_name``.  Eager:
     cross-process ring exchange of equal blocks, axis-general via a
     moveaxis shim (the wire op exchanges dim-0 blocks): split ``tensor``
     into ``size()`` blocks along ``split_axis``; block i goes to rank i;
     the received blocks concatenate along ``concat_axis`` — same
-    semantics as ``lax.all_to_all`` on the traced path."""
+    semantics as ``lax.all_to_all`` on the traced path.
+
+    ``splits`` (eager, dim 0 only) sends VARIABLE per-rank row counts —
+    the MoE dispatch/combine primitive; the output's dim 0 is this
+    rank's column of the negotiated size matrix, so it is data-dependent
+    and only available eagerly."""
     if _is_traced(tensor):
+        if splits is not None:
+            raise NotImplementedError(
+                "variable splits are eager-only (the output shape is "
+                "data-dependent; XLA all_to_all exchanges equal blocks)")
         return _cops.alltoall(tensor, axis_name=axis_name,
                               split_axis=split_axis, concat_axis=concat_axis)
     import jax.numpy as jnp
@@ -199,8 +208,14 @@ def alltoall(tensor, *, axis_name="seq", split_axis=0, concat_axis=0,
         return x
     from horovod_tpu.runtime import eager
 
+    if splits is not None and (split_axis != 0 or concat_axis != 0):
+        raise NotImplementedError(
+            "variable splits address dim-0 rows; use "
+            "split_axis=0, concat_axis=0")
     if split_axis == 0 and concat_axis == 0:
-        return eager.alltoall(x, name=name)  # wire semantics, copy-free
+        return eager.alltoall(x, name=name, splits=splits,
+                              wire_dtype=wire_dtype,
+                              priority=priority)  # wire semantics, copy-free
     moved = jnp.moveaxis(x, split_axis, 0)
     z = eager.alltoall(moved, name=name)
     # z: size() received blocks stacked along dim 0, each the moved shape

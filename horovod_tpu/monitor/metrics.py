@@ -36,7 +36,8 @@ TELEM_COUNTERS = [
     "shm_bytes_tx", "compressed_bytes_tx",
     "wire_bytes_saved", "backup_skips",
     "stale_epoch_msgs", "stall_warnings",
-    "priority_inversions",
+    "priority_inversions", "alltoall_bytes",
+    "moe_tokens_dropped",
 ]
 
 
@@ -93,6 +94,25 @@ STATS_METRICS: List[Metric] = [
            "committed responses dispatched after a less-urgent response "
            "of the same cycle (0 by construction with "
            "HOROVOD_PRIORITY_BANDS on)"),
+    Metric("alltoall_bytes", "horovod_alltoall_bytes_total", "counter",
+           "alltoall payload bytes (variable-split block exchange; "
+           "MoE dispatch/combine rides this)"),
+    Metric("alltoall_ns", "horovod_alltoall_ns_total", "counter",
+           "wall nanoseconds spent in alltoall exchanges"),
+    Metric("alltoall_bus_bw_bytes_per_sec",
+           "horovod_alltoall_bus_bw_bytes_per_sec", "gauge",
+           "alltoall bus bandwidth ((N-1)/N * bytes / wall) over the "
+           "stats window"),
+    Metric("moe_tokens_dropped", "horovod_moe_tokens_dropped_total",
+           "counter",
+           "expert-capacity overflow tokens dropped by the MoE plane "
+           "(receiver-side, deterministic in global token order)"),
+    Metric("moe_dispatches", "horovod_moe_dispatches_total", "counter",
+           "MoE dispatch/combine round trips completed by this process"),
+    Metric("moe_capacity_factor", "horovod_moe_capacity_factor", "gauge",
+           "capacity factor of the most recent MoE dispatch"),
+    Metric("moe_experts", "horovod_moe_experts", "gauge",
+           "expert count of the most recent MoE dispatch"),
     Metric("link_reconnects", "horovod_link_reconnects_total", "counter",
            "data-channel edges transparently re-established mid-collective "
            "(link self-healing, HOROVOD_LINK_RETRIES)"),

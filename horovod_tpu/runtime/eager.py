@@ -239,11 +239,20 @@ def reducescatter(tensor, *, op=Sum, average=None,
                           red_op=_WIRE_OPS[op]))
 
 
-def alltoall(tensor, *, name: Optional[str] = None):
-    """Exchange equal dim-0 blocks between processes: output block i holds
-    the block rank i addressed to this rank.  Requires dim 0 divisible by
-    ``size()`` (mismatches surface as a negotiated typed error)."""
+def alltoall(tensor, *, name: Optional[str] = None, splits=None,
+             wire_dtype: Optional[str] = None,
+             priority: Optional[int] = None):
+    """Exchange dim-0 blocks between processes: output block i holds the
+    block rank i addressed to this rank.  ``splits=None`` exchanges
+    equal blocks (dim 0 must divide by ``size()``; mismatches surface as
+    a negotiated typed error); ``splits=[n_0, .., n_{size-1}]`` sends
+    ``n_d`` rows to rank d (the per-rank vectors are validated
+    cross-rank into one committed size matrix, like the allgather
+    geometry).  ``wire_dtype``/``priority`` ride the same seams as the
+    reduction collectives (fp32 payloads only / the banded scheduler)."""
     eng = _engine()
     if eng is None:
         return jnp.asarray(tensor)
-    return jnp.asarray(eng.alltoall(np.asarray(tensor), name=name))
+    return jnp.asarray(eng.alltoall(np.asarray(tensor), name=name,
+                                    splits=splits, wire_dtype=wire_dtype,
+                                    priority=priority))

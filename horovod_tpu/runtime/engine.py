@@ -123,6 +123,21 @@ def note_sharded_step() -> None:
         fn()
 
 
+def note_moe_dispatch(dropped: int) -> None:
+    """Called by the MoE plane (runtime.moe) once per completed
+    dispatch/combine round with the number of capacity-dropped tokens —
+    lands in the engine's cumulative ``moe_tokens_dropped`` counter so
+    drop accounting rides the TELEM fleet aggregation (no-op when no
+    engine is loaded or against a stale prebuilt .so)."""
+    global _engine
+    eng = _engine
+    if eng is None:
+        return
+    fn = getattr(eng._lib, "horovod_note_moe_dispatch", None)
+    if fn is not None and getattr(fn, "restype", "?") is None:
+        fn(int(dropped))
+
+
 def flight_note(kind: str, text: str) -> None:
     """Record a Python-plane event (e.g. a checkpoint commit/restore)
     into the C++ flight recorder's ring, so postmortem merges it into
@@ -151,6 +166,14 @@ def _fsdp_stats() -> dict:
     from horovod_tpu.runtime.fsdp import fsdp_stats
 
     return fsdp_stats()
+
+
+def _moe_stats() -> dict:
+    """The MoE plane's stats() slice (lazy import, like the FSDP
+    plane's)."""
+    from horovod_tpu.runtime.moe import moe_stats
+
+    return moe_stats()
 
 
 def _dtype_code(dtype) -> int:
@@ -333,6 +356,24 @@ class NativeEngine:
             lib.horovod_note_sharded_step.restype = None
         except AttributeError:
             pass  # stale .so: the sharded_steps counter stays 0
+        try:
+            for sym in ("horovod_alltoall_bytes",
+                        "horovod_alltoall_ns",
+                        "horovod_moe_tokens_dropped"):
+                fn = getattr(lib, sym)
+                fn.argtypes = []
+                fn.restype = ctypes.c_int64
+            lib.horovod_note_moe_dispatch.argtypes = [ctypes.c_int64]
+            lib.horovod_note_moe_dispatch.restype = None
+            lib.horovod_enqueue_alltoall.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.horovod_enqueue_alltoall.restype = ctypes.c_int64
+        except AttributeError:
+            pass  # stale .so: splits alltoall raises; counters stay 0
         try:
             lib.horovod_autotune_set.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
@@ -558,10 +599,67 @@ class NativeEngine:
             red_op=red_op, wire_dtype=wire_dtype, priority=priority)
 
     def enqueue_alltoall(self, arr: np.ndarray,
-                         name: Optional[str] = None) -> int:
-        """Exchange equal dim-0 blocks: output block i came from rank i."""
-        return self._enqueue(
-            _OP_ALLTOALL, arr, self._auto_name("alltoall", name))
+                         name: Optional[str] = None,
+                         splits=None,
+                         wire_dtype: Optional[str] = None,
+                         priority: Optional[int] = None) -> int:
+        """Exchange dim-0 blocks: output block i came from rank i.
+
+        ``splits`` (world-size entries of non-negative dim-0 row counts
+        summing to ``arr.shape[0]``) is this rank's per-destination
+        routing — the MoE dispatch surface; every rank's splits are
+        validated cross-rank like the dim-0 allgather geometry, and rank
+        j receives column j of the committed split matrix.  ``None``
+        keeps the legacy equal-split contract (dim 0 divisible by world
+        size).  ``wire_dtype`` rides the codec seam (fp32 payloads only:
+        fp16/bf16 half staging, int8/fp8 per-block quantization of the
+        routed activations — fp32 stays bitwise-verbatim).  ``priority``
+        as in :meth:`enqueue_allreduce` — MoE routing traffic stamps
+        band 0 so it preempts bulk gradient bands."""
+        name = self._auto_name("alltoall", name)
+        if splits is None and wire_dtype is None and priority is None:
+            return self._enqueue(_OP_ALLTOALL, arr, name)
+        if priority is not None and not self._stamp_priorities():
+            priority = None
+        if wire_dtype is not None and wire_dtype not in WIRE_DTYPES:
+            raise ValueError(
+                f"unknown wire_dtype {wire_dtype!r} "
+                f"(want one of {sorted(WIRE_DTYPES)})")
+        fn = getattr(self._lib, "horovod_enqueue_alltoall", None)
+        if getattr(fn, "restype", None) is not ctypes.c_int64:
+            raise RuntimeError(
+                "libhorovod_core.so predates variable-split alltoall — "
+                "rebuild it with `make -C horovod_tpu/cpp`")
+        sp = [] if splits is None else [int(s) for s in splits]
+        if sp:
+            world = self._lib.horovod_size()
+            if len(sp) != world:
+                raise ValueError(
+                    f"alltoall splits must have one entry per rank "
+                    f"({world}); got {len(sp)}")
+            if any(s < 0 for s in sp):
+                raise ValueError("alltoall splits must be non-negative")
+            rows = arr.shape[0] if arr.ndim > 0 else 0
+            if sum(sp) != rows:
+                raise ValueError(
+                    f"alltoall splits sum to {sum(sp)} but dim 0 is "
+                    f"{rows}")
+        shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+        csp = (ctypes.c_int64 * max(1, len(sp)))(*(sp or [0]))
+        handle = fn(
+            name.encode(), _dtype_code(arr.dtype), arr.ndim, shape,
+            arr.ctypes.data_as(ctypes.c_void_p), csp, len(sp),
+            -1 if wire_dtype is None else WIRE_DTYPES[wire_dtype],
+            0, 0 if priority is None else max(0, int(priority)))
+        if handle == -1:
+            raise HorovodInternalError(
+                f"a collective named {name!r} is already in flight "
+                "(duplicate name)")
+        if handle < 0:
+            raise self._not_running_error()
+        with self._inflight_lock:
+            self._inflight[handle] = arr
+        return handle
 
     # -- execution stats --
 
@@ -616,11 +714,11 @@ class NativeEngine:
         the env default (see docs/autotune.md)."""
         # Gate on the NEWEST counter symbol so a stale prebuilt .so raises
         # the rebuild hint instead of an AttributeError mid-dict.
-        if getattr(getattr(self._lib, "horovod_priority_inversions",
+        if getattr(getattr(self._lib, "horovod_alltoall_bytes",
                            None),
                    "restype", None) is not ctypes.c_int64:
             raise RuntimeError(
-                "libhorovod_core.so predates the priority-scheduling "
+                "libhorovod_core.so predates the alltoall/MoE "
                 "counters (and possibly earlier counter families) — "
                 "rebuild it with `make -C horovod_tpu/cpp`")
         size = self._lib.horovod_size()
@@ -634,6 +732,12 @@ class NativeEngine:
         rs_bus_bw = 0.0
         if rs_ns > 0 and size > 1:
             rs_bus_bw = (rs_bytes * 1.0 * (size - 1) / size) / (rs_ns / 1e9)
+        a2a_bytes = self._lib.horovod_alltoall_bytes()
+        a2a_ns = self._lib.horovod_alltoall_ns()
+        a2a_bus_bw = 0.0
+        if a2a_ns > 0 and size > 1:
+            a2a_bus_bw = (a2a_bytes * 1.0 * (size - 1) / size) \
+                / (a2a_ns / 1e9)
         return {
             "cycles": self._lib.horovod_exec_cycles(),
             "responses": self._lib.horovod_responses_executed(),
@@ -724,6 +828,17 @@ class NativeEngine:
             "reducescatter_fallbacks":
                 self._lib.horovod_reducescatter_fallbacks(),
             "sharded_steps": self._lib.horovod_sharded_steps(),
+            # Alltoall (first-class collective; the MoE plane's
+            # dispatch/combine half): payload bytes / wall time of
+            # ALLTOALL responses and the derived bus bandwidth
+            # (N-1)/N·bytes/wall — matching the variable-split ring's
+            # wire pattern — plus cumulative MoE drop-token accounting
+            # (noted per dispatch from runtime/moe.py).
+            "alltoall_bytes": a2a_bytes,
+            "alltoall_ns": a2a_ns,
+            "alltoall_bus_bw_bytes_per_sec": a2a_bus_bw,
+            "moe_tokens_dropped":
+                self._lib.horovod_moe_tokens_dropped(),
             "num_channels": self._lib.horovod_num_channels(),
             "shm_bytes_tx": self._lib.horovod_shm_bytes_tx(),
             "shm_bytes_rx": self._lib.horovod_shm_bytes_rx(),
@@ -751,6 +866,9 @@ class NativeEngine:
             # The FSDP plane's counters (Python-side: unit registry,
             # prefetch hit/miss, resident full-parameter bytes + peak).
             **_fsdp_stats(),
+            # The MoE plane's counters (Python-side: dispatches
+            # completed, configured capacity factor / expert gauges).
+            **_moe_stats(),
             "topology": {
                 "hosts": self._lib.horovod_topology_hosts(),
                 "local_ranks": self._lib.horovod_topology_local_ranks(),
@@ -821,6 +939,11 @@ class NativeEngine:
             if k in ("config", "num_channels", "topology",
                      "allreduce_bus_bw_bytes_per_sec",
                      "reducescatter_bus_bw_bytes_per_sec",
+                     "alltoall_bus_bw_bytes_per_sec",
+                     # MoE gauges: configured capacity factor / expert
+                     # count of the live plane — not cumulative.
+                     "moe_capacity_factor",
+                     "moe_experts",
                      "coordinator_cycle_ns_p50",
                      "coordinator_cycle_ns_p99",
                      "step_time_ns_p50",
@@ -851,6 +974,11 @@ class NativeEngine:
             rs_bw = (delta["reducescatter_bytes"] * 1.0 * (size - 1)
                      / size) / (delta["reducescatter_ns"] / 1e9)
         delta["reducescatter_bus_bw_bytes_per_sec"] = rs_bw
+        a2a_bw = 0.0
+        if delta["alltoall_ns"] > 0 and size > 1:
+            a2a_bw = (delta["alltoall_bytes"] * 1.0 * (size - 1)
+                      / size) / (delta["alltoall_ns"] / 1e9)
+        delta["alltoall_bus_bw_bytes_per_sec"] = a2a_bw
         return delta
 
     def fleet_stats(self) -> dict:
@@ -1057,9 +1185,13 @@ class NativeEngine:
             return out
         return self._apply_average(out, info.get("participants") or None)
 
-    def alltoall(self, tensor, *, name: Optional[str] = None) -> np.ndarray:
+    def alltoall(self, tensor, *, name: Optional[str] = None,
+                 splits=None, wire_dtype: Optional[str] = None,
+                 priority: Optional[int] = None) -> np.ndarray:
         arr = np.ascontiguousarray(tensor)
-        return self.synchronize(self.enqueue_alltoall(arr, name))
+        return self.synchronize(self.enqueue_alltoall(
+            arr, name, splits=splits, wire_dtype=wire_dtype,
+            priority=priority))
 
 
 _engine: Optional[NativeEngine] = None

@@ -1,0 +1,492 @@
+"""Expert-parallel MoE training plane (Shazeer et al. sparsely-gated
+MoE; Lepikhin et al. GShard — dispatch/combine over alltoall).
+
+The FSDP plane (`runtime/fsdp.py`) shards PARAMETERS that every rank
+uses; this plane shards EXPERTS — disjoint parameter sets that each see
+only the tokens routed to them.  Tokens move, parameters stay:
+
+* **gating**: a replicated router projects each token to expert logits;
+  deterministic top-k selection (stable argsort — ties break toward the
+  lower expert id on every rank) with full-softmax gate weights;
+* **dispatch**: every kept (token, slot) assignment is a payload row
+  ``[features.., expert_id]`` routed to the expert's owner rank via ONE
+  variable-split :meth:`Engine.alltoall` (splits = per-destination row
+  counts, negotiated cross-rank by the engine's committed size matrix),
+  named ``moe.dispatch*`` so the timeline marks it ``MOE_DISPATCH`` and
+  enqueued at priority band 0 — routing traffic preempts bulk gradient
+  bands under HOROVOD_PRIORITY_BANDS;
+* **capacity**: each expert processes at most ``capacity =
+  ceil(cf * topk * total_tokens / n_experts)`` rows, first-come in
+  GLOBAL token order (ranks send their contiguous batch shard in token
+  order, and the engine lays alltoall output out in source-rank order,
+  so arrival order IS global token order).  Overflow rows return zero
+  features and are counted into the engine's ``moe_tokens_dropped``
+  telemetry counter via :func:`note_moe_dispatch` — the drop count is
+  deterministic and world-size invariant;
+* **combine**: expert outputs ride the return alltoall with the
+  TRANSPOSED splits (this rank's recv counts — the committed matrix
+  column, obtained from an equal-split int64 counts exchange), then
+  each token accumulates ``gate * expert_out`` in slot order.
+
+Bit-exactness anchor (the tests' contract): a step at ANY world size is
+bit-identical to the single-rank dense-gated reference
+(``MoeLayer(..., world=(0, 1))``) on the same global batch, because
+
+* expert math is row-at-a-time (``_expert_rows``) — a row's bytes never
+  depend on its batch neighbours or arrival position;
+* drop decisions replay in global token order (above);
+* the router gradient is computed from ALLGATHERED inputs/dlogits, so
+  every rank runs the exact same two matmuls the reference runs (no
+  ring-association drift from allreducing partial sums);
+* at size 1 the engine alltoall is a pure identity memcpy (no wire, no
+  codec), collapsing the distributed path onto the reference path.
+
+Deliberately jax/torch-free (numpy + the native engine), like
+runtime.fsdp — both frontends drive this plane, and
+``DistributedOptimizer`` composes by treating ``router_params()`` as
+replicated (reduce their grads) and ``expert_params()`` as rank-local
+(NEVER reduce them — each rank owns a disjoint expert set).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.runtime import engine_or_none
+from horovod_tpu.runtime.engine import note_moe_dispatch
+
+__all__ = ["MoeLayer", "moe_experts_default", "moe_capacity_factor_default",
+           "moe_topk_default", "moe_capacity", "moe_stats",
+           "reset_moe_stats"]
+
+
+def moe_experts_default(world_size: int = 1) -> int:
+    """``HOROVOD_MOE_EXPERTS`` (lenient-parsed): global expert count.
+    Defaults to the world size (one expert per rank); clamped up to the
+    world size so every rank owns at least one expert."""
+    raw = os.environ.get("HOROVOD_MOE_EXPERTS", "")
+    try:
+        n = int(raw) if raw.strip() else int(world_size)
+    except ValueError:
+        n = int(world_size)
+    return max(int(world_size), n)
+
+
+def moe_capacity_factor_default() -> float:
+    """``HOROVOD_MOE_CAPACITY_FACTOR`` (lenient-parsed): slack on the
+    perfect-balance per-expert token budget.  Default 1.25 (the GShard
+    training setting); floor 0.0 means capacity 0 — every token drops
+    (the drop-accounting soak's degenerate arm)."""
+    raw = os.environ.get("HOROVOD_MOE_CAPACITY_FACTOR", "")
+    try:
+        return max(0.0, float(raw)) if raw.strip() else 1.25
+    except ValueError:
+        return 1.25
+
+
+def moe_topk_default() -> int:
+    """``HOROVOD_MOE_TOPK`` (lenient-parsed): experts per token.
+    Default 2 (GShard top-2 gating); floor 1."""
+    raw = os.environ.get("HOROVOD_MOE_TOPK", "")
+    try:
+        return max(1, int(raw)) if raw.strip() else 2
+    except ValueError:
+        return 2
+
+
+def moe_capacity(total_tokens: int, n_experts: int, topk: int,
+                 capacity_factor: float) -> int:
+    """The per-expert row budget: ``ceil(cf * topk * tokens / experts)``
+    — a pure function of committed step geometry, so every rank (and
+    the single-rank reference) agrees without negotiation."""
+    return int(math.ceil(capacity_factor * topk * total_tokens
+                         / max(1, n_experts)))
+
+
+# -- the plane's stats() slice (Python-side, like the FSDP plane's:
+#    dispatch bookkeeping lives above the engine; the authoritative
+#    moe_tokens_dropped counter lives IN the engine so it survives this
+#    module's reset and rides TELEM frames).  capacity_factor and
+#    experts are gauges (current config), dispatches is cumulative. --
+
+_STATS_LOCK = threading.Lock()
+_DISPATCHES = 0
+_CAPACITY_FACTOR = 0.0
+_EXPERTS = 0
+
+
+def moe_stats() -> dict:
+    with _STATS_LOCK:
+        return {
+            "moe_dispatches": _DISPATCHES,
+            "moe_capacity_factor": _CAPACITY_FACTOR,
+            "moe_experts": _EXPERTS,
+        }
+
+
+def reset_moe_stats() -> None:
+    """Zero the plane gauges/counters (tests; the engine-side
+    ``moe_tokens_dropped`` counter is process-lifetime, like every
+    TELEM counter)."""
+    global _DISPATCHES, _CAPACITY_FACTOR, _EXPERTS
+    with _STATS_LOCK:
+        _DISPATCHES = 0
+        _CAPACITY_FACTOR = 0.0
+        _EXPERTS = 0
+
+
+def _note_dispatch(capacity_factor: float, experts: int) -> None:
+    global _DISPATCHES, _CAPACITY_FACTOR, _EXPERTS
+    with _STATS_LOCK:
+        _DISPATCHES += 1
+        _CAPACITY_FACTOR = float(capacity_factor)
+        _EXPERTS = int(experts)
+
+
+def _expert_rows(rows: np.ndarray, w1: np.ndarray, b1: np.ndarray,
+                 w2: np.ndarray, b2: np.ndarray) -> np.ndarray:
+    """Two-layer relu MLP applied ROW AT A TIME.  One row in, one row
+    out, independent of batch shape — the property that makes a token's
+    bytes identical whether it was computed on its owner rank among N
+    neighbours or in the single-rank reference among T."""
+    out = np.empty((rows.shape[0], w2.shape[1]), dtype=np.float32)
+    for i in range(rows.shape[0]):
+        h = np.maximum(rows[i] @ w1 + b1, np.float32(0))
+        out[i] = h @ w2 + b2
+    return out
+
+
+def _rows_dot(rows: np.ndarray, w: np.ndarray) -> np.ndarray:
+    """``rows @ w`` computed ROW AT A TIME — same rationale as
+    :func:`_expert_rows`: a batched gemm's per-row bytes can shift with
+    the batch extent, and the batch extent differs between a rank's
+    shard and the single-rank reference."""
+    out = np.empty((rows.shape[0], w.shape[1]), dtype=np.float32)
+    for i in range(rows.shape[0]):
+        out[i] = rows[i] @ w
+    return out
+
+
+class MoeLayer:
+    """One expert-parallel MoE layer: replicated top-k router + a
+    disjoint contiguous block of two-layer MLP experts per rank.
+
+    Every rank constructs the layer with the same arguments; parameter
+    init draws ALL experts from one seeded stream and keeps the owned
+    block, so the union across ranks is bit-identical to the reference
+    layer's full set.  ``world=(0, 1)`` builds the single-rank
+    dense-gated reference (all experts local, no engine) — the
+    bit-exactness anchor.
+
+    >>> layer = MoeLayer(d_model=16, d_hidden=32)
+    >>> y, cache = layer.forward(x_shard)          # x: [T_local, d]
+    >>> dx = layer.backward(dy_shard, cache)       # accumulates grads
+    >>> layer.apply_grads(lr=0.1)                  # SGD, zeroes grads
+    """
+
+    #: Per-process construction counter — two layers in one process get
+    #: distinct collective names (same contract as FlatSharder).
+    _instances = 0
+
+    def __init__(self, d_model: int, d_hidden: Optional[int] = None, *,
+                 n_experts: Optional[int] = None, topk: Optional[int] = None,
+                 capacity_factor: Optional[float] = None, seed: int = 0,
+                 name: str = "moe", wire_dtype: Optional[str] = None,
+                 world: Optional[Tuple[int, int]] = None):
+        if world is None:
+            from horovod_tpu.common.basics import basics
+            if basics.is_initialized():
+                world = (basics.rank(), basics.size())
+            else:
+                world = (0, 1)
+        self.rank, self.size = int(world[0]), int(world[1])
+        self.d_model = int(d_model)
+        self.d_hidden = int(d_hidden) if d_hidden is not None \
+            else 2 * self.d_model
+        self.n_experts = (moe_experts_default(self.size)
+                          if n_experts is None else int(n_experts))
+        if self.n_experts % self.size != 0:
+            raise ValueError(
+                f"n_experts {self.n_experts} must be divisible by the "
+                f"world size {self.size} (contiguous expert blocks per "
+                "rank)")
+        self.experts_per_rank = self.n_experts // self.size
+        self.topk = moe_topk_default() if topk is None else max(1, int(topk))
+        if self.topk > self.n_experts:
+            raise ValueError(f"topk {self.topk} > n_experts "
+                             f"{self.n_experts}")
+        self.capacity_factor = (moe_capacity_factor_default()
+                                if capacity_factor is None
+                                else max(0.0, float(capacity_factor)))
+        self.wire_dtype = wire_dtype
+        self.name = f"{name}.{MoeLayer._instances}"
+        MoeLayer._instances += 1
+        self._dispatches = 0
+
+        # Every rank draws the SAME full parameter set (one seeded
+        # stream) and keeps its contiguous expert block — the union
+        # across ranks is bitwise the reference's full set.
+        rng = np.random.RandomState(seed)
+        scale1 = np.float32(1.0 / math.sqrt(self.d_model))
+        scale2 = np.float32(1.0 / math.sqrt(self.d_hidden))
+        self.wg = (rng.standard_normal((self.d_model, self.n_experts))
+                   .astype(np.float32) * scale1)
+        full_w1 = (rng.standard_normal(
+            (self.n_experts, self.d_model, self.d_hidden))
+            .astype(np.float32) * scale1)
+        full_w2 = (rng.standard_normal(
+            (self.n_experts, self.d_hidden, self.d_model))
+            .astype(np.float32) * scale2)
+        lo = self.rank * self.experts_per_rank
+        hi = lo + self.experts_per_rank
+        self.expert_lo = lo
+        self.w1 = full_w1[lo:hi].copy()
+        self.w2 = full_w2[lo:hi].copy()
+        self.b1 = np.zeros((self.experts_per_rank, self.d_hidden),
+                           dtype=np.float32)
+        self.b2 = np.zeros((self.experts_per_rank, self.d_model),
+                           dtype=np.float32)
+        self.zero_grads()
+
+    # -- parameter views for DistributedOptimizer composition --
+
+    def router_params(self) -> List[np.ndarray]:
+        """Replicated parameters — reduce their grads across ranks."""
+        return [self.wg]
+
+    def expert_params(self) -> List[np.ndarray]:
+        """Rank-LOCAL parameters (this rank's expert block) — never
+        reduce their grads; every rank owns a disjoint set."""
+        return [self.w1, self.b1, self.w2, self.b2]
+
+    def zero_grads(self) -> None:
+        self.g_wg = np.zeros_like(self.wg)
+        self.g_w1 = np.zeros_like(self.w1)
+        self.g_b1 = np.zeros_like(self.b1)
+        self.g_w2 = np.zeros_like(self.w2)
+        self.g_b2 = np.zeros_like(self.b2)
+
+    def owner(self, expert: int) -> int:
+        """The rank owning ``expert`` (contiguous blocks)."""
+        return int(expert) // self.experts_per_rank
+
+    # -- wire helpers --
+
+    def _alltoall(self, payload: np.ndarray, splits: List[int],
+                  tag: str) -> np.ndarray:
+        """One engine alltoall (band 0, named ``moe.*`` for the
+        MOE_DISPATCH timeline span); identity at world size 1."""
+        eng = engine_or_none() if self.size > 1 else None
+        if eng is None:
+            if self.size > 1:
+                raise RuntimeError(
+                    "MoeLayer built for a multi-rank world but no engine "
+                    "is running")
+            return payload.copy()
+        return np.asarray(eng.alltoall(
+            payload, name=f"moe.{self.name}.{tag}.{self._dispatches}",
+            splits=splits, wire_dtype=self.wire_dtype, priority=0))
+
+    def _exchange_counts(self, counts: List[int], tag: str) -> List[int]:
+        """The transposed-splits negotiation: an equal-split int64
+        alltoall of each rank's send-count vector returns this rank's
+        COLUMN of the committed size matrix — the splits of the return
+        alltoall."""
+        if self.size == 1:
+            return list(counts)
+        eng = engine_or_none()
+        if eng is None:
+            raise RuntimeError(
+                "MoeLayer built for a multi-rank world but no engine "
+                "is running")
+        cnt = np.asarray(counts, dtype=np.int64).reshape(self.size, 1)
+        col = np.asarray(eng.alltoall(
+            cnt, name=f"moe.{self.name}.{tag}.counts.{self._dispatches}",
+            priority=0))
+        return [int(v) for v in col.reshape(-1)]
+
+    # -- the gate --
+
+    def gate(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]:
+        """Deterministic top-k gating: full softmax over expert logits,
+        stable-argsort top-k (ties toward the lower expert id), gate
+        weight = the softmax probability of the chosen expert (Switch /
+        GShard style, no renormalisation — keeps the vjp exact).
+        Returns ``(probs [T,E], topk_idx [T,k], gates [T,k])``."""
+        logits = _rows_dot(x, self.wg)
+        m = logits.max(axis=1, keepdims=True)
+        ex = np.exp(logits - m)
+        probs = (ex / ex.sum(axis=1, keepdims=True)).astype(np.float32)
+        topk_idx = np.argsort(-probs, axis=1, kind="stable")[:, :self.topk]
+        gates = np.take_along_axis(probs, topk_idx, axis=1)
+        return probs, topk_idx, gates
+
+    # -- forward --
+
+    def forward(self, x: np.ndarray) -> Tuple[np.ndarray, dict]:
+        """One MoE forward over this rank's contiguous batch shard
+        ``x [T_local, d_model]``.  Returns ``(y, cache)`` where ``y`` is
+        the gate-combined expert mixture and ``cache`` feeds
+        :meth:`backward`.  Dispatch and combine each ride one
+        variable-split alltoall; dropped (over-capacity) assignments
+        contribute zero and are counted into ``moe_tokens_dropped``."""
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        if x.ndim != 2 or x.shape[1] != self.d_model:
+            raise ValueError(
+                f"expected x [tokens, {self.d_model}], got {x.shape}")
+        t_local = x.shape[0]
+        probs, topk_idx, gates = self.gate(x)
+
+        # Assignments ordered (dest rank, token, slot): each dest block
+        # is in local token order, so concatenated across ranks the
+        # expert sees GLOBAL token order (contiguous batch shards).
+        by_dest: List[List[Tuple[int, int]]] = \
+            [[] for _ in range(self.size)]
+        for t in range(t_local):
+            for k in range(self.topk):
+                e = int(topk_idx[t, k])
+                by_dest[self.owner(e)].append((t, k))
+        counts = [len(b) for b in by_dest]
+        order = [tk for b in by_dest for tk in b]
+
+        payload = np.empty((len(order), self.d_model + 1),
+                           dtype=np.float32)
+        for i, (t, k) in enumerate(order):
+            payload[i, :self.d_model] = x[t]
+            payload[i, self.d_model] = np.float32(topk_idx[t, k])
+
+        recv_counts = self._exchange_counts(counts, "fwd")
+        recv = self._alltoall(payload, counts, "dispatch")
+
+        # Expert side: capacity in arrival (= global token) order.
+        capacity = moe_capacity(t_local * self.size, self.n_experts,
+                                self.topk, self.capacity_factor)
+        used = np.zeros(self.experts_per_rank, dtype=np.int64)
+        kept = np.zeros(recv.shape[0], dtype=bool)
+        local_e = np.empty(recv.shape[0], dtype=np.int64)
+        dropped = 0
+        for i in range(recv.shape[0]):
+            le = int(recv[i, self.d_model]) - self.expert_lo
+            local_e[i] = le
+            if used[le] < capacity:
+                used[le] += 1
+                kept[i] = True
+            else:
+                dropped += 1
+        out = np.zeros((recv.shape[0], self.d_model), dtype=np.float32)
+        for le in range(self.experts_per_rank):
+            sel = np.nonzero(kept & (local_e == le))[0]
+            if sel.size:
+                out[sel] = _expert_rows(recv[sel, :self.d_model],
+                                        self.w1[le], self.b1[le],
+                                        self.w2[le], self.b2[le])
+
+        note_moe_dispatch(dropped)
+        self._dispatches += 1
+        _note_dispatch(self.capacity_factor, self.n_experts)
+
+        back = self._alltoall(out, recv_counts, "combine")
+
+        # Combine: slot-ordered accumulation of gate * expert_out.
+        expert_out = np.zeros((t_local, self.topk, self.d_model),
+                              dtype=np.float32)
+        for i, (t, k) in enumerate(order):
+            expert_out[t, k] = back[i]
+        y = np.zeros((t_local, self.d_model), dtype=np.float32)
+        for k in range(self.topk):
+            y += gates[:, k:k + 1] * expert_out[:, :, :][:, k]
+        cache = {"x": x, "probs": probs, "topk_idx": topk_idx,
+                 "gates": gates, "order": order, "counts": counts,
+                 "recv_counts": recv_counts, "recv": recv, "kept": kept,
+                 "local_e": local_e, "expert_out": expert_out,
+                 "dropped": dropped}
+        return y, cache
+
+    # -- backward --
+
+    def backward(self, dy: np.ndarray, cache: dict) -> np.ndarray:
+        """Manual vjp of :meth:`forward`: accumulates expert grads
+        (rank-local) and the router grad (computed from ALLGATHERED
+        inputs and dlogits, so every rank runs the reference's exact
+        matmul — the router-grad half of the bit-exactness anchor) and
+        returns ``dx [T_local, d_model]``."""
+        dy = np.ascontiguousarray(dy, dtype=np.float32)
+        x, order = cache["x"], cache["order"]
+        gates, topk_idx = cache["gates"], cache["topk_idx"]
+        probs, expert_out = cache["probs"], cache["expert_out"]
+        t_local = x.shape[0]
+
+        # Upstream into each expert output row: gate * dy[token].
+        d_out = np.empty((len(order), self.d_model), dtype=np.float32)
+        for i, (t, k) in enumerate(order):
+            d_out[i] = gates[t, k] * dy[t]
+
+        # Ship expert-output grads along the forward routing (same
+        # splits), backprop rows on the owner, ship dx rows back.
+        recv_d = self._alltoall(d_out, cache["counts"], "bwd.dispatch")
+        recv, kept, local_e = cache["recv"], cache["kept"], cache["local_e"]
+        dx_rows = np.zeros((recv.shape[0], self.d_model), dtype=np.float32)
+        for i in range(recv.shape[0]):
+            if not kept[i]:
+                continue
+            le = int(local_e[i])
+            xi = recv[i, :self.d_model]
+            h_pre = xi @ self.w1[le] + self.b1[le]
+            h = np.maximum(h_pre, np.float32(0))
+            g = recv_d[i]
+            self.g_w2[le] += np.outer(h, g)
+            self.g_b2[le] += g
+            dh = g @ self.w2[le].T
+            dh = np.where(h_pre > 0, dh, np.float32(0))
+            self.g_w1[le] += np.outer(xi, dh)
+            self.g_b1[le] += dh
+            dx_rows[i] = dh @ self.w1[le].T
+        back = self._alltoall(dx_rows, cache["recv_counts"], "bwd.combine")
+
+        dx = np.zeros((t_local, self.d_model), dtype=np.float32)
+        d_gates = np.zeros_like(gates)
+        for i, (t, k) in enumerate(order):
+            dx[t] += back[i]
+            d_gates[t, k] = np.float32(np.dot(dy[t], expert_out[t, k]))
+
+        # Router vjp through the full softmax: dP is sparse on the
+        # selected entries; dlogits = P * (dP - sum(dP * P)).
+        d_probs = np.zeros_like(probs)
+        np.put_along_axis(d_probs, topk_idx, d_gates, axis=1)
+        inner = (d_probs * probs).sum(axis=1, keepdims=True)
+        dlogits = (probs * (d_probs - inner)).astype(np.float32)
+        dx += _rows_dot(dlogits, np.ascontiguousarray(self.wg.T))
+
+        # The anchor: allgather (x, dlogits) so EVERY rank computes the
+        # router grad with the reference's one matmul over the global
+        # batch — bitwise identical at every world size.
+        eng = engine_or_none() if self.size > 1 else None
+        if eng is None:
+            x_full, dl_full = x, dlogits
+        else:
+            x_full = np.asarray(eng.allgather(
+                x, name=f"moe.{self.name}.router.agx.{self._dispatches}"))
+            dl_full = np.asarray(eng.allgather(
+                dlogits,
+                name=f"moe.{self.name}.router.agdl.{self._dispatches}"))
+        self.g_wg += x_full.T @ dl_full
+        return dx
+
+    def apply_grads(self, lr: float) -> None:
+        """Plain SGD on router + owned experts, then zero grads.  The
+        router grad is already the GLOBAL-batch grad (backward's
+        allgather), so no reduction happens here — every rank applies
+        the same bytes and the replicas stay bit-identical."""
+        lr = np.float32(lr)
+        self.wg -= lr * self.g_wg
+        self.w1 -= lr * self.g_w1
+        self.b1 -= lr * self.g_b1
+        self.w2 -= lr * self.g_w2
+        self.b2 -= lr * self.g_b2
+        self.zero_grads()

@@ -380,15 +380,21 @@ def reducescatter(tensor: torch.Tensor,
 
 
 def alltoall_async(tensor: torch.Tensor,
-                   name: Optional[str] = None) -> int:
-    """Exchange equal dim-0 blocks: output block i came from rank i
-    (dim 0 must be divisible by ``size()``)."""
+                   name: Optional[str] = None, *, splits=None,
+                   wire_dtype: Optional[str] = None,
+                   priority: Optional[int] = None) -> int:
+    """Exchange dim-0 blocks: output block i came from rank i.  With
+    ``splits=None`` the blocks are equal (dim 0 must be divisible by
+    ``size()``); ``splits=[n_0, ..]`` sends ``n_d`` rows to rank d (the
+    variable-split MoE dispatch primitive — the engine validates the
+    per-rank vectors into one committed size matrix)."""
     eng = _engine()
     src = tensor.detach().contiguous()
     if eng is None:
         return _local_handle(src.clone())
     view = _np_view(src)
-    handle = eng.enqueue_alltoall(view, name)
+    handle = eng.enqueue_alltoall(view, name, splits=splits,
+                                  wire_dtype=wire_dtype, priority=priority)
     return _register(
         handle, src,
         lambda _t, out_np, _info=None: _from_np(out_np, tensor.dtype))
@@ -396,17 +402,31 @@ def alltoall_async(tensor: torch.Tensor,
 
 class _HorovodAlltoall(torch.autograd.Function):
     """Alltoall is a permutation of blocks across ranks; its adjoint is the
-    inverse permutation — another alltoall."""
+    inverse permutation — another alltoall.  With variable splits the
+    adjoint's splits are the TRANSPOSED matrix row: this rank's recv
+    counts, i.e. the committed matrix column, recovered from the forward
+    output (``recv_splits``)."""
 
     @staticmethod
-    def forward(ctx, tensor, name):
-        return synchronize(alltoall_async(tensor, name))
+    def forward(ctx, tensor, name, splits, recv_splits):
+        ctx.recv_splits = recv_splits
+        return synchronize(alltoall_async(tensor, name, splits=splits))
 
     @staticmethod
     def backward(ctx, grad_output):
-        return synchronize(alltoall_async(grad_output.contiguous())), None
+        return (synchronize(alltoall_async(grad_output.contiguous(),
+                                           splits=ctx.recv_splits)),
+                None, None, None)
 
 
-def alltoall(tensor: torch.Tensor,
-             name: Optional[str] = None) -> torch.Tensor:
-    return _HorovodAlltoall.apply(tensor, name)
+def alltoall(tensor: torch.Tensor, name: Optional[str] = None, *,
+             splits=None, recv_splits=None) -> torch.Tensor:
+    """Differentiable alltoall.  When ``splits`` is given, pass
+    ``recv_splits`` (this rank's per-source recv counts — e.g. from an
+    equal-split counts exchange, see runtime/moe.py) so the backward
+    alltoall can route gradient rows back along the transposed matrix."""
+    if splits is not None and recv_splits is None:
+        raise ValueError(
+            "variable-split alltoall needs recv_splits for its backward "
+            "(this rank's recv counts: the committed matrix column)")
+    return _HorovodAlltoall.apply(tensor, name, splits, recv_splits)

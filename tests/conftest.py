@@ -70,6 +70,13 @@ def pytest_configure(config):
         "excludes the marker, tier-1 still runs them)")
     config.addinivalue_line(
         "markers",
+        "moe: expert-parallel MoE plane tests (variable-split alltoall "
+        "dispatch/combine, dense-reference bit-parity, drop-token "
+        "accounting); ci.sh runs them in the moe gate under a hard "
+        "timeout (main sweep excludes the marker; tier-1 runs the ones "
+        "not also marked slow — the 4-rank variants ride the gate)")
+    config.addinivalue_line(
+        "markers",
         "ckpt: weight-plane tests (crash-consistent sharded saves, "
         "elastic resharding restore, kill-and-resume, live serve push); "
         "ci.sh runs them in the checkpoint gate under a hard timeout "

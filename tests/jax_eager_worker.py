@@ -57,6 +57,30 @@ def scenario_axis_general(rank, size):
         np.testing.assert_allclose(np.asarray(out[:, 2 * j:2 * j + 2]),
                                    j * 10 + rank)
 
+    # Variable dim-0 splits (eager-only): rank r sends r+d+1 rows to
+    # dest d, so my output receives s+rank+1 rows from each source s —
+    # the committed split matrix's column.
+    sp = [rank + d + 1 for d in range(size)]
+    w = np.concatenate([np.full((sp[d], 3), rank * 100 + d, np.float32)
+                        for d in range(size)])
+    out = hvd.alltoall(w, name="a2a_splits", splits=sp)
+    off = 0
+    for s in range(size):
+        n = s + rank + 1
+        np.testing.assert_allclose(np.asarray(out[off:off + n]),
+                                   s * 100 + rank)
+        off += n
+    assert off == out.shape[0], (off, out.shape)
+    # splits compose only with the dim-0 axis pair: typed refusal, not a
+    # silent wrong answer.
+    try:
+        hvd.alltoall(z, split_axis=1, concat_axis=1, name="a2a_bad",
+                     splits=[2] * size)
+    except NotImplementedError:
+        pass
+    else:
+        raise AssertionError("splits with split_axis=1 must raise")
+
 
 def main():
     hvd.init()

@@ -183,8 +183,71 @@ def scenario_flap_soak(rank, size, eng):
           flush=True)
 
 
+def scenario_heal_alltoall(rank, size, eng):
+    # Variable-split alltoall across link heals: the RESUME protocol
+    # repairs edges at the streaming cascade's cursors (the allreduce
+    # interleaved into each step consumes the injected conn-reset and
+    # heals), and the alltoalls — which circulate over the SAME
+    # per-channel sockets the heal swapped in place — must stay
+    # BIT-IDENTICAL to the pairwise-sends reference before, during, and
+    # after every heal, and to an undisturbed re-run.  Alltoall payload
+    # is verbatim on the wire, so any byte slip across a healed edge is
+    # visible immediately.
+    sp = [17 * ((rank + d) % 3) + 9 for d in range(size)]
+
+    def payload(r, step):
+        spr = [17 * ((r + d) % 3) + 9 for d in range(size)]
+        rows = sum(spr)
+        x = (np.arange(rows * 96, dtype=np.float32).reshape(rows, 96)
+             % 997.0) + r * 7 + step
+        return np.ascontiguousarray(x), spr
+
+    def expected(step):
+        blocks = []
+        for s in range(size):
+            xs, sps = payload(s, step)
+            off = sum(sps[:rank])
+            blocks.append(xs[off:off + sps[rank]])
+        return np.concatenate(blocks).tobytes()
+
+    def run(engine, tag):
+        outs = []
+        for step in range(STEPS):
+            # The cascade leg: consumes any armed conn-reset mid-stream
+            # and heals the edge the alltoall is about to ride.
+            g = (np.arange(COUNT, dtype=np.float32) % 1000.0) \
+                + rank * 7 + step
+            red = engine.allreduce(g, name=f"{tag}.ar.{step}")
+            assert np.ascontiguousarray(red).tobytes() == \
+                analytic(size, step), f"step {step}: healed allreduce"
+            x, _ = payload(rank, step)
+            out = engine.alltoall(x, name=f"{tag}.{step}", splits=sp,
+                                  wire_dtype=WIRE)
+            outs.append(np.ascontiguousarray(out).tobytes())
+        return outs
+
+    disturbed = run(eng, "ha2a")
+    st = eng.stats()
+    assert eng.abort_reason() == "", eng.abort_reason()
+    assert st["link_heal_failures"] == 0, st["link_heal_failures"]
+    assert st["link_reconnects"] >= 1, st["link_reconnects"]
+    if WIRE in (None, "fp32"):
+        for step in range(STEPS):
+            assert disturbed[step] == expected(step), (
+                f"step {step}: alltoall across heal != pairwise sends")
+    basics.shutdown()
+    basics.init()
+    eng2 = get_engine()
+    clean = run(eng2, "ha2a")
+    for step in range(STEPS):
+        assert disturbed[step] == clean[step], (
+            f"step {step}: alltoall across heal not bit-identical to "
+            "the undisturbed run")
+
+
 SCENARIOS = {
     "heal_parity": scenario_heal_parity,
+    "heal_alltoall": scenario_heal_alltoall,
     "recv_stall": scenario_recv_stall,
     "heal_exhaust": scenario_heal_exhaust,
     "partial_commit_heal": scenario_partial_commit_heal,

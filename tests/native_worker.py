@@ -160,6 +160,256 @@ def scenario_alltoall_indivisible(rank, size, eng):
     raise AssertionError("expected HorovodInternalError")
 
 
+def _a2a_dtypes():
+    dtypes = [np.float32, np.float64, np.int32, np.int64, np.uint8,
+              np.int8, np.uint16, np.int16, np.float16, np.bool_]
+    try:
+        import ml_dtypes
+
+        dtypes.append(ml_dtypes.bfloat16)
+    except ImportError:
+        pass
+    return dtypes
+
+
+def _a2a_case(src, size, dt, case):
+    """Rank ``src``'s deterministic payload + split vector for parity
+    case ``case`` — every rank recomputes every peer's payload locally,
+    so the pairwise-sends reference needs no second data path.  Case 0:
+    prime per-destination counts.  Case 1: a zero-heavy matrix with an
+    all-zero ROW (rank 1 sends nothing) and an all-zero COLUMN (rank 0
+    receives nothing) — the empty-block codec offsets.  Case 2: equal
+    legacy splits."""
+    primes = (1, 3, 7, 13, 61)
+    if case == 0:
+        sp = [primes[(src + d) % len(primes)] for d in range(size)]
+    elif case == 1:
+        sp = [0 if (src == 1 % size or d == 0) else 2 + ((src + d) % 3)
+              for d in range(size)]
+    else:
+        sp = [2] * size
+    rows = sum(sp)
+    rng = np.random.default_rng(5000 + 17 * src + case)
+    if np.dtype(dt).kind == "b":
+        x = (rng.integers(0, 2, (rows, 3)) > 0)
+    elif np.dtype(dt).kind in "fV" or np.dtype(dt).name == "bfloat16":
+        x = rng.standard_normal((rows, 3)).astype(dt)
+    else:
+        x = rng.integers(0, 100, (rows, 3)).astype(dt)
+    return np.ascontiguousarray(x), sp
+
+
+def _a2a_expected(rank, size, dt, case):
+    """The pairwise-sends reference: concatenate, in source-rank order,
+    each source's block addressed to ``rank``."""
+    blocks = []
+    for s in range(size):
+        xs, sp = _a2a_case(s, size, dt, case)
+        off = sum(sp[:rank])
+        blocks.append(xs[off:off + sp[rank]])
+    return np.concatenate(blocks) if blocks else None
+
+
+def scenario_alltoall_splits(rank, size, eng):
+    # The variable-split tentpole contract, bitwise: for every wire
+    # dtype and split geometry (prime counts, empty rows/columns, equal
+    # legacy splits) the alltoall output must equal the pairwise-sends
+    # reference BYTE FOR BYTE — alltoall moves payload verbatim, so each
+    # rank rebuilds every peer's deterministic payload and compares.
+    before = eng.stats()
+    for case in range(3):
+        for d_i, dt in enumerate(_a2a_dtypes()):
+            x, sp = _a2a_case(rank, size, dt, case)
+            out = eng.alltoall(x.copy(), name=f"a2a.c{case}.d{d_i}",
+                               splits=None if case == 2 else sp)
+            exp = _a2a_expected(rank, size, dt, case)
+            assert out.shape == exp.shape, (case, dt, out.shape, exp.shape)
+            assert out.tobytes() == exp.tobytes(), (
+                f"case {case} dtype {np.dtype(dt).name}: alltoall != "
+                "pairwise sends")
+    after = eng.stats()
+    assert after["alltoall_bytes"] > before["alltoall_bytes"], after
+    assert after["alltoall_ns"] > before["alltoall_ns"], after
+    # Split-vector validation is LOCAL and typed (bad geometry never
+    # reaches the wire).
+    x = np.zeros((4, 2), dtype=np.float32)
+    for bad in ([3] * (size + 1), [-1] + [5 - size + 2] * (size - 1),
+                [0] * size):
+        try:
+            eng.alltoall(x, splits=bad, name="a2a.bad")
+        except ValueError:
+            continue
+        raise AssertionError(f"splits {bad} accepted for dim0=4")
+    # Rank-dependent dim 0 is LEGAL with splits (that is the point);
+    # rank-dependent trailing dims are a negotiated typed error.
+    if size > 1:
+        y = np.zeros((rank + 1, 2), dtype=np.float32)
+        vr = [0] * size
+        vr[rank] = rank + 1
+        out = eng.alltoall(y, splits=vr, name="a2a.selfsend")
+        assert out.shape == (rank + 1, 2), out.shape
+        z = np.zeros((size, rank + 2), dtype=np.float32)
+        try:
+            eng.alltoall(z, name="a2a.mismatch")
+            raise AssertionError("rank-dependent trailing dims accepted")
+        except HorovodInternalError as e:
+            assert "Mismatched" in str(e), str(e)
+
+
+def scenario_alltoall_cached(rank, size, eng):
+    # Steady-state variable-split loop: step 1 earns the cache slot
+    # (splits are part of the signature), later steps replay the stored
+    # size matrix via the slot bit — same hit-rate contract as the
+    # allreduce steady loop.
+    steps = 40
+    sp = [(rank + d) % 3 + 1 for d in range(size)]
+    exp_rows = sum((s + rank) % 3 + 1 for s in range(size))
+    before = eng.stats()
+    for i in range(steps):
+        x = np.full((sum(sp), 2), float(rank + i), dtype=np.float32)
+        out = eng.alltoall(x, name="a2a.steady", splits=sp)
+        assert out.shape == (exp_rows, 2), out.shape
+        off = 0
+        for s in range(size):
+            n = (s + rank) % 3 + 1
+            assert np.all(out[off:off + n] == s + i), (i, s, out[off])
+            off += n
+    after = eng.stats()
+    hits = after["cache_hits"] - before["cache_hits"]
+    misses = after["cache_misses"] - before["cache_misses"]
+    assert hits + misses == steps, (hits, misses)
+    assert misses <= max(1, steps // 20), (
+        f"alltoall cache hit rate {hits}/{steps}")
+    # A DIFFERENT split vector under the same name must renegotiate
+    # (signature mismatch), not replay the stale matrix.
+    sp2 = [x + 1 for x in sp]
+    x = np.full((sum(sp2), 2), 7.0, dtype=np.float32)
+    out = eng.alltoall(x, name="a2a.steady", splits=sp2)
+    assert out.shape[0] == sum((s + rank) % 3 + 2 for s in range(size))
+    assert eng.stats()["cache_misses"] > after["cache_misses"]
+
+
+def scenario_alltoall_wire(rank, size, eng):
+    # Compressed wires on variable splits: fp32 wire is bitwise-verbatim
+    # (checked against pairwise sends in alltoall_splits); lossy wires
+    # must be DETERMINISTIC (repeat runs bitwise identical) and inside
+    # each format's error envelope — including the rank's OWN block,
+    # which round-trips the codec so output bytes never depend on which
+    # rank data stayed on.
+    rng = np.random.default_rng(6000 + rank)
+    sp = [13 * ((rank + d) % 3) + 5 for d in range(size)]
+    x = rng.standard_normal((sum(sp), 64)).astype(np.float32)
+    exp_blocks = []
+    for s in range(size):
+        sps = [13 * ((s + d) % 3) + 5 for d in range(size)]
+        rs = np.random.default_rng(6000 + s)
+        xs = rs.standard_normal((sum(sps), 64)).astype(np.float32)
+        off = sum(sps[:rank])
+        exp_blocks.append(xs[off:off + sps[rank]])
+    exp = np.concatenate(exp_blocks)
+    scale = float(np.max(np.abs(exp))) + 1e-9
+    s0 = eng.stats()
+    for wd, tol in (("fp16", 2e-3), ("bf16", 2e-2), ("int8", 4e-2),
+                    ("fp8", 1e-1)):
+        a = eng.alltoall(x.copy(), name=f"a2aw.{wd}.a", splits=sp,
+                         wire_dtype=wd)
+        b = eng.alltoall(x.copy(), name=f"a2aw.{wd}.b", splits=sp,
+                         wire_dtype=wd)
+        assert a.tobytes() == b.tobytes(), (
+            f"{wd}: alltoall repeat not deterministic")
+        err = float(np.max(np.abs(a - exp))) / scale
+        assert err < tol, (wd, err)
+    s1 = eng.stats()
+    if size > 1:
+        assert s1["wire_fp16_count"] > s0["wire_fp16_count"], s1
+        assert s1["wire_int8_count"] > s0["wire_int8_count"], s1
+        assert s1["quantize_ns"] > s0["quantize_ns"], s1
+    # Non-fp32 payloads ignore the advisory: int64 rides verbatim.
+    z = np.arange(size * 4, dtype=np.int64).reshape(size * 2, 2) + rank
+    out = eng.alltoall(z.copy(), name="a2aw.int64", wire_dtype="int8")
+    for s in range(size):
+        blk = out[2 * s:2 * s + 2]
+        zs = np.arange(size * 4, dtype=np.int64).reshape(size * 2, 2) + s
+        assert np.array_equal(blk, zs[2 * rank:2 * rank + 2]), (s, blk)
+
+
+def scenario_alltoall_shm_tcp(rank, size, eng):
+    # Transport neutrality for the variable-split path: the shm flat
+    # ring run must be BIT-IDENTICAL to the pure-TCP multi-channel run —
+    # same committed matrix, same block layout, only the bytes' route
+    # changes.
+    assert eng.stats()["config"]["shm_enabled"], "expected shm on"
+
+    def run(tag):
+        outs = []
+        for case in range(2):
+            for d_i, dt in enumerate(_a2a_dtypes()):
+                x, sp = _a2a_case(rank, size, dt, case)
+                outs.append(eng.alltoall(
+                    x.copy(), name=f"a2a.{tag}.c{case}.d{d_i}",
+                    splits=sp))
+        return outs
+
+    shm_out = run("shm")
+    basics.shutdown()
+    os.environ["HOROVOD_SHM_DISABLE"] = "1"
+    basics.init()
+    assert not eng.stats()["config"]["shm_enabled"]
+    tcp_out = run("tcp")
+    for i, (a, b) in enumerate(zip(shm_out, tcp_out)):
+        assert a.shape == b.shape and a.dtype == b.dtype, (i, a.shape)
+        assert a.tobytes() == b.tobytes(), (
+            f"case {i}: shm alltoall differs from TCP")
+
+
+def scenario_alltoall_death(rank, size, eng):
+    # Fault containment mid-alltoall: the highest rank dies abruptly
+    # after a warm-up exchange; every surviving rank's next alltoall
+    # must abort with a DESCRIPTIVE error naming the disconnect, not
+    # hang (the abort tests pin HOROVOD_LINK_RETRIES=0).
+    sp = [rank + 1] * size
+    x = np.full((sum(sp), 3), float(rank), dtype=np.float32)
+    out = eng.alltoall(x, name="pre_death", splits=sp)
+    assert out.shape[0] == sum(s + 1 for s in range(size)), out.shape
+    if rank == size - 1:
+        os._exit(31)  # crash without shutdown handshake
+    try:
+        eng.alltoall(x, name="post_death", splits=sp)
+    except HorovodInternalError as e:
+        msg = str(e)
+        assert ("disconnected" in msg or "lost connection" in msg
+                or "could not reach" in msg), msg
+        return
+    raise AssertionError("expected HorovodInternalError after peer death")
+
+
+def scenario_alltoall_fault(rank, size, eng):
+    # Deterministic conn-reset mid-alltoall (HOROVOD_FAULT_INJECT, link
+    # retries pinned to 0 by the test): every surviving rank aborts with
+    # the CULPRIT rank named; the injected rank sees its own fault.
+    frank, fstep, fkind = os.environ["HOROVOD_FAULT_INJECT"].split(":")
+    frank, fstep = int(frank), int(fstep)
+    sp = [2 * d + 1 for d in range(size)]
+    steps = fstep + 5
+    try:
+        for i in range(steps):
+            x = np.full((sum(sp), 8), float(rank + i), dtype=np.float32)
+            out = eng.alltoall(x, name=f"a2a.fault.{i}", splits=sp)
+            assert out.shape[0] == size * (2 * rank + 1), out.shape
+            assert np.all(out[:1] == i), (i, out[0, 0])
+    except HorovodInternalError as e:
+        msg = str(e)
+        if rank == frank:
+            assert "fault injection" in msg, msg
+        else:
+            assert f"rank {frank}" in msg, msg
+        print(f"worker rank={rank} got expected abort: {msg}", flush=True)
+        return
+    raise AssertionError(
+        f"rank {rank}: expected HorovodInternalError after injected "
+        f"{fkind} on rank {frank}")
+
+
 def scenario_broadcast(rank, size, eng):
     for root in range(size):
         x = np.arange(10, dtype=np.float32) * (rank + 1)
@@ -1049,6 +1299,12 @@ SCENARIOS = {
     "reducescatter": scenario_reducescatter,
     "alltoall": scenario_alltoall,
     "alltoall_indivisible": scenario_alltoall_indivisible,
+    "alltoall_splits": scenario_alltoall_splits,
+    "alltoall_cached": scenario_alltoall_cached,
+    "alltoall_wire": scenario_alltoall_wire,
+    "alltoall_shm_tcp": scenario_alltoall_shm_tcp,
+    "alltoall_death": scenario_alltoall_death,
+    "alltoall_fault": scenario_alltoall_fault,
     "shape_mismatch": scenario_shape_mismatch,
     "dtype_mismatch": scenario_dtype_mismatch,
     "root_mismatch": scenario_root_mismatch,

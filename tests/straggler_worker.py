@@ -86,6 +86,36 @@ def scenario_backup_skip(rank, size, eng):
     assert np.array_equal(out, np.full((4,), np.float32(size))), out[0]
 
 
+def scenario_backup_alltoall(rank, size, eng):
+    """Alltoall under k=1 with a permanently slow rank: the collective
+    needs every rank's split row before the matrix commits, so partial
+    commits must REFUSE it by construction — every step is a true
+    full-world barrier (all source blocks present, bitwise), nobody is
+    ever skipped, and backup_skips stays 0 even though k=1 is armed and
+    the straggler is genuinely slow."""
+    steps = 4
+    straggler = _straggler_rank(size)
+    sp = [rank + d + 1 for d in range(size)]
+    for s in range(steps):
+        x = np.full((sum(sp), 4), float(rank * 10 + s), dtype=np.float32)
+        try:
+            out = eng.alltoall(x, name=f"bka2a.{s}", splits=sp)
+        except StepSkipped:
+            raise AssertionError(
+                f"rank {rank} step {s}: alltoall was partially "
+                "committed under backup workers")
+        # Full world: block from EVERY source, including the straggler.
+        assert out.shape == (sum(r + rank + 1 for r in range(size)), 4)
+        off = 0
+        for src in range(size):
+            n = src + rank + 1
+            assert np.all(out[off:off + n] == src * 10 + s), (s, src)
+            off += n
+    st = eng.stats()
+    assert st["backup_skips"] == 0, st["backup_skips"]
+    assert st["config"]["backup_workers"] == 1, st["config"]
+
+
 def scenario_backup_cached(rank, size, eng):
     """Partial commit on the CACHED negotiation path: warm the response
     cache with full steps, make the last rank slow for exactly one step
@@ -272,6 +302,7 @@ def scenario_converge(rank, size, eng):
 SCENARIOS = {
     "parity_k0": scenario_parity_k0,
     "backup_skip": scenario_backup_skip,
+    "backup_alltoall": scenario_backup_alltoall,
     "backup_cached": scenario_backup_cached,
     "backup_multi": scenario_backup_multi,
     "backup_hier": scenario_backup_hier,

@@ -102,6 +102,26 @@ def test_worker_death_mid_multichannel_allreduce_aborts_cleanly():
                 extra_env={**FAULT_ENV, "HOROVOD_NUM_CHANNELS": "4"})
 
 
+@pytest.mark.parametrize("n", [2, 4])
+def test_worker_death_mid_alltoall_aborts_cleanly(n):
+    """The highest rank dies abruptly between variable-split alltoalls:
+    every survivor's next alltoall must abort with a descriptive
+    disconnect error — never a hang parked in the ring exchange (link
+    retries pinned to 0: this is the abort path's coverage; the heal
+    path has its own alltoall test in test_link_heal.py)."""
+    run_workers(n, "alltoall_death", timeout=90,
+                expected_rc={n - 1: 31}, extra_env=FAULT_ENV)
+
+
+def test_injected_conn_reset_mid_alltoall_names_culprit():
+    """A deterministic drop-conn on rank 2's 4th enqueue mid-alltoall
+    loop: every survivor aborts with the CULPRIT rank named; the
+    injected rank sees its own fault message."""
+    run_workers(3, "alltoall_fault", timeout=90,
+                extra_env={**FAULT_ENV,
+                           "HOROVOD_FAULT_INJECT": "2:3:drop-conn"})
+
+
 def test_injected_fault_multichannel_aborts_all_survivors():
     """drop-conn fault injection under channels=4: the abrupt loss of all
     of a rank's channel sockets surfaces as the prompt coordinator abort
@@ -248,6 +268,10 @@ def test_elastic_shrink_rewires_all_channels():
     assert len({ok[5] for ok in oks}) == 1, oks    # identical final loss
 
 
+# Slow-marked for the tier-1 wall-clock budget: ci.sh's main sweep does
+# not exclude slow, and test_relaunched_worker_rejoins_and_world_grows_back
+# keeps the rejoin machinery in tier-1.
+@pytest.mark.slow
 def test_elastic_rejoin_rewires_all_channels():
     """Worker rejoin mid-run under channels=4: the grow re-rendezvous
     admits the candidate and wires the full channel fan-out for the new

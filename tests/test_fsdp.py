@@ -49,7 +49,11 @@ def test_fsdp_plane_bitwise_parity_and_counters(n):
                 extra_env=_BANDS)
 
 
-@pytest.mark.parametrize("n", [2, 4])
+# The 4-rank jax/torch frontend variants are slow-marked for the tier-1
+# wall-clock budget: ci.sh's main sweep (which does not exclude slow)
+# still runs them, and the fsdp gate re-proves 4-rank plane parity.
+@pytest.mark.parametrize(
+    "n", [2, pytest.param(4, marks=pytest.mark.slow)])
 def test_fsdp_jax_bitwise_parity(n):
     """DistributedOptimizer(optax.adam, fsdp=True): unit boundaries
     from the param tree, per-unit shard-sized inner state, bitwise
@@ -58,7 +62,8 @@ def test_fsdp_jax_bitwise_parity(n):
                 extra_env={"JAX_PLATFORMS": "cpu", **_BANDS})
 
 
-@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize(
+    "n", [2, pytest.param(4, marks=pytest.mark.slow)])
 def test_fsdp_torch_bitwise_parity(n):
     """torch _FsdpOptimizer: hook-driven unit reductions on a real
     backward, bitwise parity vs the flat reference, measured ~1/N

@@ -60,6 +60,19 @@ def test_heal_mid_allreduce_bitwise_parity(n):
                            "HOROVOD_FAULT_INJECT": heal_schedule(n)})
 
 
+@pytest.mark.parametrize("n", [2, 4])
+def test_heal_mid_alltoall_bitwise_parity(n):
+    """One injected conn-reset per rank in an allreduce+alltoall loop:
+    the cascade's RESUME rewind heals each shot edge, and the variable-
+    split alltoalls riding the SAME healed per-channel sockets complete
+    every step with zero aborts and output bytes equal to both the
+    pairwise-sends reference and an undisturbed re-run — a healed edge
+    may not slip a single alltoall payload byte."""
+    run_workers(n, "heal_alltoall", worker=WORKER, timeout=180,
+                extra_env={**HEAL_ENV,
+                           "HOROVOD_FAULT_INJECT": heal_schedule(n)})
+
+
 @pytest.mark.parametrize("n,wire", [(2, "int8"), (4, "fp16")])
 def test_heal_compressed_wire_bitwise(n, wire):
     """Healing under compressed wires: the rewound byte stream is the

@@ -112,6 +112,59 @@ def test_alltoall_indivisible_raises():
     run_workers(2, "alltoall_indivisible")
 
 
+@pytest.mark.parametrize("n", [2, 4])
+def test_alltoall_variable_splits_bitwise(n):
+    """The tentpole parity anchor: variable-split alltoall over the full
+    dtype corpus (prime counts, empty rows/columns, equal legacy splits)
+    must equal pairwise sends BYTE FOR BYTE, local split validation must
+    be typed, and rank-dependent trailing dims must raise the negotiated
+    error (shm flat ring, the single-host default)."""
+    run_workers(n, "alltoall_splits", timeout=120)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_alltoall_variable_splits_bitwise_tcp(n):
+    """Same corpus over the pure-TCP multi-channel cascade — the
+    committed split matrix must slice identically across channel
+    shards."""
+    run_workers(n, "alltoall_splits", timeout=120,
+                extra_env={"HOROVOD_SHM_DISABLE": "1",
+                           "HOROVOD_NUM_CHANNELS": "3"})
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_alltoall_cached_negotiation(n):
+    """Steady-state variable-split loop negotiates via the cache slot bit
+    (splits are part of the signature); a changed split vector under the
+    same name renegotiates instead of replaying the stale matrix."""
+    run_workers(n, "alltoall_cached", timeout=120)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_alltoall_compressed_wires(n):
+    """fp16/bf16/int8/fp8 wires on variable splits: deterministic,
+    inside each format's error envelope, counted by the wire stats; the
+    advisory never touches non-fp32 payloads."""
+    run_workers(n, "alltoall_wire", timeout=120)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_alltoall_shm_vs_tcp_bitwise(n):
+    """Transport neutrality: the shm run and the TCP re-init run of the
+    same variable-split corpus produce identical bytes."""
+    run_workers(n, "alltoall_shm_tcp", timeout=150)
+
+
+def test_alltoall_timeline_span(tmp_path):
+    """Alltoall activity is attributed as an ALLTOALL span (moe.* names
+    get MOE_DISPATCH — covered in test_moe.py)."""
+    path = tmp_path / "timeline.json"
+    run_workers(2, "alltoall", extra_env={"HOROVOD_TIMELINE": str(path)})
+    events = json.loads(path.read_text().rstrip().rstrip(",") + "]")
+    names = {e.get("name") for e in events}
+    assert "ALLTOALL" in names, sorted(n for n in names if n)
+
+
 def test_shape_mismatch_raises_everywhere():
     run_workers(2, "shape_mismatch")
 

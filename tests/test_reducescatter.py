@@ -120,7 +120,11 @@ def test_sharded_jax_optax_bitwise(n):
                 extra_env={"JAX_PLATFORMS": "cpu"})
 
 
-@pytest.mark.parametrize("n", [2, 4])
+# 4-rank variant is slow-marked for the tier-1 wall-clock budget: it
+# still runs in ci.sh's main sweep (which does not exclude slow) and the
+# sharded gate re-proves 4-rank bitwise parity on every CI run.
+@pytest.mark.parametrize(
+    "n", [2, pytest.param(4, marks=pytest.mark.slow)])
 def test_sharded_torch_bitwise(n):
     """torch DistributedOptimizer(sharded=True) == unsharded flat
     SGD+momentum, bit-for-bit, with measured ~1/N optimizer-state

@@ -67,6 +67,21 @@ def test_backup_worker_skips_permanent_straggler():
                            "HOROVOD_FAULT_INJECT": "3:*:slow:600"})
 
 
+@pytest.mark.straggler
+def test_backup_worker_refuses_alltoall_partial_commit():
+    """k=1 with a permanently slow rank: alltoall steps must commit
+    FULL-WORLD every time (the committed split matrix needs every
+    rank's row, so the partial-commit machinery refuses the op by
+    construction) — correct bytes from every source, zero skips.
+
+    Marked ``straggler`` (not ``slow``): runs in the ci.sh straggler
+    gate and in tier-1, excluded from the main sweep."""
+    run_workers(4, "backup_alltoall", timeout=120, worker=WORKER,
+                extra_env={"HOROVOD_BACKUP_WORKERS": "1",
+                           "HOROVOD_BACKUP_GRACE_MS": "50",
+                           "HOROVOD_FAULT_INJECT": "3:*:slow:200"})
+
+
 def test_backup_worker_partial_commit_on_cached_path():
     """One-shot slow fault against a WARM negotiation cache: the partial
     commit rides the cached-slot path (participant set in partial_slots),

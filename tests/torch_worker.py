@@ -159,6 +159,29 @@ def scenario_rs_alltoall(rank, size):
     hvd.alltoall(y).sum().backward()
     assert torch.allclose(y.grad, torch.ones_like(y)), y.grad
 
+    # Variable splits: rank r sends r+d+1 rows to dest d; the receive
+    # layout is the transposed matrix column, and the adjoint ships the
+    # grad back over exactly those recv counts.
+    sp = [rank + d + 1 for d in range(size)]
+    rsp = [s + rank + 1 for s in range(size)]
+    w = torch.cat([torch.full((sp[d], 2), float(rank * 100 + d))
+                   for d in range(size)]).requires_grad_(True)
+    out = hvd.alltoall(w, splits=sp, recv_splits=rsp)
+    off = 0
+    for s in range(size):
+        assert torch.all(out[off:off + rsp[s]] == s * 100 + rank), out
+        off += rsp[s]
+    assert off == out.shape[0], (off, out.shape)
+    out.sum().backward()
+    assert torch.allclose(w.grad, torch.ones_like(w)), w.grad
+    # splits without recv_splits cannot define the adjoint: typed error.
+    try:
+        hvd.alltoall(w.detach(), splits=sp)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("splits without recv_splits must raise")
+
 
 def scenario_sparse(rank, size):
     # Gather-based sparse aggregation must match the densify path
